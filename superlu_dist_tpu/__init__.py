@@ -1,0 +1,53 @@
+"""superlu_dist_tpu — a TPU-native distributed sparse direct solver framework.
+
+A brand-new framework with the capabilities of SuperLU_DIST 6.4 (reference:
+``pdgssvx``, SRC/pdgssvx.c:505): solve sparse A·X = B by supernodal Gaussian
+elimination with static pivoting (GESP), followed by iterative refinement.
+
+Architecture (TPU-first, not a port):
+
+* **Host analysis layer** (numpy; C++ accelerators planned): equilibration,
+  MC64-style maximum-product row matching, fill-reducing column orderings,
+  elimination tree, supernodal symbolic factorization.  This mirrors the
+  reference's L4 preprocessing layer (SURVEY.md §1) but is organised around
+  building *static-shape batched compute plans* for XLA instead of MPI
+  message schedules.
+* **TPU numeric core**: a level-batched supernodal *multifrontal*
+  factorization.  All frontal matrices at one elimination-tree level are
+  independent; they are bucketed into padded static shapes and factored as a
+  single vmapped dense partial-LU + Schur-complement GEMM on the MXU
+  (the reference's flops hot spot, dSchCompUdt-2Ddynamic.c:566).  Extend-add
+  ("scatter", dscatter.c:111) becomes precomputed flat gather/scatter-add.
+* **Distribution**: a 2D logical device mesh (``jax.sharding.Mesh``) is the
+  analog of the reference's 2D MPI process grid (superlu_grid.c:31); fronts
+  are sharded over the mesh with ``shard_map`` and extend-add contributions
+  combined with ``psum`` over ICI — XLA collectives instead of MPI.
+* **Precision**: TPUs have no fp64 MXU; the default TPU path factors in
+  float32 and recovers double-precision residuals via iterative refinement
+  in float64 — the reference's own GESP + ReplaceTinyPivot + IR design
+  (pdgstrf2.c:218, pdgsrfs.c:120) is the justification.  Full f64/c128
+  paths run on the CPU backend.
+"""
+
+from superlu_dist_tpu.utils.options import (
+    Options, Fact, ColPerm, RowPerm, IterRefine, Trans, YesNo,
+    set_default_options,
+)
+from superlu_dist_tpu.utils.stats import Stats
+from superlu_dist_tpu.sparse.formats import SparseCSR, SparseCSC
+
+
+def __getattr__(name):
+    # lazy: the driver pulls in jax; keep light imports (io, formats) fast
+    if name in ("gssvx", "LUFactorization"):
+        from superlu_dist_tpu.drivers import gssvx as _g
+        return getattr(_g, name)
+    raise AttributeError(name)
+
+__version__ = "0.1.0"
+
+
+def get_version_number():
+    """Analog of superlu_dist_GetVersionNumber (superlu_dist_version.c)."""
+    major, minor, bugfix = (int(x) for x in __version__.split("."))
+    return major, minor, bugfix

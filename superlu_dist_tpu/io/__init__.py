@@ -1,0 +1,4 @@
+from superlu_dist_tpu.io.readers import (
+    read_harwell_boeing, read_rutherford_boeing, read_matrix_market,
+    read_triples, read_binary, write_matrix_market, write_binary, read_matrix,
+)

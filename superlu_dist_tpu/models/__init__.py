@@ -1,0 +1,3 @@
+from superlu_dist_tpu.models.gallery import (
+    poisson2d, poisson3d, random_sparse, convection_diffusion_2d,
+)

@@ -1,0 +1,120 @@
+"""Model-problem generators (the framework's benchmark "model family").
+
+The reference ships small Harwell-Boeing fixtures (EXAMPLE/g20.rua etc.,
+EXAMPLE/README:31-34) and BASELINE.md targets a 5-pt 3D Poisson with n≈1M.
+These generators produce the same class of matrices directly, with grid
+coordinates attached so the geometric nested-dissection ordering can be used.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from superlu_dist_tpu.sparse.formats import SparseCSR, coo_to_csr
+
+
+def poisson2d(nx: int, ny: int | None = None, dtype=np.float64) -> SparseCSR:
+    """5-point 2D Laplacian on an nx×ny grid (n = nx*ny), Dirichlet."""
+    ny = nx if ny is None else ny
+    idx = np.arange(nx * ny).reshape(nx, ny)
+    rows, cols, vals = [], [], []
+
+    def add(r, c, v):
+        rows.append(r.ravel())
+        cols.append(c.ravel())
+        vals.append(np.full(r.size, v, dtype=dtype))
+
+    add(idx, idx, 4.0)
+    add(idx[1:, :], idx[:-1, :], -1.0)
+    add(idx[:-1, :], idx[1:, :], -1.0)
+    add(idx[:, 1:], idx[:, :-1], -1.0)
+    add(idx[:, :-1], idx[:, 1:], -1.0)
+    a = coo_to_csr(nx * ny, nx * ny, np.concatenate(rows), np.concatenate(cols),
+                   np.concatenate(vals))
+    a.grid_shape = (nx, ny)   # consumed by geometric nested dissection
+    return a
+
+
+def poisson3d(nx: int, ny: int | None = None, nz: int | None = None,
+              dtype=np.float64) -> SparseCSR:
+    """7-point 3D Laplacian (the BASELINE.md config-4 matrix class)."""
+    ny = nx if ny is None else ny
+    nz = nx if nz is None else nz
+    idx = np.arange(nx * ny * nz).reshape(nx, ny, nz)
+    rows, cols, vals = [], [], []
+
+    def add(r, c, v):
+        rows.append(r.ravel())
+        cols.append(c.ravel())
+        vals.append(np.full(r.size, v, dtype=dtype))
+
+    add(idx, idx, 6.0)
+    for axis in range(3):
+        lo = [slice(None)] * 3
+        hi = [slice(None)] * 3
+        lo[axis] = slice(1, None)
+        hi[axis] = slice(None, -1)
+        add(idx[tuple(lo)], idx[tuple(hi)], -1.0)
+        add(idx[tuple(hi)], idx[tuple(lo)], -1.0)
+    n = nx * ny * nz
+    a = coo_to_csr(n, n, np.concatenate(rows), np.concatenate(cols),
+                   np.concatenate(vals))
+    a.grid_shape = (nx, ny, nz)
+    return a
+
+
+def convection_diffusion_2d(nx: int, ny: int | None = None, beta: float = 10.0,
+                            dtype=np.float64) -> SparseCSR:
+    """Unsymmetric 2D convection-diffusion (upwind), exercises the
+    unsymmetric-value path (pattern stays structurally symmetric)."""
+    ny = nx if ny is None else ny
+    h = 1.0 / (nx + 1)
+    idx = np.arange(nx * ny).reshape(nx, ny)
+    rows, cols, vals = [], [], []
+
+    def add(r, c, v):
+        rows.append(r.ravel())
+        cols.append(c.ravel())
+        vals.append(np.full(r.size, v, dtype=dtype))
+
+    add(idx, idx, 4.0 + beta * h)
+    add(idx[1:, :], idx[:-1, :], -1.0 - beta * h)   # upwind in x
+    add(idx[:-1, :], idx[1:, :], -1.0)
+    add(idx[:, 1:], idx[:, :-1], -1.0)
+    add(idx[:, :-1], idx[:, 1:], -1.0)
+    a = coo_to_csr(nx * ny, nx * ny, np.concatenate(rows), np.concatenate(cols),
+                   np.concatenate(vals))
+    a.grid_shape = (nx, ny)
+    return a
+
+
+def random_sparse(n: int, density: float = 0.01, seed: int = 0,
+                  diag_dominant: bool = True, dtype=np.float64,
+                  pattern_symmetric: bool = False) -> SparseCSR:
+    """Random square sparse matrix with a guaranteed nonzero diagonal.
+
+    With diag_dominant=True the matrix is safe to factor without pivoting,
+    which isolates structure bugs from numerics in tests.  Complex dtypes
+    give the z-path (reference z-twin files) coverage.
+    """
+    rng = np.random.default_rng(seed)
+    nnz_target = max(n, int(density * n * n))
+    rows = rng.integers(0, n, size=nnz_target)
+    cols = rng.integers(0, n, size=nnz_target)
+    if pattern_symmetric:
+        rows, cols = np.concatenate([rows, cols]), np.concatenate([cols, rows])
+
+    def rand(size):
+        if np.issubdtype(np.dtype(dtype), np.complexfloating):
+            return (rng.standard_normal(size) + 1j * rng.standard_normal(size)).astype(dtype)
+        return rng.standard_normal(size).astype(dtype)
+
+    vals = rand(len(rows))
+    # ensure full diagonal
+    rows = np.concatenate([rows, np.arange(n)])
+    cols = np.concatenate([cols, np.arange(n)])
+    dval = rand(n)
+    if diag_dominant:
+        dval = dval + np.sign(dval.real + (dval.real == 0)) * (4.0 * n * density + 4.0)
+    vals = np.concatenate([vals, dval])
+    return coo_to_csr(n, n, rows, cols, vals)

@@ -1,0 +1,2 @@
+from superlu_dist_tpu.ordering.etree import etree_symmetric, postorder, tree_levels
+from superlu_dist_tpu.ordering.dispatch import get_perm_c

@@ -1,0 +1,3 @@
+from superlu_dist_tpu.sparse.formats import (
+    SparseCSR, SparseCSC, coo_to_csr, coo_to_csc, symmetrize_pattern,
+)

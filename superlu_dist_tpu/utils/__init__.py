@@ -1,0 +1,6 @@
+from superlu_dist_tpu.utils.options import (
+    Options, Fact, ColPerm, RowPerm, IterRefine, Trans, YesNo,
+    set_default_options,
+)
+from superlu_dist_tpu.utils.stats import Stats
+from superlu_dist_tpu.utils.errors import SuperLUError, SingularMatrixError

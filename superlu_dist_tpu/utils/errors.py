@@ -1,0 +1,20 @@
+"""Error model.
+
+The reference reports errors via ``info`` codes (<0: the -info-th argument
+was invalid, via pxerr_dist; >0: U(i,i) is exactly singular, pdgstrf.c:234-241)
+or aborts (ABORT, util_dist.h:27-34).  We use exceptions for argument errors
+and return ``info`` from drivers for singularity, matching pdgssvx semantics.
+"""
+
+
+class SuperLUError(Exception):
+    """Invalid argument / internal error (analog of pxerr_dist + ABORT)."""
+
+
+class SingularMatrixError(SuperLUError):
+    """U(i,i) exactly singular and ReplaceTinyPivot disabled (info > 0)."""
+
+    def __init__(self, k: int):
+        self.info = k + 1   # reference convention: 1-based first zero pivot
+        super().__init__(f"Factorization failed: U({k},{k}) is exactly zero "
+                         f"(info={self.info})")

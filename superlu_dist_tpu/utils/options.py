@@ -1,0 +1,119 @@
+"""Solver options.
+
+Mirrors the reference's runtime option struct ``superlu_dist_options_t``
+(SRC/superlu_defs.h:628-657) and its defaults ``set_default_options_dist``
+(SRC/util.c:376-401), re-expressed for the TPU-native pipeline.  TPU-specific
+knobs (factor dtype, bucket geometry) replace the CPU/GPU tuning env vars
+(sp_ienv_dist, SRC/sp_ienv.c:70-123; get_cublas_nb etc., SRC/util.c:932-972).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+
+
+class YesNo(enum.Enum):
+    NO = 0
+    YES = 1
+
+
+class Fact(enum.Enum):
+    """Factorization reuse tiers (reference fact_t, superlu_defs.h:489-510).
+
+    These are the reference API's main performance feature for time-stepping
+    users (SURVEY.md §5 checkpoint/resume): each tier skips more of the
+    pipeline on a repeated solve.
+    """
+
+    DOFACT = 0                      # factor from scratch
+    SamePattern = 1                 # reuse column perm + symbolic + plan
+    SamePattern_SameRowPerm = 2     # additionally reuse row perm + scalings
+    FACTORED = 3                    # reuse the numeric factors (solve only)
+
+
+class ColPerm(enum.Enum):
+    """Fill-reducing column orderings (reference colperm_t; dispatch
+    get_perm_c_dist, SRC/get_perm_c.c:463-530)."""
+
+    NATURAL = 0
+    MMD_AT_PLUS_A = 1       # minimum degree on pattern of A^T + A
+    ND_AT_PLUS_A = 2        # BFS nested dissection (METIS_AT_PLUS_A analog)
+    METIS_AT_PLUS_A = 2     # alias: the reference default maps to our ND
+    MY_PERMC = 3            # user-supplied permutation
+
+
+class RowPerm(enum.Enum):
+    """Numerical row pivoting strategy (reference rowperm_t;
+    dldperm_dist, SRC/dldperm_dist.c:95)."""
+
+    NOROWPERM = 0
+    LargeDiag_MC64 = 1      # maximum-product weighted bipartite matching
+    MY_PERMR = 2
+
+
+class IterRefine(enum.Enum):
+    """Iterative refinement (reference IterRefine_t; pdgsrfs.c:120)."""
+
+    NOREFINE = 0
+    SLU_SINGLE = 1
+    SLU_DOUBLE = 2
+
+
+class Trans(enum.Enum):
+    NOTRANS = 0
+    TRANS = 1
+    CONJ = 2
+
+
+@dataclasses.dataclass
+class Options:
+    """Runtime options (analog of superlu_dist_options_t).
+
+    Defaults follow set_default_options_dist (SRC/util.c:376-401):
+    Fact=DOFACT, Equil=YES, ColPerm=METIS_AT_PLUS_A, RowPerm=LargeDiag_MC64,
+    ReplaceTinyPivot, IterRefine=DOUBLE, PrintStat=YES.
+    """
+
+    fact: Fact = Fact.DOFACT
+    equil: bool = True
+    col_perm: ColPerm = ColPerm.ND_AT_PLUS_A
+    row_perm: RowPerm = RowPerm.LargeDiag_MC64
+    replace_tiny_pivot: bool = True
+    iter_refine: IterRefine = IterRefine.SLU_DOUBLE
+    trans: Trans = Trans.NOTRANS
+    print_stat: bool = False
+    # --- symbolic / blocking tuning (sp_ienv analogs, SRC/sp_ienv.c:70-123) ---
+    relax: int = 20              # NREL: amalgamate subtrees with <= relax cols
+    max_supernode: int = 256     # NSUP: cap supernode width.  The reference
+                                 # uses 128 (CPU-cache-sized); the MXU wants
+                                 # wider panels (SURVEY.md §7 step 10).
+    # --- TPU-native knobs -----------------------------------------------------
+    factor_dtype: str | None = None   # None => float32 on TPU, float64 on CPU
+    ir_dtype: str = "float64"         # residual precision for refinement
+    bucket_growth: float = 1.5        # geometric padding factor for front
+                                      # size buckets (static-shape batching)
+    min_bucket: int = 8               # smallest padded front dimension
+    # user-supplied permutations for MY_PERMC / MY_PERMR
+    user_perm_c = None
+    user_perm_r = None
+
+
+def set_default_options() -> Options:
+    """Analog of set_default_options_dist (SRC/util.c:376)."""
+    return Options()
+
+
+def default_factor_dtype() -> str:
+    """float32 on TPU (no fp64 MXU), float64 elsewhere."""
+    try:
+        import jax
+        platform = jax.default_backend()
+    except Exception:  # pragma: no cover - jax always present in practice
+        platform = "cpu"
+    if platform == "cpu" and os.environ.get("JAX_ENABLE_X64", "").lower() not in ("0", "false"):
+        import jax
+        if jax.config.read("jax_enable_x64"):
+            return "float64"
+    return "float32"

@@ -1,0 +1,69 @@
+"""Phase timing / flop statistics.
+
+Analog of ``SuperLUStat_t`` (SRC/util_dist.h:83-96) with the per-phase
+``utime[]``/``ops[]`` arrays over the PhaseType enum
+(SRC/superlu_enum_consts.h:65-89), and of ``PStatPrint`` (SRC/util.c:484-534)
+which reports phase seconds plus factor/solve Mflops — the baseline metric
+source (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+#: Phases, mirroring the reference's PhaseType (superlu_enum_consts.h:65-89).
+PHASES = (
+    "EQUIL", "ROWPERM", "COLPERM", "ETREE", "SYMBFACT", "DIST",
+    "FACT", "SOLVE", "REFINE",
+)
+
+
+@dataclass
+class Stats:
+    utime: dict = field(default_factory=lambda: {p: 0.0 for p in PHASES})
+    ops: dict = field(default_factory=lambda: {p: 0.0 for p in PHASES})
+    tiny_pivots: int = 0          # reference: stat->TinyPivots (pdgstrf2.c:226)
+    refine_steps: int = 0         # reference: stat->RefineSteps
+    peak_memory_bytes: int = 0
+    current_memory_bytes: int = 0
+
+    @contextlib.contextmanager
+    def timer(self, phase: str):
+        """TIC/TOC analog (util_dist.h:135-141)."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.utime[phase] = self.utime.get(phase, 0.0) + time.perf_counter() - t0
+
+    def log_memory(self, nbytes: int):
+        """Analog of log_memory (SRC/util.c:914)."""
+        self.current_memory_bytes += nbytes
+        self.peak_memory_bytes = max(self.peak_memory_bytes, self.current_memory_bytes)
+
+    def gflops(self, phase: str) -> float:
+        t = self.utime.get(phase, 0.0)
+        return (self.ops.get(phase, 0.0) / t / 1e9) if t > 0 else 0.0
+
+    def report(self) -> str:
+        """PStatPrint analog (SRC/util.c:484-534): phase times + Mflops."""
+        lines = ["**************************************************",
+                 "**** Time (seconds) ****"]
+        for p in PHASES:
+            if self.utime.get(p, 0.0) > 0 or self.ops.get(p, 0.0) > 0:
+                lines.append(f"    {p:<10s} time {self.utime.get(p, 0.0):10.4f}")
+        for p in ("FACT", "SOLVE"):
+            if self.ops.get(p, 0.0) > 0:
+                lines.append(
+                    f"    {p} flops {self.ops[p]:.6e}\tMflops {self.gflops(p) * 1e3:10.2f}")
+        if self.tiny_pivots:
+            lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
+        if self.refine_steps:
+            lines.append(f"    refinement steps: {self.refine_steps}")
+        lines.append("**************************************************")
+        return "\n".join(lines)
+
+    def print(self):
+        print(self.report())

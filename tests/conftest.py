@@ -1,0 +1,19 @@
+"""Test harness configuration.
+
+Tests run on the CPU backend with an 8-device virtual mesh so multi-chip
+sharding is exercised without TPU hardware (the driver separately dry-runs
+the multi-chip path), and with x64 enabled so the f64/c128 reference paths
+are exact.  Mirrors the reference's strategy of oversubscribing MPI ranks on
+one box (SURVEY.md §4, .travis_tests.sh).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+jax.config.update("jax_enable_x64", True)

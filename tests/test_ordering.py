@@ -1,0 +1,106 @@
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.models.gallery import poisson2d, random_sparse
+from superlu_dist_tpu.ordering.etree import etree_symmetric, postorder, tree_levels
+from superlu_dist_tpu.ordering.minimum_degree import minimum_degree
+from superlu_dist_tpu.ordering.dissection import geometric_nd, bfs_nd
+from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+
+
+def dense_etree(pat):
+    """Brute-force etree via dense symbolic elimination: parent[j] = first
+    below-diagonal nonzero of column j of the filled pattern."""
+    n = pat.shape[0]
+    f = pat.copy()
+    parent = np.full(n, -1, dtype=np.int64)
+    for j in range(n):
+        below = np.flatnonzero(f[j + 1:, j]) + j + 1
+        if len(below):
+            p = below[0]
+            parent[j] = p
+            f[below, p] = True      # fill: column j merges into column p
+            f[p, below] = True
+    return parent
+
+
+def sym_pattern(a):
+    n = a.n_rows
+    pat = np.zeros((n, n), dtype=bool)
+    rows = np.repeat(np.arange(n), np.diff(a.indptr))
+    pat[rows, a.indices] = True
+    pat |= pat.T
+    np.fill_diagonal(pat, True)
+    return pat
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_etree_matches_dense(seed):
+    a = random_sparse(30, density=0.08, seed=seed)
+    s = symmetrize_pattern(a)
+    parent = etree_symmetric(s.n_rows, s.indptr, s.indices)
+    want = dense_etree(sym_pattern(a))
+    assert np.array_equal(parent, want)
+
+
+def test_postorder_valid():
+    a = poisson2d(6)
+    s = symmetrize_pattern(a)
+    parent = etree_symmetric(s.n_rows, s.indptr, s.indices)
+    post = postorder(parent)
+    assert sorted(post) == list(range(len(parent)))
+    seen = np.zeros(len(parent), dtype=bool)
+    for j in post:
+        for pj in [parent[j]]:
+            pass
+        # children must appear before parents
+        assert not seen[j]
+        seen[j] = True
+        if parent[j] >= 0:
+            assert not seen[parent[j]]
+    lvl = tree_levels(parent)
+    for j, p in enumerate(parent):
+        if p >= 0:
+            assert lvl[p] > lvl[j]
+
+
+def fill_count(pat, order):
+    """nnz(L) after eliminating in the given order (dense symbolic)."""
+    n = pat.shape[0]
+    f = pat[np.ix_(order, order)].copy()
+    np.fill_diagonal(f, True)
+    count = 0
+    for j in range(n):
+        below = np.flatnonzero(f[j + 1:, j]) + j + 1
+        count += len(below) + 1
+        if len(below):
+            f[np.ix_(below, below)] = True
+    return count
+
+
+@pytest.mark.parametrize("maker", ["poisson", "random"])
+def test_orderings_reduce_fill_and_are_perms(maker):
+    if maker == "poisson":
+        a = poisson2d(8)
+    else:
+        a = random_sparse(48, density=0.06, seed=3, pattern_symmetric=True)
+    s = symmetrize_pattern(a)
+    n = s.n_rows
+    pat = sym_pattern(a)
+    natural_fill = fill_count(pat, np.arange(n))
+    md = minimum_degree(n, s.indptr, s.indices)
+    assert sorted(md) == list(range(n))
+    assert fill_count(pat, md) <= natural_fill
+    nd = bfs_nd(n, s.indptr, s.indices, leaf_size=8)
+    assert sorted(nd) == list(range(n))
+    if maker == "poisson":
+        geo = geometric_nd(a.grid_shape)
+        assert sorted(geo) == list(range(n))
+        assert fill_count(pat, geo) <= natural_fill
+
+
+def test_geometric_nd_3d():
+    from superlu_dist_tpu.models.gallery import poisson3d
+    a = poisson3d(4)
+    order = geometric_nd(a.grid_shape)
+    assert sorted(order) == list(range(64))
