@@ -1,0 +1,2 @@
+from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
+from superlu_dist_tpu.rowperm.matching import maximum_product_matching

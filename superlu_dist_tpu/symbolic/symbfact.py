@@ -1,0 +1,175 @@
+"""Supernodal symbolic factorization.
+
+Capability analog of the reference's serial symbolic factorization
+(symbfact, SRC/symbfact.c:80: column DFS, T2 supernode detection, relaxed
+supernodes via relax_snode at :224) — redesigned for the TPU numeric phase:
+
+* The pattern is structurally symmetrized first (sparse.formats.
+  symmetrize_pattern).  Under static pivoting (GESP — no row exchanges
+  during factorization, reference pdgstrf2.c:218) the LU fill of a
+  symmetric pattern equals the Cholesky fill of that pattern, so the
+  symbolic phase is exact and L and U share one structure (U = Lᵀ pattern),
+  halving the bookkeeping.
+* Row structures are computed per *supernode*, not per column: for a
+  supernode with root (last) column r, the below-diagonal structure equals
+  struct(r) — by the etree subset theorem struct(j)\\{parent(j)} ⊆
+  struct(parent(j)), applied along the path from any member column to r.
+  Bottom-up set unions over the supernode tree give O(fill)-ish work.
+* Supernodes = relaxed leaf subtrees (≤ `relax` columns; reference NREL,
+  sp_ienv(2)) plus zero-extra-fill chain merges capped at `max_supernode`
+  (reference NSUP, sp_ienv(3)).  The merge test — child's row structure
+  exactly equals parent's columns ∪ parent's rows, with contiguous column
+  ranges — recovers the fundamental supernodes the reference's T2 test
+  finds, at supernode granularity.
+
+The output feeds the FactorPlan ("distribution" analog, numeric.plan) that
+maps supernodes onto level-batched dense fronts for the MXU.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from superlu_dist_tpu.sparse.formats import SparseCSR, invert_perm
+from superlu_dist_tpu.ordering.etree import etree_symmetric, postorder
+
+
+@dataclasses.dataclass
+class SymbolicFact:
+    n: int
+    perm: np.ndarray          # combined fill-reducing + postorder: new k <- old perm[k]
+    parent: np.ndarray        # column etree in final labels
+    sn_start: np.ndarray      # (ns+1,) supernode column ranges [start, end)
+    col_to_sn: np.ndarray     # (n,)
+    sn_rows: list             # per supernode: sorted below-diagonal rows (final labels)
+    sn_parent: np.ndarray     # (ns,) parent supernode id or -1
+    sn_level: np.ndarray      # (ns,) batching level (leaves 0)
+    nnz_L: int                # including the dense diagonal-block lower triangle
+    nnz_U: int
+    flops: float              # factorization flop estimate
+
+    @property
+    def n_supernodes(self) -> int:
+        return len(self.sn_start) - 1
+
+    def sn_width(self, s: int) -> int:
+        return int(self.sn_start[s + 1] - self.sn_start[s])
+
+
+def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
+                       relax: int = 20, max_supernode: int = 256) -> SymbolicFact:
+    """Symbolic phase on a symmetrized pattern with a fill-reducing order.
+
+    Returns all structures in the final (order ∘ postorder) labeling.
+    """
+    n = sym_pattern.n_rows
+    relax = min(relax, max_supernode)
+
+    # ---- permute, etree, postorder, combine --------------------------------
+    b0 = sym_pattern.permute(order, order)
+    parent0 = etree_symmetric(n, b0.indptr, b0.indices)
+    post = postorder(parent0)
+    inv_post = invert_perm(post)
+    perm = np.asarray(order, dtype=np.int64)[post]
+    old_parents = parent0[post]
+    parent = np.where(old_parents >= 0, inv_post[np.clip(old_parents, 0, None)], -1)
+    b = sym_pattern.permute(perm, perm)
+    indptr, indices = b.indptr, b.indices
+
+    # ---- relaxed leaf supernodes (relax_snode analog) ----------------------
+    # postordered labels => every subtree is a contiguous column range
+    cnt = np.ones(n, dtype=np.int64)
+    for j in range(n):
+        p = parent[j]
+        if p >= 0:
+            cnt[p] += cnt[j]
+    is_relaxed_root = (cnt <= relax) & np.where(
+        parent >= 0, cnt[np.clip(parent, 0, None)] > relax, True)
+    starts = []
+    j = 0
+    relaxed_roots = np.flatnonzero(is_relaxed_root)
+    root_iter = iter(relaxed_roots)
+    next_root = next(root_iter, None)
+    while j < n:
+        starts.append(j)
+        if next_root is not None and next_root - cnt[next_root] + 1 == j:
+            j = int(next_root) + 1
+            next_root = next(root_iter, None)
+        else:
+            assert next_root is None or j < next_root - cnt[next_root] + 1, \
+                "relaxed subtrees must be contiguous and disjoint"
+            j += 1
+    starts.append(n)
+    first = np.array(starts[:-1], dtype=np.int64)
+    last = np.array(starts[1:], dtype=np.int64) - 1
+    ns0 = len(first)
+    col_to_sn0 = np.repeat(np.arange(ns0), last - first + 1)
+
+    # ---- bottom-up structures + zero-fill chain merging --------------------
+    rows_of: list = [None] * ns0
+    kids: list[list[int]] = [[] for _ in range(ns0)]
+    alive = np.ones(ns0, dtype=bool)
+    by_last = {int(l): s for s, l in enumerate(last)}   # live supernode by last col
+
+    for s in range(ns0):
+        l = int(last[s])
+        pieces = [np.empty(0, dtype=np.int64)]
+        for j in range(int(first[s]), l + 1):
+            rj = indices[indptr[j]:indptr[j + 1]]
+            pieces.append(rj[rj > l].astype(np.int64))
+        for g in kids[s]:
+            rg = rows_of[g]
+            pieces.append(rg[rg > l])
+        rows = np.unique(np.concatenate(pieces))
+        rows_of[s] = rows
+        # chain-merge the supernode ending just before first[s] while the
+        # merge adds no fill: rows(c) ≡ cols(s) ∪ rows(s), contiguous cols
+        while True:
+            c = by_last.get(int(first[s]) - 1)
+            if c is None or not alive[c]:
+                break
+            if int(last[s]) - int(first[c]) + 1 > max_supernode:
+                break
+            rc = rows_of[c]
+            if (len(rc) == 0 or rc[0] != first[s]
+                    or len(rc) != (last[s] - first[s] + 1) + len(rows)):
+                break
+            del by_last[int(last[c])]
+            alive[c] = False
+            first[s] = first[c]
+        if len(rows):
+            kids[int(col_to_sn0[rows[0]])].append(s)
+
+    # ---- compact to live supernodes ----------------------------------------
+    live = np.flatnonzero(alive)
+    ns = len(live)
+    sn_start = np.concatenate([first[live], [n]]).astype(np.int64)
+    assert np.all(np.diff(sn_start) > 0)
+    col_to_sn = np.repeat(np.arange(ns), np.diff(sn_start))
+    sn_rows = [rows_of[s] for s in live]
+    sn_parent = np.full(ns, -1, dtype=np.int64)
+    for s in range(ns):
+        if len(sn_rows[s]):
+            sn_parent[s] = col_to_sn[sn_rows[s][0]]
+        assert sn_parent[s] > s or sn_parent[s] == -1
+
+    # ---- levels over the supernode tree (the batch schedule) ---------------
+    sn_level = np.zeros(ns, dtype=np.int64)
+    for s in range(ns):
+        p = sn_parent[s]
+        if p >= 0:
+            sn_level[p] = max(sn_level[p], sn_level[s] + 1)
+
+    widths = np.diff(sn_start)
+    us = np.array([len(r) for r in sn_rows], dtype=np.int64)
+    nnz_tri = int(np.sum(widths * (widths + 1) // 2))
+    nnz_rect = int(np.sum(widths * us))
+    w = widths.astype(float)
+    u = us.astype(float)
+    flops = float(np.sum(2.0 / 3.0 * w ** 3 + 2.0 * w ** 2 * u + 2.0 * w * u ** 2))
+    return SymbolicFact(
+        n=n, perm=perm, parent=parent, sn_start=sn_start, col_to_sn=col_to_sn,
+        sn_rows=sn_rows, sn_parent=sn_parent, sn_level=sn_level,
+        nnz_L=nnz_tri + nnz_rect, nnz_U=nnz_tri + nnz_rect, flops=flops)

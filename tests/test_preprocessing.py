@@ -1,0 +1,73 @@
+import itertools
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.models.gallery import random_sparse
+from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
+from superlu_dist_tpu.rowperm.matching import maximum_product_matching
+from superlu_dist_tpu.sparse.formats import coo_to_csr
+
+
+def test_gsequ_scaling_makes_unit_maxima():
+    rng = np.random.default_rng(0)
+    a = random_sparse(30, density=0.1, seed=1)
+    # make badly scaled
+    a = a.row_scale(10.0 ** rng.integers(-8, 8, 30)).col_scale(
+        10.0 ** rng.integers(-8, 8, 30))
+    r, c, rowcnd, colcnd, amax = gsequ(a)
+    scaled, equed = laqgs(a, r, c, rowcnd, colcnd, amax)
+    assert equed == "B"
+    d = np.abs(scaled.to_dense())
+    np.testing.assert_allclose(d.max(axis=1), 1.0, rtol=1e-12)  # row maxes
+    assert d.max() <= 1.0 + 1e-12
+
+
+def test_laqgs_no_scaling_when_well_conditioned():
+    a = random_sparse(20, density=0.2, seed=2)
+    r, c, rowcnd, colcnd, amax = gsequ(a)
+    _, equed = laqgs(a, r, c, rowcnd, colcnd, amax)
+    assert equed == "N"
+
+
+def _brute_force_best_product(d):
+    n = d.shape[0]
+    best = -1.0
+    for p in itertools.permutations(range(n)):
+        prod = np.prod([np.abs(d[p[j], j]) for j in range(n)])
+        best = max(best, prod)
+    return best
+
+
+@pytest.mark.parametrize("seed", range(5))
+def test_matching_is_max_product(seed):
+    n = 6
+    rng = np.random.default_rng(seed)
+    # dense-ish random with some zeros
+    d = rng.standard_normal((n, n)) * (rng.random((n, n)) > 0.3)
+    d += np.diag(rng.standard_normal(n) * 0.01 + 0.02)  # keep nonsingular
+    rows, cols = np.nonzero(d)
+    a = coo_to_csr(n, n, rows, cols, d[rows, cols])
+    order, r, c = maximum_product_matching(a)
+    assert sorted(order) == list(range(n))
+    got = np.prod([np.abs(d[order[j], j]) for j in range(n)])
+    want = _brute_force_best_product(d)
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+
+
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_matching_scalings(dtype):
+    a = random_sparse(40, density=0.08, seed=3, dtype=dtype)
+    order, r, c = maximum_product_matching(a)
+    b = a.row_scale(r).col_scale(c).permute(perm_r=order)
+    d = np.abs(b.to_dense())
+    np.testing.assert_allclose(np.diag(d), 1.0, rtol=1e-10)   # matched = ±1
+    assert d.max() <= 1.0 + 1e-10                             # all <= 1
+
+
+def test_matching_detects_structural_singularity():
+    n = 4
+    rows = np.array([0, 1, 2, 3, 0])
+    cols = np.array([0, 0, 0, 0, 1])   # columns 2,3 empty
+    with pytest.raises(Exception):
+        maximum_product_matching(coo_to_csr(n, n, rows, cols, np.ones(5)))
