@@ -1,0 +1,1 @@
+from superlu_dist_tpu.ops.dense import make_front_kernel, lu_nopivot

@@ -1,0 +1,111 @@
+"""Dense supernodal kernels — the TPU offload boundary.
+
+This layer replaces the reference's BLAS seam (CBLAS fallback / vendor BLAS
+/ cuBLAS, SURVEY.md L1): the panel factorization dger/dtrsm loop
+(pdgstrf2_trsm, SRC/pdgstrf2.c:140-318), the U-row triangular solves
+(pdgstrs2_omp, :771), and the Schur-complement GEMM
+(dSchCompUdt-2Ddynamic.c:566) all become one *batched partial factorization
+of padded dense fronts*, vmapped over a level's worth of supernodes and
+compiled by XLA onto the MXU.
+
+Everything is static-shape: fronts are padded to bucket sizes (M total, W
+pivot columns), with identity columns in the pivot-block padding so the
+unpivoted LU passes through them untouched.  Tiny pivots are replaced by
+±sqrt(eps)·‖A‖ exactly like the reference's GESP (pdgstrf2.c:218-232,
+option ReplaceTinyPivot), and counted.
+
+Layout of a factored front F (M×M, pivot width W, real sizes w ≤ W,
+u ≤ M−W):
+    F[:W, :W]   packed LU of the diagonal block (unit-lower L11 + U11)
+    F[W:, :W]   L21 = A21·U11⁻¹   (real data in rows W..W+u)
+    F[:W, W:]   U12 = L11⁻¹·A12
+    F[W:, W:]   Schur complement S = A22 − L21·U12 (scattered to the pool)
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.scipy.linalg import solve_triangular
+
+_UNROLL = 16   # panel width factored by the unrolled column loop
+
+
+def _fix_pivot(piv, thresh):
+    """GESP tiny-pivot replacement: piv -> phase(piv)·thresh if |piv|<thresh."""
+    ap = jnp.abs(piv)
+    safe = jnp.where(ap == 0, jnp.ones_like(ap), ap)
+    unit = jnp.where(ap == 0, jnp.ones_like(piv), piv / safe.astype(piv.dtype))
+    tiny = ap < thresh
+    return jnp.where(tiny, unit * thresh.astype(piv.dtype), piv), tiny.astype(jnp.int32)
+
+
+def _lu_unrolled(a, thresh):
+    """Unpivoted LU of a small block, columns unrolled (static indices)."""
+    k = a.shape[0]
+    count = jnp.zeros((), jnp.int32)
+    for i in range(k):
+        piv, tiny = _fix_pivot(a[i, i], thresh)
+        count = count + tiny
+        a = a.at[i, i].set(piv)
+        if i + 1 < k:
+            col = a[i + 1:, i] / piv
+            a = a.at[i + 1:, i].set(col)
+            a = a.at[i + 1:, i + 1:].add(
+                -col[:, None] * a[i, i + 1:][None, :])
+    return a, count
+
+
+def lu_nopivot(a, thresh):
+    """Blocked-recursive unpivoted LU with tiny-pivot replacement.
+
+    Static shapes throughout; the trailing update is a single GEMM per
+    recursion level, which is where XLA maps onto the MXU.
+    """
+    n = a.shape[0]
+    if n <= _UNROLL:
+        return _lu_unrolled(a, thresh)
+    h = max(_UNROLL, (n // 2 + _UNROLL - 1) // _UNROLL * _UNROLL)
+    h = min(h, n - 1)
+    a11, a12 = a[:h, :h], a[:h, h:]
+    a21, a22 = a[h:, :h], a[h:, h:]
+    f11, c1 = lu_nopivot(a11, thresh)
+    u12 = solve_triangular(f11, a12, lower=True, unit_diagonal=True)
+    l21 = solve_triangular(f11, a21.T, trans=1, lower=False).T
+    s = a22 - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    f22, c2 = lu_nopivot(s, thresh)
+    top = jnp.concatenate([f11, u12], axis=1)
+    bot = jnp.concatenate([l21, f22], axis=1)
+    return jnp.concatenate([top, bot], axis=0), c1 + c2
+
+
+def _partial_front_factor(f, thresh, w):
+    """Factor the leading w columns of one front; see module docstring."""
+    m = f.shape[0]
+    f11, count = lu_nopivot(f[:w, :w], thresh)
+    if w == m:
+        return f11, count
+    u12 = solve_triangular(f11, f[:w, w:], lower=True, unit_diagonal=True)
+    l21 = solve_triangular(f11, f[w:, :w].T, trans=1, lower=False).T
+    s = f[w:, w:] - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    top = jnp.concatenate([f11, u12], axis=1)
+    bot = jnp.concatenate([l21, s], axis=1)
+    return jnp.concatenate([top, bot], axis=0), count
+
+
+@functools.lru_cache(maxsize=None)
+def make_front_kernel(m: int, w: int, dtype: str):
+    """Jitted batched front factorization for bucket shape (M=m, W=w).
+
+    Returns fn(F: (B, m, m), thresh) -> (F_packed: (B, m, m), tiny: int32).
+    Cached per (m, w, dtype); batch size participates in jit's own cache.
+    """
+
+    def kernel(fronts, thresh):
+        outs, counts = jax.vmap(lambda f: _partial_front_factor(f, thresh, w))(fronts)
+        return outs, jnp.sum(counts)
+
+    return jax.jit(kernel)

@@ -5,15 +5,21 @@ sharding is exercised without TPU hardware (the driver separately dry-runs
 the multi-chip path), and with x64 enabled so the f64/c128 reference paths
 are exact.  Mirrors the reference's strategy of oversubscribing MPI ranks on
 one box (SURVEY.md §4, .travis_tests.sh).
+
+Note: the session environment pins JAX_PLATFORMS to the remote TPU (axon)
+and its sitecustomize imports jax at interpreter start, so env vars are
+already snapshotted — jax.config.update is the only override that works
+here.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"   # for any subprocesses
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,79 @@
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from superlu_dist_tpu.ops.dense import lu_nopivot, make_front_kernel
+
+
+def np_lu_nopiv(a):
+    a = a.copy()
+    n = a.shape[0]
+    for i in range(n):
+        a[i + 1:, i] /= a[i, i]
+        a[i + 1:, i + 1:] -= np.outer(a[i + 1:, i], a[i, i + 1:])
+    return a
+
+
+@pytest.mark.parametrize("n", [1, 3, 16, 17, 40, 96])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_lu_nopivot_matches_numpy(n, dtype):
+    rng = np.random.default_rng(n)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        a = a + 1j * rng.standard_normal((n, n))
+    a += np.eye(n) * (2 * n)      # diagonally dominant: no tiny pivots
+    got, count = lu_nopivot(jnp.asarray(a), jnp.asarray(1e-300))
+    want = np_lu_nopiv(a.copy())
+    np.testing.assert_allclose(np.asarray(got), want, rtol=1e-10, atol=1e-10)
+    assert int(count) == 0
+
+
+def test_tiny_pivot_replacement():
+    a = np.array([[1.0, 1.0], [1.0, 1.0]])   # second pivot exactly 0
+    out, count = lu_nopivot(jnp.asarray(a), jnp.asarray(1e-8))
+    assert int(count) == 1
+    assert abs(np.asarray(out)[1, 1]) == pytest.approx(1e-8)
+
+
+@pytest.mark.parametrize("m,w,u_real,w_real", [(24, 8, 16, 8), (32, 16, 10, 13)])
+def test_partial_front_factor(m, w, u_real, w_real):
+    rng = np.random.default_rng(0)
+    B = 3
+    fronts = np.zeros((B, m, m))
+    for b in range(B):
+        f = np.zeros((m, m))
+        # real data: pivot block w_real, rows u_real; identity padding in
+        # pivot cols [w_real, w)
+        blk = rng.standard_normal((w_real + u_real, w_real + u_real))
+        blk += np.eye(w_real + u_real) * 2 * (w_real + u_real)
+        f[:w_real, :w_real] = blk[:w_real, :w_real]
+        f[w:w + u_real, :w_real] = blk[w_real:, :w_real]
+        f[:w_real, w:w + u_real] = blk[:w_real, w_real:]
+        f[w:w + u_real, w:w + u_real] = blk[w_real:, w_real:]
+        for k in range(w_real, w):
+            f[k, k] = 1.0
+        fronts[b] = f
+    kern = make_front_kernel(m, w, "float64")
+    out, tiny = kern(jnp.asarray(fronts), jnp.asarray(1e-300))
+    out = np.asarray(out)
+    assert int(tiny) == 0
+    for b in range(B):
+        f = fronts[b]
+        # reconstruct: dense partial LU on the real (w_real+u_real) block
+        blk = np.zeros((w_real + u_real, w_real + u_real))
+        blk[:w_real, :w_real] = f[:w_real, :w_real]
+        blk[w_real:, :w_real] = f[w:w + u_real, :w_real]
+        blk[:w_real, w_real:] = f[:w_real, w:w + u_real]
+        blk[w_real:, w_real:] = f[w:w + u_real, w:w + u_real]
+        ref = blk.copy()
+        for i in range(w_real):
+            ref[i + 1:, i] /= ref[i, i]
+            ref[i + 1:, i + 1:] -= np.outer(ref[i + 1:, i], ref[i, i + 1:])
+        np.testing.assert_allclose(out[b][:w_real, :w_real], ref[:w_real, :w_real],
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(out[b][w:w + u_real, :w_real], ref[w_real:, :w_real],
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(out[b][:w_real, w:w + u_real], ref[:w_real, w_real:],
+                                   rtol=1e-10, atol=1e-10)
+        np.testing.assert_allclose(out[b][w:w + u_real, w:w + u_real],
+                                   ref[w_real:, w_real:], rtol=1e-10, atol=1e-10)
