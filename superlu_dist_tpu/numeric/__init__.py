@@ -1,0 +1,2 @@
+from superlu_dist_tpu.numeric.plan import FactorPlan, build_plan
+from superlu_dist_tpu.numeric.factor import numeric_factorize, NumericFactorization

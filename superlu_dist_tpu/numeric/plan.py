@@ -1,0 +1,232 @@
+"""Factorization plan: mapping supernodes onto level-batched padded fronts.
+
+This is the TPU-native analog of the reference's *distribution* phase
+(pddistribute, SRC/pddistribute.c:322): where the reference builds
+dLocalLU_t index structures plus MPI send/recv schedules, we precompute —
+entirely on the host, once per sparsity pattern — the flat gather/scatter
+index maps that let the whole numeric factorization run as a short sequence
+of XLA ops per (level, bucket) group:
+
+  assemble:   F[slot, pos] += A_vals[a_src]          (original entries)
+              F[slot, pos] += pool[e_src]            (children's Schur pieces,
+                                                      the extend-add /
+                                                      dscatter.c:111 analog)
+  factor:     batched partial LU (ops.dense)         (the pdgstrf hot loop)
+  write-back: pool[s_dst] = F[slot, s_src]           (Schur to update pool)
+
+Fronts are square (symmetrized pattern): index set = supernode columns +
+below-diagonal rows, padded to bucket sizes (W for the pivot block, M
+total) so every group is one static-shape vmapped kernel.  The reference's
+GEMM aggregation-and-padding trick (dSchCompUdt-2Ddynamic.c:212-237) is the
+same idea at single-GEMM granularity; here it covers the entire level.
+
+Like the reference's SamePattern path, a plan is reusable across numeric
+refactorizations with the same sparsity pattern.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from superlu_dist_tpu.sparse.formats import SparseCSR
+from superlu_dist_tpu.symbolic.symbfact import SymbolicFact
+
+
+@dataclasses.dataclass
+class Group:
+    """One (level, bucket) batch of fronts."""
+
+    level: int
+    m: int                  # padded front size
+    w: int                  # padded pivot width
+    batch: int              # number of real fronts
+    sns: np.ndarray         # supernode ids, slot order
+    # assembly of original matrix entries
+    a_slot: np.ndarray
+    a_flat: np.ndarray
+    a_src: np.ndarray
+    # identity padding for unused pivot columns
+    pad_slot: np.ndarray
+    pad_flat: np.ndarray
+    # extend-add gathers from the update pool
+    e_slot: np.ndarray
+    e_flat: np.ndarray
+    e_src: np.ndarray
+    # Schur write-back into the update pool
+    s_slot: np.ndarray
+    s_src_flat: np.ndarray
+    s_dst: np.ndarray
+
+
+@dataclasses.dataclass
+class FactorPlan:
+    n: int
+    sf: SymbolicFact
+    pattern_indptr: np.ndarray     # permuted symmetrized pattern (CSR)
+    pattern_indices: np.ndarray
+    groups: list                   # Groups in level-ascending order
+    pool_size: int
+    sn_group: np.ndarray           # (ns,) group index of each supernode
+    sn_slot: np.ndarray            # (ns,) slot within its group
+    flops: float
+    front_bytes: int               # total padded front storage (per dtype unit)
+
+    @property
+    def n_levels(self) -> int:
+        return int(self.sf.sn_level.max()) + 1 if len(self.sf.sn_level) else 0
+
+
+def _bucket_sizes(max_needed: int, min_bucket: int, growth: float):
+    sizes = []
+    s = min_bucket
+    while s < max_needed:
+        sizes.append(s)
+        s = max(s + 8, int(np.ceil(s * growth / 8.0) * 8))
+    sizes.append(int(np.ceil(max_needed / 8.0) * 8) if max_needed > min_bucket
+                 else min_bucket)
+    return np.unique(np.array(sizes, dtype=np.int64))
+
+
+def _round_to_bucket(x: int, sizes: np.ndarray) -> int:
+    return int(sizes[np.searchsorted(sizes, max(x, 1))])
+
+
+def build_plan(sf: SymbolicFact, min_bucket: int = 8,
+               growth: float = 1.5) -> FactorPlan:
+    """Precompute all index maps.  Pure numpy; cost is O(nnz(L) + pool)."""
+    n = sf.n
+    ns = sf.n_supernodes
+    indptr, indices = sf.pattern_indptr, sf.pattern_indices
+
+    widths = np.diff(sf.sn_start).astype(np.int64)
+    us = np.array([len(r) for r in sf.sn_rows], dtype=np.int64)
+
+    w_sizes = _bucket_sizes(int(widths.max(initial=1)), min_bucket, growth)
+    u_sizes = _bucket_sizes(int(us.max(initial=1)), min_bucket, growth)
+
+    sn_W = np.array([_round_to_bucket(int(w), w_sizes) for w in widths])
+    sn_U = np.array([0 if u == 0 else _round_to_bucket(int(u), u_sizes)
+                     for u in us])
+    sn_M = sn_W + sn_U
+
+    # pool offsets (real u^2 strides, not padded)
+    off = np.zeros(ns + 1, dtype=np.int64)
+    np.cumsum(us * us, out=off[1:])
+    pool_size = int(off[-1])
+
+    # group supernodes by (level, W, U)
+    key_order = np.lexsort((sn_U, sn_W, sf.sn_level))
+    groups: list[Group] = []
+    sn_group = np.empty(ns, dtype=np.int64)
+    sn_slot = np.empty(ns, dtype=np.int64)
+    i = 0
+    while i < ns:
+        s0 = key_order[i]
+        lvl, W, U = int(sf.sn_level[s0]), int(sn_W[s0]), int(sn_U[s0])
+        j = i
+        members = []
+        while (j < ns and sf.sn_level[key_order[j]] == lvl
+               and sn_W[key_order[j]] == W and sn_U[key_order[j]] == U):
+            members.append(key_order[j])
+            j += 1
+        sns = np.array(members, dtype=np.int64)
+        for slot, s in enumerate(sns):
+            sn_group[s] = len(groups)
+            sn_slot[s] = slot
+        groups.append(Group(level=lvl, m=W + U, w=W, batch=len(sns), sns=sns,
+                            a_slot=None, a_flat=None, a_src=None,
+                            pad_slot=None, pad_flat=None,
+                            e_slot=None, e_flat=None, e_src=None,
+                            s_slot=None, s_src_flat=None, s_dst=None))
+        i = j
+
+    # position helper: global index x within front of supernode s
+    first = sf.sn_start[:-1]
+    last = sf.sn_start[1:] - 1
+
+    def positions(s: int, xs: np.ndarray) -> np.ndarray:
+        inpiv = xs <= last[s]
+        pos = np.where(inpiv, xs - first[s], 0)
+        below = ~inpiv
+        if below.any():
+            pos_below = np.searchsorted(sf.sn_rows[s], xs[below])
+            pos = pos.copy()
+            pos[below] = sn_W[s] + pos_below
+        return pos
+
+    # --- A-entry assembly maps -------------------------------------------
+    rows_all = np.repeat(np.arange(n), np.diff(indptr)).astype(np.int64)
+    cols_all = indices.astype(np.int64)
+    owner = sf.col_to_sn[np.minimum(rows_all, cols_all)]
+    order_by_owner = np.argsort(owner, kind="stable")
+    bounds = np.searchsorted(owner[order_by_owner], np.arange(ns + 1))
+    ga_slot = [[] for _ in groups]
+    ga_flat = [[] for _ in groups]
+    ga_src = [[] for _ in groups]
+    for s in range(ns):
+        sel = order_by_owner[bounds[s]:bounds[s + 1]]
+        if len(sel) == 0:
+            continue
+        pi = positions(s, rows_all[sel])
+        pj = positions(s, cols_all[sel])
+        g = sn_group[s]
+        M = groups[g].m
+        ga_slot[g].append(np.full(len(sel), sn_slot[s], dtype=np.int64))
+        ga_flat[g].append(pi * M + pj)
+        ga_src[g].append(sel)
+
+    # --- identity padding + extend-add + write-back maps ------------------
+    ge_slot = [[] for _ in groups]
+    ge_flat = [[] for _ in groups]
+    ge_src = [[] for _ in groups]
+    gs_slot = [[] for _ in groups]
+    gs_srcf = [[] for _ in groups]
+    gs_dst = [[] for _ in groups]
+    gp_slot = [[] for _ in groups]
+    gp_flat = [[] for _ in groups]
+    for s in range(ns):
+        g = sn_group[s]
+        grp = groups[g]
+        M, W = grp.m, grp.w
+        w, u = int(widths[s]), int(us[s])
+        slot = sn_slot[s]
+        if w < W:
+            ks = np.arange(w, W, dtype=np.int64)
+            gp_slot[g].append(np.full(len(ks), slot, dtype=np.int64))
+            gp_flat[g].append(ks * M + ks)
+        if u > 0:
+            # write-back of the real u×u Schur block into the pool
+            kk = np.arange(u, dtype=np.int64)
+            src = ((W + kk)[:, None] * M + (W + kk)[None, :]).ravel()
+            gs_slot[g].append(np.full(u * u, slot, dtype=np.int64))
+            gs_srcf[g].append(src)
+            gs_dst[g].append(off[s] + np.arange(u * u, dtype=np.int64))
+            # extend-add into the parent front
+            p = int(sf.sn_parent[s])
+            assert p >= 0
+            gp_ = sn_group[p]
+            pgrp = groups[gp_]
+            posp = positions(p, sf.sn_rows[s])
+            eflat = (posp[:, None] * pgrp.m + posp[None, :]).ravel()
+            ge_slot[gp_].append(np.full(u * u, sn_slot[p], dtype=np.int64))
+            ge_flat[gp_].append(eflat)
+            ge_src[gp_].append(off[s] + np.arange(u * u, dtype=np.int64))
+
+    def cat(lst, dtype=np.int64):
+        return (np.concatenate(lst).astype(dtype) if lst
+                else np.empty(0, dtype=dtype))
+
+    front_bytes = 0
+    for g, grp in enumerate(groups):
+        grp.a_slot, grp.a_flat, grp.a_src = cat(ga_slot[g]), cat(ga_flat[g]), cat(ga_src[g])
+        grp.pad_slot, grp.pad_flat = cat(gp_slot[g]), cat(gp_flat[g])
+        grp.e_slot, grp.e_flat, grp.e_src = cat(ge_slot[g]), cat(ge_flat[g]), cat(ge_src[g])
+        grp.s_slot, grp.s_src_flat, grp.s_dst = cat(gs_slot[g]), cat(gs_srcf[g]), cat(gs_dst[g])
+        front_bytes += grp.batch * grp.m * grp.m
+
+    return FactorPlan(n=n, sf=sf, pattern_indptr=indptr,
+                      pattern_indices=indices, groups=groups,
+                      pool_size=pool_size, sn_group=sn_group, sn_slot=sn_slot,
+                      flops=sf.flops, front_bytes=front_bytes)

@@ -1,0 +1,1 @@
+from superlu_dist_tpu.solve.trisolve import lu_solve

@@ -1,0 +1,73 @@
+"""Supernodal triangular solves (host path).
+
+Capability analog of pdgstrs (SRC/pdgstrs.c:838) + the lsum kernels
+(SRC/pdgstrs_lsum.c): forward solve L·y = d level-by-level up the supernode
+tree, backward solve U·x = y back down.  The reference's distributed solve
+is an MPI event loop over per-supernode broadcast/reduce trees; the tree
+dependencies here are the same supernode levels the factorization batches
+on, so the host loop visits supernodes in level order — and a device-side
+batched version (large nrhs) can reuse the same plan (future work, mirrors
+the reference offloading Linv GEMMs only when nrhs is large, SURVEY.md §7
+hard-part 5).
+
+Solves run in float64 on the host regardless of factor dtype: factors are
+promoted on pull, which costs nothing extra at solve time and keeps
+iterative refinement's correction solves stable.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from superlu_dist_tpu.numeric.factor import NumericFactorization
+
+
+def lu_solve(fact: NumericFactorization, rhs: np.ndarray) -> np.ndarray:
+    """Solve (L·U) x = rhs for rhs (n,) or (n, k), in the factor's permuted
+    labeling."""
+    plan = fact.plan
+    sf = plan.sf
+    hosts = fact.pull_to_host()
+    y = np.array(rhs, dtype=np.promote_types(np.asarray(rhs).dtype,
+                                             np.float64 if not np.issubdtype(
+                                                 fact.dtype, np.complexfloating)
+                                             else np.complex128))
+    squeeze = y.ndim == 1
+    if squeeze:
+        y = y[:, None]
+    ns = sf.n_supernodes
+    first = sf.sn_start[:-1]
+    last = sf.sn_start[1:] - 1
+
+    def blocks(s):
+        grp = plan.groups[plan.sn_group[s]]
+        f = hosts[plan.sn_group[s]][plan.sn_slot[s]]
+        w = int(last[s] - first[s] + 1)
+        u = len(sf.sn_rows[s])
+        W = grp.w
+        f11 = f[:w, :w]
+        l21 = f[W:W + u, :w]
+        u12 = f[:w, W:W + u]
+        return f11, l21, u12, w, u
+
+    # forward: supernodes in column order = topological (children first)
+    for s in range(ns):
+        f11, l21, u12, w, u = blocks(s)
+        cols = slice(int(first[s]), int(last[s]) + 1)
+        l11 = np.tril(f11, -1) + np.eye(w, dtype=f11.dtype)
+        yj = np.linalg.solve(l11, y[cols])
+        y[cols] = yj
+        if u:
+            y[sf.sn_rows[s]] -= l21.astype(yj.dtype) @ yj
+
+    # backward: reverse order (parents before children)
+    for s in range(ns - 1, -1, -1):
+        f11, l21, u12, w, u = blocks(s)
+        cols = slice(int(first[s]), int(last[s]) + 1)
+        t = y[cols]
+        if u:
+            t = t - u12.astype(t.dtype) @ y[sf.sn_rows[s]]
+        u11 = np.triu(f11)
+        y[cols] = np.linalg.solve(u11, t)
+
+    return y[:, 0] if squeeze else y

@@ -49,6 +49,9 @@ class SymbolicFact:
     nnz_L: int                # including the dense diagonal-block lower triangle
     nnz_U: int
     flops: float              # factorization flop estimate
+    pattern_indptr: np.ndarray = None    # symmetrized pattern permuted by
+    pattern_indices: np.ndarray = None   # `perm` (CSR); value alignment is
+                                         # reproduced by permuting with `perm`
 
     @property
     def n_supernodes(self) -> int:
@@ -172,4 +175,5 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
     return SymbolicFact(
         n=n, perm=perm, parent=parent, sn_start=sn_start, col_to_sn=col_to_sn,
         sn_rows=sn_rows, sn_parent=sn_parent, sn_level=sn_level,
-        nnz_L=nnz_tri + nnz_rect, nnz_U=nnz_tri + nnz_rect, flops=flops)
+        nnz_L=nnz_tri + nnz_rect, nnz_U=nnz_tri + nnz_rect, flops=flops,
+        pattern_indptr=indptr, pattern_indices=indices)
