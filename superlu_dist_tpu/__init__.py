@@ -40,8 +40,9 @@ from superlu_dist_tpu.sparse.formats import SparseCSR, SparseCSC
 def __getattr__(name):
     # lazy: the driver pulls in jax; keep light imports (io, formats) fast
     if name in ("gssvx", "LUFactorization"):
-        from superlu_dist_tpu.drivers import gssvx as _g
-        return getattr(_g, name)
+        import importlib
+        mod = importlib.import_module("superlu_dist_tpu.drivers.gssvx")
+        return getattr(mod, name)
     raise AttributeError(name)
 
 __version__ = "0.1.0"

@@ -1,0 +1,1 @@
+from superlu_dist_tpu.drivers.gssvx import gssvx, LUFactorization

@@ -1,0 +1,223 @@
+"""Expert driver: the full solve pipeline with factorization-reuse tiers.
+
+Analog of pdgssvx (SRC/pdgssvx.c:505): equilibrate → row-permute (maximum
+product matching with scalings) → column-order → symbolic → plan ("distribute")
+→ numeric factor → solve → iterative refinement, with the reference's Fact
+reuse modes (superlu_defs.h:489-510):
+
+  DOFACT                  — everything from scratch
+  SamePattern             — reuse column order + symbolic + plan
+  SamePattern_SameRowPerm — additionally reuse scalings + row permutation,
+                            only redo the numeric factorization
+  FACTORED                — reuse the numeric factors; solve + refine only
+
+Permutation algebra (careful!): with equilibration scalings Dr, Dc, matching
+scalings r1, c1 and row order ρ, the factored matrix is
+    M = Pπ · (diag(R) A diag(C))[ρ] · Pπᵀ,  R = r1·dr, C = dc·c1
+where π is the fill-reducing + postorder column permutation.  Then
+A·x = b is solved as
+    d = (R ⊙ b)[ρ][π] ;  M·ẑ = d ;  z[π] = ẑ ;  x = C ⊙ z.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+from superlu_dist_tpu.sparse.formats import SparseCSR, symmetrize_pattern
+from superlu_dist_tpu.utils.options import (
+    Options, Fact, RowPerm, IterRefine, default_factor_dtype)
+from superlu_dist_tpu.utils.stats import Stats
+from superlu_dist_tpu.utils.errors import SuperLUError
+from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
+from superlu_dist_tpu.rowperm.matching import maximum_product_matching
+from superlu_dist_tpu.ordering.dispatch import get_perm_c
+from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize, SymbolicFact
+from superlu_dist_tpu.numeric.plan import build_plan, FactorPlan
+from superlu_dist_tpu.numeric.factor import numeric_factorize, NumericFactorization
+from superlu_dist_tpu.solve.trisolve import lu_solve
+from superlu_dist_tpu.refine.ir import iterative_refinement
+
+
+@dataclasses.dataclass
+class LUFactorization:
+    """Persistent factorization handle — the {ScalePermstruct, LUstruct,
+    SOLVEstruct} bundle of the reference API (superlu_ddefs.h:76-82,186-228)."""
+
+    n: int
+    options: Options
+    equed: str
+    dr: np.ndarray            # equilibration row scaling (or ones)
+    dc: np.ndarray
+    r1: np.ndarray            # matching scalings (or ones)
+    c1: np.ndarray
+    row_order: np.ndarray     # ρ: position j <- original row ρ[j]
+    col_order: np.ndarray     # fill-reducing order fed to symbolic
+    sf: SymbolicFact = None
+    plan: FactorPlan = None
+    numeric: NumericFactorization = None
+    anorm: float = 0.0
+    a: SparseCSR = None       # original matrix (for refinement SpMV)
+    berrs: list = None        # backward errors of the last refinement
+
+    # -- combined transforms --------------------------------------------------
+    @property
+    def R(self):
+        return self.r1 * self.dr
+
+    @property
+    def C(self):
+        return self.dc * self.c1
+
+    @property
+    def sigma(self):
+        """Composite row order: M rows <- original rows sigma[k]."""
+        return self.row_order[self.sf.perm]
+
+    def solve_factored(self, b: np.ndarray) -> np.ndarray:
+        """Solve A·x = b through the factored M (no refinement)."""
+        b = np.asarray(b)
+        d = b * (self.R[:, None] if b.ndim > 1 else self.R)
+        d = d[self.sigma]
+        z_hat = lu_solve(self.numeric, d)
+        z = np.empty_like(z_hat)
+        z[self.sf.perm] = z_hat
+        return z * (self.C[:, None] if b.ndim > 1 else self.C)
+
+
+def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
+          lu: LUFactorization | None = None, stats: Stats | None = None):
+    """Solve A·X = B.  Returns (x, lu, stats, info).
+
+    info = 0 on success; > 0 mirrors the reference's singularity reporting
+    via tiny-pivot counts in stats (with ReplaceTinyPivot the factorization
+    always completes, pdgstrf2.c:218-232).
+    """
+    if stats is None:
+        stats = Stats()
+    n = a.n_rows
+    if a.n_cols != n:
+        raise SuperLUError("A must be square")
+    b = np.asarray(b)
+    if b.shape[0] != n:
+        raise SuperLUError("B leading dimension must match A")
+    fact = options.fact
+
+    if fact == Fact.FACTORED:
+        if lu is None or lu.numeric is None:
+            raise SuperLUError("Fact=FACTORED requires a prior factorization")
+        return _solve_and_refine(options, a, b, lu, stats)
+
+    reuse_rowperm = fact == Fact.SamePattern_SameRowPerm and lu is not None
+    reuse_colperm = fact in (Fact.SamePattern, Fact.SamePattern_SameRowPerm) \
+        and lu is not None
+    # our symbolic runs on the row-permuted pattern, so the symbolic/plan can
+    # only be reused when the row permutation is reused too (the reference's
+    # SamePattern_SameRowPerm tier; plain SamePattern reuses the column order)
+    reuse_symbolic = reuse_rowperm
+
+    # ---- EQUIL (pdgssvx.c:647-760) -----------------------------------------
+    with stats.timer("EQUIL"):
+        if reuse_rowperm:
+            dr, dc, equed = lu.dr, lu.dc, lu.equed
+            a1 = a.row_scale(dr).col_scale(dc) if equed != "N" else a
+        elif options.equil:
+            r, c, rowcnd, colcnd, amax = gsequ(a)
+            a1, equed = laqgs(a, r, c, rowcnd, colcnd, amax)
+            dr = r if equed in ("R", "B") else np.ones(n)
+            dc = c if equed in ("C", "B") else np.ones(n)
+        else:
+            a1, equed = a, "N"
+            dr = dc = np.ones(n)
+
+    # ---- ROWPERM (pdgssvx.c:793-937) ---------------------------------------
+    with stats.timer("ROWPERM"):
+        if reuse_rowperm:
+            row_order, r1, c1 = lu.row_order, lu.r1, lu.c1
+            a2 = a1.row_scale(r1).col_scale(c1).permute(perm_r=row_order)
+        elif options.row_perm == RowPerm.LargeDiag_MC64:
+            row_order, r1, c1 = maximum_product_matching(a1)
+            a2 = a1.row_scale(r1).col_scale(c1).permute(perm_r=row_order)
+        elif options.row_perm == RowPerm.MY_PERMR:
+            row_order = np.asarray(options.user_perm_r, dtype=np.int64)
+            r1 = c1 = np.ones(n)
+            a2 = a1.permute(perm_r=row_order)
+        else:
+            row_order = np.arange(n, dtype=np.int64)
+            r1 = c1 = np.ones(n)
+            a2 = a1
+
+    anorm = a2.norm_max()
+    sym = symmetrize_pattern(a2)
+
+    # ---- COLPERM (pdgssvx.c:958-1031) --------------------------------------
+    with stats.timer("COLPERM"):
+        if reuse_colperm:
+            col_order = lu.col_order
+        else:
+            col_order = get_perm_c(options, a2, sym)
+
+    # ---- SYMBFACT (pdgssvx.c:1034-1118) ------------------------------------
+    with stats.timer("SYMBFACT"):
+        if reuse_symbolic:
+            sf = lu.sf
+        else:
+            sf = symbolic_factorize(sym, col_order, relax=options.relax,
+                                    max_supernode=options.max_supernode)
+
+    # ---- DIST / plan (pdgssvx.c:1132-1166) ---------------------------------
+    with stats.timer("DIST"):
+        if reuse_symbolic:
+            plan = lu.plan
+        else:
+            plan = build_plan(sf, min_bucket=options.min_bucket,
+                              growth=options.bucket_growth)
+        if sym.nnz != len(sf.value_perm):
+            raise SuperLUError(
+                f"Fact={fact.name} reuse requires the same sparsity pattern: "
+                f"matrix has {sym.nnz} symmetrized entries, factorization "
+                f"expects {len(sf.value_perm)}")
+        bvals = sym.data[sf.value_perm]
+
+    # ---- FACT (pdgssvx.c:1176 → pdgstrf) -----------------------------------
+    dtype = options.factor_dtype or default_factor_dtype()
+    if np.issubdtype(a.data.dtype, np.complexfloating):
+        dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
+    with stats.timer("FACT"):
+        numeric = numeric_factorize(plan, bvals, anorm, dtype=dtype,
+                                    replace_tiny=options.replace_tiny_pivot)
+        for f in numeric.fronts:
+            f.block_until_ready()
+    stats.ops["FACT"] += plan.flops
+    stats.tiny_pivots += numeric.tiny_pivots
+
+    lu = LUFactorization(n=n, options=options, equed=equed, dr=dr, dc=dc,
+                         r1=r1, c1=c1, row_order=row_order,
+                         col_order=col_order, sf=sf, plan=plan,
+                         numeric=numeric, anorm=anorm, a=a)
+    if not numeric.finite:
+        # exactly singular U and no tiny-pivot replacement: the reference
+        # returns the first zero-pivot index (pdgstrf.c:1920-1924); we flag
+        # singularity without localizing it (info = n+1 convention would lie)
+        return None, lu, stats, 1
+    return _solve_and_refine(options, a, b, lu, stats)
+
+
+def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
+                      lu: LUFactorization, stats: Stats):
+    n = a.n_rows
+    with stats.timer("SOLVE"):
+        x = lu.solve_factored(b)
+    nrhs = 1 if b.ndim == 1 else b.shape[1]
+    stats.ops["SOLVE"] += 4.0 * lu.sf.nnz_L * nrhs  # fwd+back L,U sweeps
+
+    info = 0
+    if options.iter_refine != IterRefine.NOREFINE:
+        with stats.timer("REFINE"):
+            x, berrs = iterative_refinement(a, b, x, lu.solve_factored)
+        stats.refine_steps += len(berrs)
+        lu.berrs = berrs
+    if options.print_stat:
+        stats.print()
+    return x, lu, stats, info

@@ -31,6 +31,8 @@ class NumericFactorization:
     fronts: list              # per group: (B, M, M) device array, packed LU
     tiny_pivots: int
     dtype: object
+    finite: bool = True       # False => an exact zero pivot propagated
+                              # (only possible with replace_tiny=False)
     host_fronts: list = None  # lazily pulled numpy copies for the host solve
 
     def pull_to_host(self):
@@ -42,18 +44,23 @@ class NumericFactorization:
 
 
 def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
-                      anorm: float, dtype="float64") -> NumericFactorization:
+                      anorm: float, dtype="float64",
+                      replace_tiny: bool = True) -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
     (reference pdgstrf2.c:218: thresh = eps·‖A‖; we use the sqrt variant of
     ReplaceTinyPivot so f32 factors retain half their digits).
+    With replace_tiny=False an exact zero pivot propagates inf/nan; the
+    result is flagged non-finite (the reference's info>0 singularity path,
+    pdgstrf.c:234-241).
     """
     dtype = jnp.dtype(dtype)
-    eps = jnp.finfo(dtype if jnp.issubdtype(dtype, jnp.floating)
-                    else jnp.dtype(dtype).type(0).real.dtype).eps
-    thresh = jnp.asarray(np.sqrt(float(eps)) * max(anorm, 1e-300),
-                         dtype=jnp.dtype(dtype).type(0).real.dtype)
+    real_dtype = jnp.dtype(dtype).type(0).real.dtype
+    eps = jnp.finfo(real_dtype).eps
+    thresh = jnp.asarray(
+        np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
+        dtype=real_dtype)
     avals = jnp.asarray(pattern_values, dtype=dtype)
     pool = jnp.zeros(plan.pool_size, dtype=dtype)
     fronts_out = []
@@ -74,8 +81,12 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         if len(grp.s_dst):
             flat = packed.reshape(grp.batch, -1)
             pool = pool.at[grp.s_dst].set(flat[(grp.s_slot, grp.s_src_flat)])
+    finite = True
+    if not replace_tiny:
+        finite = all(bool(jnp.isfinite(f).all()) for f in fronts_out)
     return NumericFactorization(plan=plan, fronts=fronts_out,
-                                tiny_pivots=int(tiny_total), dtype=dtype)
+                                tiny_pivots=int(tiny_total), dtype=dtype,
+                                finite=finite)
 
 
 def factor_flops(plan: FactorPlan) -> float:

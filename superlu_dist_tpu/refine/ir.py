@@ -1,0 +1,61 @@
+"""Iterative refinement.
+
+Analog of pdgsrfs (SRC/pdgsrfs.c:120): classical IR with componentwise
+backward error.  r = b − A·x is computed in float64 (the analog of the
+reference's double-precision residual in IterRefine=SLU_DOUBLE), the
+correction solves reuse the factors, and iteration stops when
+berr = max_i |r|_i / (|A|·|x| + |b|)_i reaches eps, stops improving by 2×
+(reference :232), or after ITMAX=20 steps (reference :126).
+
+On TPU this is the half of the mixed-precision design that recovers f64
+accuracy from f32 factors (SURVEY.md §7 hard-part 1): the factorization is
+fast/low-precision on the MXU, the cheap SpMV residual is exact.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from superlu_dist_tpu.sparse.formats import SparseCSR
+
+ITMAX = 20
+
+
+def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
+                         solve_fn, itmax: int = ITMAX):
+    """Refine solve_fn-based solution x of A·x = b.
+
+    solve_fn(r) must solve A·dx = r using the existing factorization
+    (including all scalings/permutations).  Returns (x, berr_history).
+    """
+    b = np.asarray(b)
+    squeeze = b.ndim == 1
+    b2 = b[:, None] if squeeze else b
+    x2 = (x[:, None] if squeeze else x).astype(
+        np.promote_types(b.dtype, np.float64), copy=True)
+    eps = np.finfo(np.float64).eps
+    safe1 = a.nnz + 1
+    safmin = np.finfo(np.float64).tiny
+    nrhs = b2.shape[1]
+    berrs = []
+    # per-RHS stopping state, like the reference's outer loop over RHS
+    # columns (pdgsrfs.c:126): one stagnating column must not halt others
+    lstres = np.full(nrhs, np.inf)
+    active = np.ones(nrhs, dtype=bool)
+    for _ in range(itmax):
+        r = b2 - a.matvec(x2)
+        # componentwise backward error per rhs (pdgsrfs.c:213-231)
+        berr = np.empty(nrhs)
+        for k in range(nrhs):
+            den = a.abs_matvec(np.abs(x2[:, k])) + np.abs(b2[:, k])
+            den = np.where(den <= safe1 * safmin, den + safe1 * safmin, den)
+            berr[k] = float(np.max(np.abs(r[:, k]) / den))
+        berrs.append(berr.copy())
+        active &= (berr > eps) & (berr < lstres / 2.0)
+        if not active.any():
+            break
+        lstres = np.where(active, berr, lstres)
+        dx = solve_fn(r[:, active])
+        x2[:, active] = x2[:, active] + (dx[:, None] if dx.ndim == 1 else dx)
+    berrs = [float(b.max()) for b in berrs]
+    return (x2[:, 0] if squeeze else x2), berrs

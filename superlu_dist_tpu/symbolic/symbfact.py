@@ -49,9 +49,10 @@ class SymbolicFact:
     nnz_L: int                # including the dense diagonal-block lower triangle
     nnz_U: int
     flops: float              # factorization flop estimate
-    pattern_indptr: np.ndarray = None    # symmetrized pattern permuted by
-    pattern_indices: np.ndarray = None   # `perm` (CSR); value alignment is
-                                         # reproduced by permuting with `perm`
+    pattern_indptr: np.ndarray = None    # symmetrized pattern permuted by `perm` (CSR)
+    pattern_indices: np.ndarray = None
+    value_perm: np.ndarray = None        # gather map: permuted-pattern values
+                                         # = sym_pattern.data[value_perm]
 
     @property
     def n_supernodes(self) -> int:
@@ -78,8 +79,12 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
     perm = np.asarray(order, dtype=np.int64)[post]
     old_parents = parent0[post]
     parent = np.where(old_parents >= 0, inv_post[np.clip(old_parents, 0, None)], -1)
-    b = sym_pattern.permute(perm, perm)
-    indptr, indices = b.indptr, b.indices
+    # permute once, carrying entry ids so later refactorizations can align
+    # values with a single gather instead of re-permuting (SamePattern reuse)
+    tracer = SparseCSR(n, n, sym_pattern.indptr, sym_pattern.indices,
+                       np.arange(sym_pattern.nnz, dtype=np.int64))
+    b = tracer.permute(perm, perm)
+    indptr, indices, value_perm = b.indptr, b.indices, b.data
 
     # ---- relaxed leaf supernodes (relax_snode analog) ----------------------
     # postordered labels => every subtree is a contiguous column range
@@ -176,4 +181,4 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
         n=n, perm=perm, parent=parent, sn_start=sn_start, col_to_sn=col_to_sn,
         sn_rows=sn_rows, sn_parent=sn_parent, sn_level=sn_level,
         nnz_L=nnz_tri + nnz_rect, nnz_U=nnz_tri + nnz_rect, flops=flops,
-        pattern_indptr=indptr, pattern_indices=indices)
+        pattern_indptr=indptr, pattern_indices=indices, value_perm=value_perm)

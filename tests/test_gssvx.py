@@ -1,0 +1,186 @@
+"""End-to-end driver tests.
+
+Mirrors the reference's test strategy (SURVEY.md §4 / TEST/pdtest.c): real
+small matrices, residual thresholds ‖b−Ax‖/(‖A‖·‖x‖·ε·m) < THRESH=20, a
+sweep over option combinations and Fact-reuse modes, plus fabricated-xtrue
+accuracy checks like the EXAMPLE drivers (dcreate_matrix.c:147-148).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import gssvx
+from superlu_dist_tpu.io.readers import read_harwell_boeing
+from superlu_dist_tpu.models.gallery import (
+    poisson2d, poisson3d, random_sparse, convection_diffusion_2d)
+from superlu_dist_tpu.utils.options import (
+    Options, Fact, ColPerm, RowPerm, IterRefine)
+
+REF = "/root/reference/EXAMPLE"
+THRESH = 20.0
+
+
+def resid_test(a, x, b):
+    """pdcompute_resid analog (TEST/pdcompute_resid.c:18)."""
+    r = b - a.matvec(x)
+    eps = np.finfo(np.float64).eps
+    denom = a.norm_inf() * np.linalg.norm(x, np.inf) * eps * a.n_rows
+    return np.linalg.norm(r, np.inf) / max(denom, 1e-300)
+
+
+def run_and_check(a, opts=None, nrhs=1, seed=0):
+    n = a.n_rows
+    rng = np.random.default_rng(seed)
+    dtype = a.data.dtype
+    xtrue = rng.standard_normal((n, nrhs)).astype(dtype)
+    if np.issubdtype(dtype, np.complexfloating):
+        xtrue = xtrue + 1j * rng.standard_normal((n, nrhs))
+    xtrue = xtrue[:, 0] if nrhs == 1 else xtrue
+    b = a.matvec(xtrue)
+    opts = opts or Options()
+    x, lu, stats, info = gssvx(opts, a, b)
+    assert info == 0
+    res = resid_test(a, x, b)
+    assert res < THRESH, f"residual ratio {res} over threshold"
+    return x, xtrue, lu, stats
+
+
+def test_poisson2d_default():
+    x, xtrue, lu, stats = run_and_check(poisson2d(12))
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8, atol=1e-8)
+    assert stats.utime["FACT"] > 0 and stats.ops["FACT"] > 0
+
+
+def test_poisson3d():
+    run_and_check(poisson3d(6))
+
+
+def test_unsymmetric_convection():
+    run_and_check(convection_diffusion_2d(10, beta=100.0))
+
+
+@pytest.mark.parametrize("colperm", [ColPerm.NATURAL, ColPerm.MMD_AT_PLUS_A,
+                                     ColPerm.ND_AT_PLUS_A])
+@pytest.mark.parametrize("rowperm", [RowPerm.NOROWPERM, RowPerm.LargeDiag_MC64])
+def test_option_sweep(colperm, rowperm):
+    """The pdtest-style parameter sweep (TEST/CMakeLists.txt:9-18)."""
+    a = random_sparse(60, density=0.05, seed=11)
+    opts = Options(col_perm=colperm, row_perm=rowperm)
+    run_and_check(a, opts)
+
+
+@pytest.mark.parametrize("nrhs", [1, 3])
+def test_multiple_rhs(nrhs):
+    run_and_check(poisson2d(8), nrhs=nrhs, seed=3)
+
+
+def test_needs_pivoting_matrix():
+    """A matrix whose natural diagonal is terrible: matching must fix it."""
+    n = 50
+    rng = np.random.default_rng(4)
+    # permuted diagonal: A[perm[i], i] large, diagonal tiny/zero
+    perm = rng.permutation(n)
+    rows = np.concatenate([perm, rng.integers(0, n, 150)])
+    cols = np.concatenate([np.arange(n), rng.integers(0, n, 150)])
+    vals = np.concatenate([10.0 + rng.random(n), 0.1 * rng.standard_normal(150)])
+    from superlu_dist_tpu.sparse.formats import coo_to_csr
+    a = coo_to_csr(n, n, rows, cols, vals)
+    run_and_check(a)
+
+
+def test_fact_reuse_modes():
+    a = poisson2d(9)
+    n = a.n_rows
+    b1 = np.ones(n)
+    b2 = np.arange(n, dtype=np.float64)
+    opts = Options()
+    x1, lu, stats, _ = gssvx(opts, a, b1)
+
+    # FACTORED: same A, new b — solve only (pddrive1 scenario)
+    opts_f = Options(fact=Fact.FACTORED)
+    x2, lu, stats2, _ = gssvx(opts_f, a, b2, lu=lu)
+    np.testing.assert_allclose(a.matvec(x2), b2, atol=1e-8)
+    assert stats2.utime["FACT"] == 0
+
+    # SamePattern_SameRowPerm: new values, same pattern (pddrive3 scenario)
+    a3 = poisson2d(9)
+    a3.data = a3.data * 2.0
+    opts_s = Options(fact=Fact.SamePattern_SameRowPerm)
+    x3, lu3, stats3, _ = gssvx(opts_s, a3, b1, lu=lu)
+    np.testing.assert_allclose(a3.matvec(x3), b1, atol=1e-8)
+    assert lu3.sf is lu.sf          # symbolic reused
+    assert lu3.plan is lu.plan      # plan reused
+
+    # SamePattern: new values, may re-pivot rows (pddrive2 scenario)
+    opts_p = Options(fact=Fact.SamePattern)
+    x4, lu4, _, _ = gssvx(opts_p, a3, b2, lu=lu)
+    np.testing.assert_allclose(a3.matvec(x4), b2, atol=1e-8)
+    assert lu4.col_order is lu.col_order
+
+
+def test_f32_factor_with_f64_refinement():
+    """The TPU mixed-precision design: f32 factors + IR reach f64 accuracy."""
+    a = poisson2d(10)
+    opts = Options(factor_dtype="float32")
+    x, xtrue, lu, stats = run_and_check(a, opts)
+    r = a.matvec(x) - a.matvec(xtrue)
+    rel = np.linalg.norm(r) / np.linalg.norm(a.matvec(xtrue))
+    assert rel < 1e-10
+    assert stats.refine_steps >= 1
+
+
+def test_no_refine_option():
+    a = poisson2d(6)
+    opts = Options(iter_refine=IterRefine.NOREFINE)
+    run_and_check(a, opts)
+
+
+def test_complex_end_to_end():
+    a = random_sparse(40, density=0.08, seed=6, dtype=np.complex128)
+    run_and_check(a)
+
+
+def test_exact_singularity_reported_without_replacement():
+    """ReplaceTinyPivot=NO + singular A => info>0, like pdgstrf.c:234-241."""
+    from superlu_dist_tpu.sparse.formats import coo_to_csr
+    z = coo_to_csr(2, 2, [0, 0, 1, 1], [0, 1, 0, 1], np.ones(4))  # rank 1
+    opts = Options(replace_tiny_pivot=False, row_perm=RowPerm.NOROWPERM,
+                   equil=False, iter_refine=IterRefine.NOREFINE)
+    x, lu, stats, info = gssvx(opts, z, np.ones(2))
+    assert info > 0 and x is None
+
+
+def test_pattern_mismatch_reuse_raises():
+    """Reusing a factorization against a different sparsity pattern must
+    raise, not silently produce wrong factors."""
+    a = random_sparse(36, density=0.08, seed=1)
+    _, lu, _, _ = gssvx(Options(), a, np.ones(36))
+    other = random_sparse(36, density=0.12, seed=2)   # same n, new pattern
+    with pytest.raises(Exception):
+        gssvx(Options(fact=Fact.SamePattern_SameRowPerm), other,
+              np.ones(36), lu=lu)
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/g20.rua"), reason="no fixtures")
+def test_g20_rua():
+    """The reference CI's canonical matrix (.travis_tests.sh)."""
+    a = read_harwell_boeing(f"{REF}/g20.rua").tocsr()
+    x, xtrue, lu, stats = run_and_check(a)
+    err = np.linalg.norm(x - xtrue, np.inf) / np.linalg.norm(x, np.inf)
+    assert err < 1e-8        # pdinf_norm_error analog
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/cg20.cua"), reason="no fixtures")
+def test_cg20_cua_complex():
+    a = read_harwell_boeing(f"{REF}/cg20.cua").tocsr()
+    run_and_check(a)
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/big.rua"), reason="no fixtures")
+def test_big_rua():
+    a = read_harwell_boeing(f"{REF}/big.rua").tocsr()
+    x, xtrue, lu, stats = run_and_check(a)
+    err = np.linalg.norm(x - xtrue, np.inf) / np.linalg.norm(x, np.inf)
+    assert err < 1e-6
