@@ -60,6 +60,8 @@ class LUFactorization:
     anorm: float = 0.0
     a: SparseCSR = None       # original matrix (for refinement SpMV)
     berrs: list = None        # backward errors of the last refinement
+    a_sym_indptr: np.ndarray = None    # symmetrized pattern the symbolic
+    a_sym_indices: np.ndarray = None   # factorization was built on
 
     # -- combined transforms --------------------------------------------------
     @property
@@ -173,11 +175,17 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         else:
             plan = build_plan(sf, min_bucket=options.min_bucket,
                               growth=options.bucket_growth)
-        if sym.nnz != len(sf.value_perm):
+        pattern_mismatch = sym.nnz != len(sf.value_perm)
+        if not pattern_mismatch and reuse_symbolic:
+            # nnz equality is not enough: a moved entry with equal count
+            # would gather values into wrong structural slots silently
+            pattern_mismatch = not (
+                np.array_equal(sym.indptr, lu.a_sym_indptr)
+                and np.array_equal(sym.indices, lu.a_sym_indices))
+        if pattern_mismatch:
             raise SuperLUError(
-                f"Fact={fact.name} reuse requires the same sparsity pattern: "
-                f"matrix has {sym.nnz} symmetrized entries, factorization "
-                f"expects {len(sf.value_perm)}")
+                f"Fact={fact.name} reuse requires the same sparsity pattern "
+                f"as the factorization being reused")
         bvals = sym.data[sf.value_perm]
 
     # ---- FACT (pdgssvx.c:1176 → pdgstrf) -----------------------------------
@@ -195,7 +203,8 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
     lu = LUFactorization(n=n, options=options, equed=equed, dr=dr, dc=dc,
                          r1=r1, c1=c1, row_order=row_order,
                          col_order=col_order, sf=sf, plan=plan,
-                         numeric=numeric, anorm=anorm, a=a)
+                         numeric=numeric, anorm=anorm, a=a,
+                         a_sym_indptr=sym.indptr, a_sym_indices=sym.indices)
     if not numeric.finite:
         # exactly singular U and no tiny-pivot replacement: the reference
         # returns the first zero-pivot index (pdgstrf.c:1920-1924); we flag

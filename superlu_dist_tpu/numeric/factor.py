@@ -19,7 +19,7 @@ import jax
 import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.plan import FactorPlan
-from superlu_dist_tpu.ops.dense import make_front_kernel
+from superlu_dist_tpu.ops.dense import group_partial_factor
 
 
 @dataclasses.dataclass
@@ -43,6 +43,84 @@ class NumericFactorization:
         return self.host_fronts
 
 
+def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
+    """Build the whole numeric factorization as ONE jittable function.
+
+    Where the reference's pdgstrf is an MPI pipeline of thousands of BLAS
+    calls (SRC/pdgstrf.c:1100-1745), the plan's level groups let the entire
+    factorization trace into a single XLA program: per group one gather
+    (assembly + extend-add), one batched partial LU, one scatter to the
+    Schur pool.  XLA then owns scheduling, fusion, and buffer reuse.
+
+    Returns fn(avals, thresh) -> (fronts_tuple, tiny_count).  The plan's
+    index maps are closed over as device constants (hoisted to args by jit).
+    If `mesh` is a jax.sharding.Mesh with axes ("snode", "panel"), each
+    group's front batch is sharded batch-over-"snode" and columns-over-
+    "panel" — the 2D block-cyclic layout analog (SURVEY.md §2.4) — and the
+    Schur pool is replicated (extend-add plays the role of the reference's
+    cross-rank scatter, pddistribute.c:61).
+    """
+    dtype = jnp.dtype(dtype)
+    one = jnp.ones((), dtype=dtype)
+    sharding = pivot_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # Only the dense factor math (triangular solves + Schur GEMM) is
+        # sharded; every irregular scatter/gather (assembly, extend-add,
+        # pool write-back) is pinned replicated — XLA's SPMD partitioner
+        # miscompiles scatter/gather with sharded operand dims (jax 0.9.0),
+        # and these ops are bandwidth-trivial next to the GEMMs anyway.
+        sharding = NamedSharding(mesh, P("snode", None, "panel"))
+        pivot_sharding = NamedSharding(mesh, P("snode", None, None))
+        pool_sharding = NamedSharding(mesh, P(None))
+        flat_repl = NamedSharding(mesh, P(None, None))
+    # hoist index maps to device arrays once (jit passes them as consts)
+    idx = []
+    for grp in plan.groups:
+        idx.append(tuple(jnp.asarray(a) for a in (
+            grp.pad_slot, grp.pad_flat, grp.a_slot, grp.a_flat, grp.a_src,
+            grp.e_slot, grp.e_flat, grp.e_src,
+            grp.s_slot, grp.s_src_flat, grp.s_dst)))
+
+    def fn(avals, thresh):
+        avals = avals.astype(dtype)
+        pool = jnp.zeros(plan.pool_size, dtype=dtype)
+        if sharding is not None:
+            pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
+        fronts = []
+        tiny = jnp.zeros((), jnp.int32)
+        for grp, (pad_slot, pad_flat, a_slot, a_flat, a_src,
+                  e_slot, e_flat, e_src, s_slot, s_src_flat, s_dst) in zip(
+                plan.groups, idx):
+            f = jnp.zeros((grp.batch, grp.m * grp.m), dtype=dtype)
+            if sharding is not None:
+                f = jax.lax.with_sharding_constraint(f, flat_repl)
+            if len(grp.pad_flat):
+                f = f.at[(pad_slot, pad_flat)].set(one)
+            if len(grp.a_src):
+                f = f.at[(a_slot, a_flat)].add(avals[a_src])
+            if len(grp.e_src):
+                f = f.at[(e_slot, e_flat)].add(pool[e_src])
+            f = f.reshape(grp.batch, grp.m, grp.m)
+            if sharding is not None:
+                f = jax.lax.with_sharding_constraint(f, sharding)
+            packed, counts = group_partial_factor(
+                f, thresh, grp.w, front_sharding=sharding,
+                pivot_sharding=pivot_sharding)
+            fronts.append(packed)
+            tiny = tiny + counts
+            if len(grp.s_dst):
+                flat = packed.reshape(grp.batch, -1)
+                if sharding is not None:
+                    flat = jax.lax.with_sharding_constraint(flat, flat_repl)
+                pool = pool.at[s_dst].set(flat[(s_slot, s_src_flat)])
+                if sharding is not None:
+                    pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
+        return tuple(fronts), tiny
+
+    return jax.jit(fn)
+
+
 def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       anorm: float, dtype="float64",
                       replace_tiny: bool = True) -> NumericFactorization:
@@ -62,28 +140,24 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)
     avals = jnp.asarray(pattern_values, dtype=dtype)
-    pool = jnp.zeros(plan.pool_size, dtype=dtype)
-    fronts_out = []
-    tiny_total = jnp.zeros((), jnp.int32)
-    one = jnp.ones((), dtype=dtype)
-    for grp in plan.groups:
-        f = jnp.zeros((grp.batch, grp.m * grp.m), dtype=dtype)
-        if len(grp.pad_flat):
-            f = f.at[(grp.pad_slot, grp.pad_flat)].set(one)
-        if len(grp.a_src):
-            f = f.at[(grp.a_slot, grp.a_flat)].add(avals[grp.a_src])
-        if len(grp.e_src):
-            f = f.at[(grp.e_slot, grp.e_flat)].add(pool[grp.e_src])
-        kern = make_front_kernel(grp.m, grp.w, str(dtype))
-        packed, tiny = kern(f.reshape(grp.batch, grp.m, grp.m), thresh)
-        fronts_out.append(packed)
-        tiny_total = tiny_total + tiny
-        if len(grp.s_dst):
-            flat = packed.reshape(grp.batch, -1)
-            pool = pool.at[grp.s_dst].set(flat[(grp.s_slot, grp.s_src_flat)])
+    cache = getattr(plan, "_factor_fns", None)
+    if cache is None:
+        cache = plan._factor_fns = {}
+    fn = cache.get(str(dtype))
+    if fn is None:
+        fn = cache[str(dtype)] = make_factor_fn(plan, dtype)
+    fronts_out, tiny_total = fn(avals, thresh)
+    fronts_out = list(fronts_out)
     finite = True
     if not replace_tiny:
-        finite = all(bool(jnp.isfinite(f).all()) for f in fronts_out)
+        # singularity check: non-finite factors OR an exact zero on the U
+        # diagonal (a zero pivot in the last column of an unpadded front
+        # divides nothing during factorization, so isfinite alone misses it)
+        for grp, f in zip(plan.groups, fronts_out):
+            diag = jnp.diagonal(f[:, :grp.w, :grp.w], axis1=1, axis2=2)
+            if not bool(jnp.isfinite(f).all()) or bool((diag == 0).any()):
+                finite = False
+                break
     return NumericFactorization(plan=plan, fronts=fronts_out,
                                 tiny_pivots=int(tiny_total), dtype=dtype,
                                 finite=finite)

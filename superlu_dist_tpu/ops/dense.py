@@ -43,19 +43,37 @@ def _fix_pivot(piv, thresh):
     return jnp.where(tiny, unit * thresh.astype(piv.dtype), piv), tiny.astype(jnp.int32)
 
 
-def _lu_unrolled(a, thresh):
-    """Unpivoted LU of a small block, columns unrolled (static indices)."""
+def _lu_masked(a, thresh):
+    """Unpivoted LU of a small block — scatter-free masked formulation.
+
+    Each step is one-hot selects + a full-matrix rank-1 update + `where`
+    masks: no scatter/dynamic-update ops at all.  That matters twice on
+    TPU: (a) masked dense updates vectorize on the VPU where scatters
+    serialize, and (b) XLA's SPMD partitioner miscompiles vmapped
+    scatter-updates whose minor dim gets sharded (observed jax 0.9.0), so
+    the factorization core must stay scatter-free to be mesh-shardable.
+    The ~3× extra flops of full-width updates are negligible next to the
+    Schur GEMMs.
+    """
     k = a.shape[0]
-    count = jnp.zeros((), jnp.int32)
-    for i in range(k):
-        piv, tiny = _fix_pivot(a[i, i], thresh)
-        count = count + tiny
-        a = a.at[i, i].set(piv)
-        if i + 1 < k:
-            col = a[i + 1:, i] / piv
-            a = a.at[i + 1:, i].set(col)
-            a = a.at[i + 1:, i + 1:].add(
-                -col[:, None] * a[i, i + 1:][None, :])
+    idx = jnp.arange(k)
+
+    def step(i, carry):
+        a, count = carry
+        e = (idx == i).astype(a.dtype)
+        row_i = e @ a                       # row i
+        col_i = a @ e                       # column i
+        piv, tiny = _fix_pivot(row_i @ e.astype(row_i.dtype), thresh)
+        below = (idx > i)
+        l = jnp.where(below, col_i / piv, jnp.zeros_like(col_i))
+        u = jnp.where(below, row_i, jnp.zeros_like(row_i))   # cols > i
+        a = a - l[:, None] * u[None, :]
+        # write multipliers + fixed pivot into column i
+        new_col = jnp.where(below, l, col_i) + (piv - row_i @ e) * e
+        a = a + (new_col - a @ e)[:, None] * e[None, :]
+        return a, count + tiny
+
+    a, count = jax.lax.fori_loop(0, k, step, (a, jnp.zeros((), jnp.int32)))
     return a, count
 
 
@@ -67,7 +85,7 @@ def lu_nopivot(a, thresh):
     """
     n = a.shape[0]
     if n <= _UNROLL:
-        return _lu_unrolled(a, thresh)
+        return _lu_masked(a, thresh)
     h = max(_UNROLL, (n // 2 + _UNROLL - 1) // _UNROLL * _UNROLL)
     h = min(h, n - 1)
     a11, a12 = a[:h, :h], a[:h, h:]
@@ -82,7 +100,7 @@ def lu_nopivot(a, thresh):
     return jnp.concatenate([top, bot], axis=0), c1 + c2
 
 
-def _partial_front_factor(f, thresh, w):
+def partial_front_factor(f, thresh, w):
     """Factor the leading w columns of one front; see module docstring."""
     m = f.shape[0]
     f11, count = lu_nopivot(f[:w, :w], thresh)
@@ -96,6 +114,50 @@ def _partial_front_factor(f, thresh, w):
     return jnp.concatenate([top, bot], axis=0), count
 
 
+def group_partial_factor(fronts, thresh, w, front_sharding=None,
+                         pivot_sharding=None):
+    """Partial factorization of a batch of fronts with explicit shardings.
+
+    Group-level formulation of partial_front_factor: the pivot-block LU is
+    latency-bound (unrolled column loop) and runs replicated along the
+    "panel" mesh axis (pivot_sharding), while the trailing triangular
+    solves and the Schur GEMM — where the flops are (reference
+    dSchCompUdt-2Ddynamic.c:566) — are pure batched matmuls that partition
+    cleanly over the 2D mesh (front_sharding).  Note: the scatter-style
+    pivot loop must NOT be sharded along its last dim — XLA's SPMD
+    partitioner miscompiles vmapped scatter-updates with a sharded minor
+    dimension (observed on jax 0.9.0), and splitting a tiny LU across
+    chips would be latency-dominated anyway.
+    """
+    from jax.lax import with_sharding_constraint as wsc
+    m = fronts.shape[-1]
+    f11_in = fronts[:, :w, :w]
+    if pivot_sharding is not None:
+        f11_in = wsc(f11_in, pivot_sharding)
+    f11, counts = jax.vmap(lambda x: lu_nopivot(x, thresh))(f11_in)
+    tiny = jnp.sum(counts)
+    if w == m:
+        if front_sharding is not None:
+            f11 = wsc(f11, front_sharding)
+        return f11, tiny
+    a12 = fronts[:, :w, w:]
+    a21 = fronts[:, w:, :w]
+    a22 = fronts[:, w:, w:]
+    u12 = jax.vmap(lambda l, b: solve_triangular(l, b, lower=True,
+                                                 unit_diagonal=True))(f11, a12)
+    l21 = jax.vmap(lambda u, b: solve_triangular(u, b.T, trans=1,
+                                                 lower=False).T)(f11, a21)
+    s = a22 - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    if front_sharding is not None:
+        s = wsc(s, front_sharding)
+    top = jnp.concatenate([f11, u12], axis=2)
+    bot = jnp.concatenate([l21, s], axis=2)
+    out = jnp.concatenate([top, bot], axis=1)
+    if front_sharding is not None:
+        out = wsc(out, front_sharding)
+    return out, tiny
+
+
 @functools.lru_cache(maxsize=None)
 def make_front_kernel(m: int, w: int, dtype: str):
     """Jitted batched front factorization for bucket shape (M=m, W=w).
@@ -105,7 +167,7 @@ def make_front_kernel(m: int, w: int, dtype: str):
     """
 
     def kernel(fronts, thresh):
-        outs, counts = jax.vmap(lambda f: _partial_front_factor(f, thresh, w))(fronts)
+        outs, counts = jax.vmap(lambda f: partial_front_factor(f, thresh, w))(fronts)
         return outs, jnp.sum(counts)
 
     return jax.jit(kernel)

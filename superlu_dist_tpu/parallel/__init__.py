@@ -1,0 +1,1 @@
+from superlu_dist_tpu.parallel.grid import ProcessGrid, gridinit

@@ -1,0 +1,57 @@
+"""2D device grid — the TPU-native analog of the reference's process grid.
+
+The reference creates a Pr×Pc MPI grid with row/column sub-communicators
+(superlu_gridinit, SRC/superlu_grid.c:31-189) and maps supernode block
+(I, J) to rank (I mod Pr, J mod Pc) (superlu_defs.h:293-318).  On TPU the
+grid is a `jax.sharding.Mesh` over the chips: axis "snode" distributes
+independent fronts of an elimination-tree level (the task-parallel axis —
+the analog of block-cyclic rows), axis "panel" splits each front's columns
+(the analog of block-cyclic columns).  XLA inserts the ICI collectives that
+the reference issues by hand (Isend/Irecv panels, Allreduce schedules,
+pdgstrf.c:1025-1224).
+
+Multi-host runs use the same Mesh spanning all processes' devices —
+jax.distributed handles what superlu_gridmap did.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass
+class ProcessGrid:
+    """gridinfo_t analog (superlu_defs.h:323-349): shape + mesh handle."""
+
+    nprow: int
+    npcol: int
+    mesh: Mesh
+
+    @property
+    def nproc(self) -> int:
+        return self.nprow * self.npcol
+
+    def front_sharding(self) -> NamedSharding:
+        """Sharding for a (batch, m, m) level group of fronts."""
+        return NamedSharding(self.mesh, P("snode", None, "panel"))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(None))
+
+
+def gridinit(nprow: int, npcol: int, devices=None) -> ProcessGrid:
+    """superlu_gridinit analog (SRC/superlu_grid.c:31): carve an nprow×npcol
+    mesh out of the first nprow·npcol devices."""
+    if devices is None:
+        devices = jax.devices()
+    need = nprow * npcol
+    if len(devices) < need:
+        raise ValueError(
+            f"grid {nprow}x{npcol} needs {need} devices, have {len(devices)}")
+    dev = np.asarray(devices[:need]).reshape(nprow, npcol)
+    return ProcessGrid(nprow=nprow, npcol=npcol,
+                       mesh=Mesh(dev, axis_names=("snode", "panel")))
