@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Benchmark: sparse LU numeric-factorization GFLOPS, TPU vs host CPU.
+
+The metric mirrors the reference's headline number — factor Mflops printed
+by PStatPrint (SRC/util.c:513-518) — on the BASELINE.md config-4 matrix
+class (7-pt 3D Poisson).  The numeric factorization runs entirely on the
+device via the streamed executor (numeric/stream.py).
+
+vs_baseline is the wall-clock factorization speedup over serial SuperLU
+with host CPU BLAS (scipy.sparse.linalg.splu — the same code family as the
+reference) factoring the identical matrix on this machine (north-star
+target: >= 4x CPU-BLAS factorization, BASELINE.json).  The reference's
+distributed pdgstrf on one node is the same computation plus MPI overhead,
+so serial SuperLU is the stronger (fairer) baseline.  Note the dtype
+asymmetry is part of the design under measure: the TPU path factors in f32
+and recovers f64 accuracy via iterative refinement (GESP + IR, SURVEY.md
+§7 hard-part 1); the residual printed is AFTER refinement and must be at
+reference accuracy.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": GFLOPS, "unit": "GFLOP/s", "vs_baseline": ...}
+
+Env knobs: BENCH_NX (grid edge, default 24 -> n=13824), BENCH_REPS.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               ".cache", "jax"))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+from superlu_dist_tpu.models.gallery import poisson3d
+from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+from superlu_dist_tpu.utils.options import Options
+from superlu_dist_tpu.ordering.dispatch import get_perm_c
+from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+from superlu_dist_tpu.numeric.plan import build_plan
+from superlu_dist_tpu.numeric.stream import StreamExecutor
+from superlu_dist_tpu.numeric.factor import NumericFactorization
+from superlu_dist_tpu.drivers.gssvx import LUFactorization
+from superlu_dist_tpu.refine.ir import iterative_refinement
+
+NX = int(os.environ.get("BENCH_NX", "24"))
+REPS = int(os.environ.get("BENCH_REPS", "5"))
+DTYPE = "float32"
+# TPU-tuned blocking: wide supernodes feed the MXU (SURVEY.md §7 step 10 —
+# the reference's NSUP=128 is CPU-cache-sized) and keep the streamed
+# executor's kernel count small.
+RELAX, MAX_SUPER, MIN_BUCKET, GROWTH = 256, 1024, 64, 2.0
+
+
+def _prepare():
+    a = poisson3d(NX)
+    opts = Options()
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(opts, a, sym)
+    sf = symbolic_factorize(sym, col_order, relax=RELAX,
+                            max_supernode=MAX_SUPER)
+    plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH)
+    avals = sym.data[sf.value_perm].astype(DTYPE)
+    thresh = np.sqrt(np.finfo(DTYPE).eps) * a.norm_max()
+    return a, sf, plan, avals, np.asarray(thresh, DTYPE)
+
+
+def _time_factor(ex, avals, thresh, reps):
+    out = jax.block_until_ready(ex(avals, thresh))     # warm (compile)
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = jax.block_until_ready(ex(avals, thresh))
+        times.append(time.perf_counter() - t0)
+    return min(times), out
+
+
+def main():
+    a, sf, plan, avals_np, thresh_np = _prepare()
+
+    backend = jax.default_backend()
+    ex = StreamExecutor(plan, DTYPE)
+    avals = jnp.asarray(avals_np)
+    thresh = jnp.asarray(thresh_np)
+    t_dev, (fronts, tiny) = _time_factor(ex, avals, thresh, REPS)
+    gflops = plan.flops / t_dev / 1e9
+
+    # residual through the full solve + f64 iterative refinement (GESP
+    # semantics: f32 factors, refined solution; pdgsrfs.c:120) — via the
+    # driver's own solve path (no equil/rowperm: identity transforms)
+    numeric = NumericFactorization(plan=plan, fronts=list(fronts),
+                                   tiny_pivots=int(tiny), dtype=jnp.dtype(DTYPE))
+    n = a.n_rows
+    ones = np.ones(n)
+    ident = np.arange(n, dtype=np.int64)
+    lu = LUFactorization(n=n, options=Options(), equed="N", dr=ones, dc=ones,
+                         r1=ones, c1=ones, row_order=ident,
+                         col_order=None, sf=sf, plan=plan, numeric=numeric,
+                         a=a)
+    xt = np.random.default_rng(0).standard_normal(n)
+    b = a.matvec(xt)
+    x, _ = iterative_refinement(a, b, lu.solve_factored(b), lu.solve_factored)
+    residual = float(np.linalg.norm(b - a.matvec(x))
+                     / max(np.linalg.norm(b), 1e-300))
+
+    # Baseline: serial SuperLU (same code family as the reference) with
+    # host CPU BLAS, factoring the identical matrix
+    try:
+        import scipy.sparse as sp
+        from scipy.sparse.linalg import splu
+        A = sp.csr_matrix((a.data, a.indices, a.indptr),
+                          shape=(a.n_rows, a.n_rows)).tocsc()
+        t_cpu = min(_timeit(lambda: splu(A)) for _ in range(2))
+        vs_baseline = round(t_cpu / t_dev, 2)
+    except ImportError:                      # pragma: no cover
+        t_cpu = vs_baseline = None
+
+    print(json.dumps({
+        "metric": f"lu_factor_gflops_poisson3d_n{a.n_rows}_{DTYPE}",
+        "value": round(gflops, 2),
+        "unit": "GFLOP/s",
+        "vs_baseline": vs_baseline,
+        "backend": backend,
+        "baseline": "scipy.splu (serial SuperLU, f64, host BLAS), same matrix",
+        "baseline_seconds": t_cpu,
+        "residual": residual,
+        "factor_seconds": t_dev,
+        "flops": plan.flops,
+        "tiny_pivots": int(tiny),
+    }))
+
+
+def _timeit(fn):
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    main()

@@ -3,11 +3,18 @@
 The execution analog of pdgstrf (SRC/pdgstrf.c:243) — but where the
 reference runs an MPI look-ahead pipeline of per-panel BLAS calls, this
 walks the elimination-tree levels bottom-up and, per (level, bucket) group,
-issues three scatter/gather ops and one batched dense kernel (ops.dense).
-All arrays stay resident on the device; the update pool plays the role of
-the reference's bigU/bigV GEMM buffers (pdgstrf.c:770-884) and the
-extend-add indices the role of the dscatter_l/u index arithmetic
-(SRC/dscatter.c:111-290).
+issues assembly gathers, one batched dense partial LU (ops.dense), and a
+strided Schur write-back.  All arrays stay resident on the device; the
+update pool plays the role of the reference's bigU/bigV GEMM buffers
+(pdgstrf.c:770-884) and the device-computed extend-add indices the role of
+the dscatter_l/u index arithmetic (SRC/dscatter.c:111-290).
+
+Two executors share the same per-group step (`group_step`):
+  * make_factor_fn — the whole factorization traced into ONE jittable XLA
+    program (best for moderate plans and for mesh-sharded runs);
+  * stream.StreamExecutor — one small jitted kernel per shape key, groups
+    streamed through asynchronously (best on real TPU where giant programs
+    compile slowly).
 """
 
 from __future__ import annotations
@@ -20,6 +27,70 @@ import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.ops.dense import group_partial_factor
+
+
+def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
+               children, front_sharding=None, pivot_sharding=None,
+               replicated=None):
+    """One (level, bucket) group: assemble + factor + write back.
+
+    dims = (batch, m, w, u) static; `children` is a list of
+    (ub, child_off, child_slot, rel) with device arrays.  Index padding
+    convention (used by the streamed executor): scatter slots == batch and
+    gather sources past the array end are dropped/filled — all index
+    arithmetic keeps OOB entries OOB (rel sentinel == m maps past m*m).
+    """
+    batch, m, w, u = dims
+    dt = pool.dtype
+    wsc = jax.lax.with_sharding_constraint
+
+    f = jnp.zeros((batch, m * m), dtype=dt)
+    if replicated is not None:
+        f = wsc(f, replicated)
+    # identity columns for pivot-block padding (cols ws..w), computed on
+    # device so padded batch slots (ws == 0) become identity fronts
+    k = jnp.arange(m)
+    diag_mask = (k[None, :] >= ws[:, None]) & (k[None, :] < w)
+    f = f.at[:, k * m + k].add(diag_mask.astype(dt))
+    if a_src.shape[0]:
+        vals = avals.at[a_src].get(mode="fill", fill_value=0)
+        f = f.at[(a_slot, a_flat)].add(vals, mode="drop")
+    for (ub, child_off, child_slot, rel) in children:
+        src = child_off[:, None] + jnp.arange(ub * ub)
+        vals = pool.at[src].get(mode="fill", fill_value=0)
+        ri, rj = rel[:, :, None], rel[:, None, :]
+        # any sentinel (rel == m) in the pair must push the flat index OOB —
+        # a mixed pair's ri*m + rj would land in-bounds at (ri+1, 0)
+        dst = jnp.where((ri >= m) | (rj >= m), m * m,
+                        ri * m + rj).reshape(-1, ub * ub)
+        f = f.at[(child_slot[:, None], dst)].add(vals, mode="drop")
+    f = f.reshape(batch, m, m)
+    if front_sharding is not None:
+        f = wsc(f, front_sharding)
+    packed, counts = group_partial_factor(f, thresh, w,
+                                          front_sharding=front_sharding,
+                                          pivot_sharding=pivot_sharding)
+    # padded batch slots (ws == 0) are identity fronts; don't let a
+    # thresh > 1 count their unit pivots as tiny
+    tiny = jnp.sum(jnp.where(ws > 0, counts, 0))
+    if u > 0:
+        flat = packed.reshape(batch, m * m)
+        if replicated is not None:
+            flat = wsc(flat, replicated)
+        i = jnp.arange(u)
+        src_flat = ((w + i)[:, None] * m + (w + i)[None, :]).reshape(-1)
+        vals = flat[:, src_flat]                       # (batch, u*u)
+        dst = off[:, None] + jnp.arange(u * u)         # off==pool_size drops
+        pool = pool.at[dst].set(vals, mode="drop")
+    return packed, pool, tiny
+
+
+def _group_arrays(grp):
+    children = [(cs.ub, jnp.asarray(cs.child_off), jnp.asarray(cs.child_slot),
+                 jnp.asarray(cs.rel)) for cs in grp.children]
+    return (jnp.asarray(grp.a_slot), jnp.asarray(grp.a_flat),
+            jnp.asarray(grp.a_src), jnp.asarray(grp.ws),
+            jnp.asarray(grp.off), children)
 
 
 @dataclasses.dataclass
@@ -46,84 +117,77 @@ class NumericFactorization:
 def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
     """Build the whole numeric factorization as ONE jittable function.
 
-    Where the reference's pdgstrf is an MPI pipeline of thousands of BLAS
-    calls (SRC/pdgstrf.c:1100-1745), the plan's level groups let the entire
-    factorization trace into a single XLA program: per group one gather
-    (assembly + extend-add), one batched partial LU, one scatter to the
-    Schur pool.  XLA then owns scheduling, fusion, and buffer reuse.
-
     Returns fn(avals, thresh) -> (fronts_tuple, tiny_count).  The plan's
-    index maps are closed over as device constants (hoisted to args by jit).
-    If `mesh` is a jax.sharding.Mesh with axes ("snode", "panel"), each
-    group's front batch is sharded batch-over-"snode" and columns-over-
-    "panel" — the 2D block-cyclic layout analog (SURVEY.md §2.4) — and the
-    Schur pool is replicated (extend-add plays the role of the reference's
-    cross-rank scatter, pddistribute.c:61).
+    index maps are closed over as device constants (hoisted to args by
+    jit).  If `mesh` is a jax.sharding.Mesh with axes ("snode", "panel"),
+    the dense factor math is sharded batch-over-"snode" and
+    columns-over-"panel" — the 2D block-cyclic layout analog (SURVEY.md
+    §2.4) — while every irregular scatter/gather is pinned replicated
+    (XLA's SPMD partitioner miscompiles scatter/gather with sharded minor
+    dims, jax 0.9.0; they are bandwidth-trivial next to the GEMMs).
     """
     dtype = jnp.dtype(dtype)
-    one = jnp.ones((), dtype=dtype)
-    sharding = pivot_sharding = None
+    sharding = pivot_sharding = replicated = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
-        # Only the dense factor math (triangular solves + Schur GEMM) is
-        # sharded; every irregular scatter/gather (assembly, extend-add,
-        # pool write-back) is pinned replicated — XLA's SPMD partitioner
-        # miscompiles scatter/gather with sharded operand dims (jax 0.9.0),
-        # and these ops are bandwidth-trivial next to the GEMMs anyway.
         sharding = NamedSharding(mesh, P("snode", None, "panel"))
         pivot_sharding = NamedSharding(mesh, P("snode", None, None))
         pool_sharding = NamedSharding(mesh, P(None))
-        flat_repl = NamedSharding(mesh, P(None, None))
-    # hoist index maps to device arrays once (jit passes them as consts)
-    idx = []
-    for grp in plan.groups:
-        idx.append(tuple(jnp.asarray(a) for a in (
-            grp.pad_slot, grp.pad_flat, grp.a_slot, grp.a_flat, grp.a_src,
-            grp.e_slot, grp.e_flat, grp.e_src,
-            grp.s_slot, grp.s_src_flat, grp.s_dst)))
+        replicated = NamedSharding(mesh, P(None, None))
+    arrays = [_group_arrays(grp) for grp in plan.groups]
 
     def fn(avals, thresh):
         avals = avals.astype(dtype)
         pool = jnp.zeros(plan.pool_size, dtype=dtype)
-        if sharding is not None:
+        if mesh is not None:
             pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
-        for grp, (pad_slot, pad_flat, a_slot, a_flat, a_src,
-                  e_slot, e_flat, e_src, s_slot, s_src_flat, s_dst) in zip(
-                plan.groups, idx):
-            f = jnp.zeros((grp.batch, grp.m * grp.m), dtype=dtype)
-            if sharding is not None:
-                f = jax.lax.with_sharding_constraint(f, flat_repl)
-            if len(grp.pad_flat):
-                f = f.at[(pad_slot, pad_flat)].set(one)
-            if len(grp.a_src):
-                f = f.at[(a_slot, a_flat)].add(avals[a_src])
-            if len(grp.e_src):
-                f = f.at[(e_slot, e_flat)].add(pool[e_src])
-            f = f.reshape(grp.batch, grp.m, grp.m)
-            if sharding is not None:
-                f = jax.lax.with_sharding_constraint(f, sharding)
-            packed, counts = group_partial_factor(
-                f, thresh, grp.w, front_sharding=sharding,
-                pivot_sharding=pivot_sharding)
+        for grp, (a_slot, a_flat, a_src, ws, off, children) in zip(
+                plan.groups, arrays):
+            packed, pool, t = group_step(
+                (grp.batch, grp.m, grp.w, grp.u), avals, pool, thresh,
+                a_slot, a_flat, a_src, ws, off, children,
+                front_sharding=sharding, pivot_sharding=pivot_sharding,
+                replicated=replicated)
+            if mesh is not None:
+                pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
             fronts.append(packed)
-            tiny = tiny + counts
-            if len(grp.s_dst):
-                flat = packed.reshape(grp.batch, -1)
-                if sharding is not None:
-                    flat = jax.lax.with_sharding_constraint(flat, flat_repl)
-                pool = pool.at[s_dst].set(flat[(s_slot, s_src_flat)])
-                if sharding is not None:
-                    pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
+            tiny = tiny + t
         return tuple(fronts), tiny
 
     return jax.jit(fn)
 
 
+def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto"):
+    """Executor for a plan, cached on the plan (SamePattern reuse tier).
+
+    executor: "fused" (one XLA program — fast dispatch, compile grows with
+    plan size), "stream" (per-bucket kernels — compile count is bounded,
+    right for real TPU where program compile is expensive), or "auto"
+    (stream on accelerators, fused on CPU).
+    """
+    if executor == "auto":
+        executor = "fused" if jax.default_backend() == "cpu" else "stream"
+    cache = getattr(plan, "_factor_fns", None)
+    if cache is None:
+        cache = plan._factor_fns = {}
+    key = (str(jnp.dtype(dtype)), executor)
+    fn = cache.get(key)
+    if fn is None:
+        if executor == "stream":
+            from superlu_dist_tpu.numeric.stream import StreamExecutor
+            fn = StreamExecutor(plan, dtype)
+        else:
+            fn = make_factor_fn(plan, dtype)
+        cache[key] = fn
+    return fn
+
+
 def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       anorm: float, dtype="float64",
-                      replace_tiny: bool = True) -> NumericFactorization:
+                      replace_tiny: bool = True,
+                      executor: str = "auto") -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
@@ -140,12 +204,7 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)
     avals = jnp.asarray(pattern_values, dtype=dtype)
-    cache = getattr(plan, "_factor_fns", None)
-    if cache is None:
-        cache = plan._factor_fns = {}
-    fn = cache.get(str(dtype))
-    if fn is None:
-        fn = cache[str(dtype)] = make_factor_fn(plan, dtype)
+    fn = get_executor(plan, dtype, executor)
     fronts_out, tiny_total = fn(avals, thresh)
     fronts_out = list(fronts_out)
     finite = True

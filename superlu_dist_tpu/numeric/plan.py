@@ -3,22 +3,27 @@
 This is the TPU-native analog of the reference's *distribution* phase
 (pddistribute, SRC/pddistribute.c:322): where the reference builds
 dLocalLU_t index structures plus MPI send/recv schedules, we precompute —
-entirely on the host, once per sparsity pattern — the flat gather/scatter
-index maps that let the whole numeric factorization run as a short sequence
-of XLA ops per (level, bucket) group:
+entirely on the host, once per sparsity pattern — the gather/scatter maps
+that let the numeric factorization run as a short sequence of XLA ops per
+(level, bucket) group:
 
-  assemble:   F[slot, pos] += A_vals[a_src]          (original entries)
-              F[slot, pos] += pool[e_src]            (children's Schur pieces,
-                                                      the extend-add /
-                                                      dscatter.c:111 analog)
-  factor:     batched partial LU (ops.dense)         (the pdgstrf hot loop)
-  write-back: pool[s_dst] = F[slot, s_src]           (Schur to update pool)
+  assemble:   F[slot] += A entries            (host-built index triples)
+              F[slot] += children's Schur     (extend-add, device-computed
+                                               indices from per-child
+                                               relative-position vectors —
+                                               the dscatter.c:111 analog)
+  factor:     batched partial LU (ops.dense)  (the pdgstrf hot loop)
+  write-back: pool[off[slot]] = Schur block   (strided, device-computed)
 
 Fronts are square (symmetrized pattern): index set = supernode columns +
-below-diagonal rows, padded to bucket sizes (W for the pivot block, M
-total) so every group is one static-shape vmapped kernel.  The reference's
-GEMM aggregation-and-padding trick (dSchCompUdt-2Ddynamic.c:212-237) is the
-same idea at single-GEMM granularity; here it covers the entire level.
+below-diagonal rows, padded to bucket sizes (W for the pivot block, M = W+U
+total).  Children's Schur blocks live in a device pool as zero-padded U×U
+blocks whose offsets come from a size-class free-list allocator simulated
+at plan time — pool memory is the live tree frontier (the multifrontal
+"update stack"), not the sum over all supernodes.  Host-side index volume
+is O(nnz(A) + nnz(L)): per-entry extend-add maps are never materialized
+(they are broadcast-computed on device), which is what lets plans scale to
+n ~ 10^6 (BASELINE.md config 4).
 
 Like the reference's SamePattern path, a plan is reusable across numeric
 refactorizations with the same sparsity pattern.
@@ -30,8 +35,22 @@ import dataclasses
 
 import numpy as np
 
-from superlu_dist_tpu.sparse.formats import SparseCSR
 from superlu_dist_tpu.symbolic.symbfact import SymbolicFact
+
+
+@dataclasses.dataclass
+class ChildSet:
+    """Children of one group's fronts, bucketed by child U size.
+
+    The extend-add kernel gathers each child's padded ub×ub Schur block from
+    the pool and scatter-adds it into the parent front at positions
+    rel[c,i]·M + rel[c,j]; rel == M is the sentinel for padding (maps past
+    the front, dropped)."""
+
+    ub: int                 # child U bucket (block is ub*ub in the pool)
+    child_off: np.ndarray   # (C,) pool offset of each child block
+    child_slot: np.ndarray  # (C,) parent slot in this group
+    rel: np.ndarray         # (C, ub) child row -> parent front position
 
 
 @dataclasses.dataclass
@@ -41,23 +60,17 @@ class Group:
     level: int
     m: int                  # padded front size
     w: int                  # padded pivot width
+    u: int                  # padded Schur size (m - w); 0 => no write-back
     batch: int              # number of real fronts
     sns: np.ndarray         # supernode ids, slot order
+    ws: np.ndarray          # (batch,) real pivot widths (identity padding)
+    off: np.ndarray         # (batch,) pool offset of each front's Schur
+                            # block (pool_size => no write-back for slot)
     # assembly of original matrix entries
     a_slot: np.ndarray
     a_flat: np.ndarray
     a_src: np.ndarray
-    # identity padding for unused pivot columns
-    pad_slot: np.ndarray
-    pad_flat: np.ndarray
-    # extend-add gathers from the update pool
-    e_slot: np.ndarray
-    e_flat: np.ndarray
-    e_src: np.ndarray
-    # Schur write-back into the update pool
-    s_slot: np.ndarray
-    s_src_flat: np.ndarray
-    s_dst: np.ndarray
+    children: list          # list[ChildSet]
 
 
 @dataclasses.dataclass
@@ -67,7 +80,7 @@ class FactorPlan:
     pattern_indptr: np.ndarray     # permuted symmetrized pattern (CSR)
     pattern_indices: np.ndarray
     groups: list                   # Groups in level-ascending order
-    pool_size: int
+    pool_size: int                 # peak live Schur-pool entries
     sn_group: np.ndarray           # (ns,) group index of each supernode
     sn_slot: np.ndarray            # (ns,) slot within its group
     flops: float
@@ -95,7 +108,7 @@ def _round_to_bucket(x: int, sizes: np.ndarray) -> int:
 
 def build_plan(sf: SymbolicFact, min_bucket: int = 8,
                growth: float = 1.5) -> FactorPlan:
-    """Precompute all index maps.  Pure numpy; cost is O(nnz(L) + pool)."""
+    """Precompute all index maps.  Pure numpy; cost is O(nnz(A) + nnz(L))."""
     n = sf.n
     ns = sf.n_supernodes
     indptr, indices = sf.pattern_indptr, sf.pattern_indices
@@ -109,12 +122,6 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
     sn_W = np.array([_round_to_bucket(int(w), w_sizes) for w in widths])
     sn_U = np.array([0 if u == 0 else _round_to_bucket(int(u), u_sizes)
                      for u in us])
-    sn_M = sn_W + sn_U
-
-    # pool offsets (real u^2 strides, not padded)
-    off = np.zeros(ns + 1, dtype=np.int64)
-    np.cumsum(us * us, out=off[1:])
-    pool_size = int(off[-1])
 
     # group supernodes by (level, W, U)
     key_order = np.lexsort((sn_U, sn_W, sf.sn_level))
@@ -135,11 +142,10 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
         for slot, s in enumerate(sns):
             sn_group[s] = len(groups)
             sn_slot[s] = slot
-        groups.append(Group(level=lvl, m=W + U, w=W, batch=len(sns), sns=sns,
+        groups.append(Group(level=lvl, m=W + U, w=W, u=U, batch=len(sns),
+                            sns=sns, ws=widths[sns], off=None,
                             a_slot=None, a_flat=None, a_src=None,
-                            pad_slot=None, pad_flat=None,
-                            e_slot=None, e_flat=None, e_src=None,
-                            s_slot=None, s_src_flat=None, s_dst=None))
+                            children=[]))
         i = j
 
     # position helper: global index x within front of supernode s
@@ -177,42 +183,44 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
         ga_flat[g].append(pi * M + pj)
         ga_src[g].append(sel)
 
-    # --- identity padding + extend-add + write-back maps ------------------
-    ge_slot = [[] for _ in groups]
-    ge_flat = [[] for _ in groups]
-    ge_src = [[] for _ in groups]
-    gs_slot = [[] for _ in groups]
-    gs_srcf = [[] for _ in groups]
-    gs_dst = [[] for _ in groups]
-    gp_slot = [[] for _ in groups]
-    gp_flat = [[] for _ in groups]
-    for s in range(ns):
-        g = sn_group[s]
-        grp = groups[g]
-        M, W = grp.m, grp.w
-        w, u = int(widths[s]), int(us[s])
-        slot = sn_slot[s]
-        if w < W:
-            ks = np.arange(w, W, dtype=np.int64)
-            gp_slot[g].append(np.full(len(ks), slot, dtype=np.int64))
-            gp_flat[g].append(ks * M + ks)
-        if u > 0:
-            # write-back of the real u×u Schur block into the pool
-            kk = np.arange(u, dtype=np.int64)
-            src = ((W + kk)[:, None] * M + (W + kk)[None, :]).ravel()
-            gs_slot[g].append(np.full(u * u, slot, dtype=np.int64))
-            gs_srcf[g].append(src)
-            gs_dst[g].append(off[s] + np.arange(u * u, dtype=np.int64))
-            # extend-add into the parent front
+    # --- pool allocation (size-class free lists) --------------------------
+    # Simulated in group execution order: a group's extend-add consumes its
+    # children's blocks (freed), then its own Schur blocks are written
+    # (allocated) — the multifrontal update-stack discipline, batched.
+    free: dict[int, list] = {}
+    top = 0
+
+    def alloc(size: int) -> int:
+        nonlocal top
+        lst = free.get(size)
+        if lst:
+            return lst.pop()
+        off = top
+        top += size
+        return off
+
+    sn_off = np.empty(ns, dtype=np.int64)
+    # children of each group, bucketed by child U size
+    grp_children: list[dict[int, list]] = [dict() for _ in groups]
+    for g, grp in enumerate(groups):
+        # free children blocks (they are fully consumed by this group)
+        for ub, lst in grp_children[g].items():
+            for (c, _) in lst:
+                free.setdefault(ub * ub, []).append(sn_off[c])
+        # allocate this group's blocks and register with parents
+        for slot, s in enumerate(grp.sns):
+            if us[s] == 0:
+                sn_off[s] = -1
+                continue
+            ub = int(sn_U[s])
+            sn_off[s] = alloc(ub * ub)
             p = int(sf.sn_parent[s])
             assert p >= 0
-            gp_ = sn_group[p]
-            pgrp = groups[gp_]
-            posp = positions(p, sf.sn_rows[s])
-            eflat = (posp[:, None] * pgrp.m + posp[None, :]).ravel()
-            ge_slot[gp_].append(np.full(u * u, sn_slot[p], dtype=np.int64))
-            ge_flat[gp_].append(eflat)
-            ge_src[gp_].append(off[s] + np.arange(u * u, dtype=np.int64))
+            gp = int(sn_group[p])
+            assert gp > g, "parent group must execute after child"
+            grp_children[gp].setdefault(ub, []).append((s, p))
+
+    pool_size = int(top)
 
     def cat(lst, dtype=np.int64):
         return (np.concatenate(lst).astype(dtype) if lst
@@ -220,10 +228,20 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
 
     front_bytes = 0
     for g, grp in enumerate(groups):
-        grp.a_slot, grp.a_flat, grp.a_src = cat(ga_slot[g]), cat(ga_flat[g]), cat(ga_src[g])
-        grp.pad_slot, grp.pad_flat = cat(gp_slot[g]), cat(gp_flat[g])
-        grp.e_slot, grp.e_flat, grp.e_src = cat(ge_slot[g]), cat(ge_flat[g]), cat(ge_src[g])
-        grp.s_slot, grp.s_src_flat, grp.s_dst = cat(gs_slot[g]), cat(gs_srcf[g]), cat(gs_dst[g])
+        grp.a_slot, grp.a_flat, grp.a_src = (
+            cat(ga_slot[g]), cat(ga_flat[g]), cat(ga_src[g]))
+        grp.off = np.where(us[grp.sns] > 0, sn_off[grp.sns], pool_size)
+        for ub, lst in sorted(grp_children[g].items()):
+            C = len(lst)
+            child_off = np.empty(C, dtype=np.int64)
+            child_slot = np.empty(C, dtype=np.int64)
+            rel = np.full((C, ub), grp.m, dtype=np.int64)   # sentinel = M
+            for k, (c, p) in enumerate(lst):
+                child_off[k] = sn_off[c]
+                child_slot[k] = sn_slot[p]
+                rel[k, :us[c]] = positions(p, sf.sn_rows[c])
+            grp.children.append(ChildSet(ub=ub, child_off=child_off,
+                                         child_slot=child_slot, rel=rel))
         front_bytes += grp.batch * grp.m * grp.m
 
     return FactorPlan(n=n, sf=sf, pattern_indptr=indptr,

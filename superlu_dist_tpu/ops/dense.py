@@ -134,8 +134,7 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
     f11_in = fronts[:, :w, :w]
     if pivot_sharding is not None:
         f11_in = wsc(f11_in, pivot_sharding)
-    f11, counts = jax.vmap(lambda x: lu_nopivot(x, thresh))(f11_in)
-    tiny = jnp.sum(counts)
+    f11, tiny = jax.vmap(lambda x: lu_nopivot(x, thresh))(f11_in)
     if w == m:
         if front_sharding is not None:
             f11 = wsc(f11, front_sharding)

@@ -52,6 +52,18 @@ def test_sharded_factor_matches_single_device(shape):
                                    rtol=1e-12, atol=1e-12)
 
 
+def test_stream_matches_fused():
+    plan, avals, thresh = _plan()
+    fused = make_factor_fn(plan, "float64")
+    rf, rt = fused(jnp.asarray(avals), jnp.asarray(thresh))
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    ex = StreamExecutor(plan, "float64")
+    gf, gt = ex(jnp.asarray(avals), jnp.asarray(thresh))
+    assert int(gt) == int(rt)
+    for a, b in zip(gf, rf):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
 def test_graft_dryrun():
     import importlib.util
     import os
