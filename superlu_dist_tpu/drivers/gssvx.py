@@ -62,6 +62,8 @@ class LUFactorization:
     berrs: list = None        # backward errors of the last refinement
     a_sym_indptr: np.ndarray = None    # symmetrized pattern the symbolic
     a_sym_indices: np.ndarray = None   # factorization was built on
+    dev_solver: object = None          # lazy DeviceSolver (SolveInitialized
+                                       # analog, pdgssvx.c:1330-1337)
 
     # -- combined transforms --------------------------------------------------
     @property
@@ -78,14 +80,28 @@ class LUFactorization:
         return self.row_order[self.sf.perm]
 
     def solve_factored(self, b: np.ndarray) -> np.ndarray:
-        """Solve A·x = b through the factored M (no refinement)."""
+        """Solve A·x = b through the factored M (no refinement).
+
+        On an accelerator backend the triangular solves run device-side
+        (solve/device.py, the pdgstrs analog) so the factors never cross
+        the host boundary; on CPU the host supernodal solve is used (f64,
+        which also serves the refinement's correction solves)."""
         b = np.asarray(b)
         d = b * (self.R[:, None] if b.ndim > 1 else self.R)
         d = d[self.sigma]
-        z_hat = lu_solve(self.numeric, d)
+        z_hat = self._solve_permuted(d)
         z = np.empty_like(z_hat)
         z[self.sf.perm] = z_hat
         return z * (self.C[:, None] if b.ndim > 1 else self.C)
+
+    def _solve_permuted(self, d: np.ndarray) -> np.ndarray:
+        import jax
+        if jax.default_backend() != "cpu":
+            if self.dev_solver is None:
+                from superlu_dist_tpu.solve.device import DeviceSolver
+                self.dev_solver = DeviceSolver(self.numeric)
+            return self.dev_solver.solve(d)
+        return lu_solve(self.numeric, d)
 
 
 def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
