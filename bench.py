@@ -90,23 +90,37 @@ def main():
     t_dev, (fronts, tiny) = _time_factor(ex, avals, thresh, REPS)
     gflops = plan.flops / t_dev / 1e9
 
+    # Everything past this point (solve, residual, CPU baseline) must not
+    # be able to zero the factor GFLOPS: each phase degrades independently
+    # and the JSON line always prints (the pdtest harness likewise counts
+    # failures and still reports, TEST/pdtest.c).
+    residual = solve_path = None
     # residual through the full solve + f64 iterative refinement (GESP
     # semantics: f32 factors, refined solution; pdgsrfs.c:120) — via the
     # driver's own solve path (no equil/rowperm: identity transforms)
-    numeric = NumericFactorization(plan=plan, fronts=list(fronts),
-                                   tiny_pivots=int(tiny), dtype=jnp.dtype(DTYPE))
-    n = a.n_rows
-    ones = np.ones(n)
-    ident = np.arange(n, dtype=np.int64)
-    lu = LUFactorization(n=n, options=Options(), equed="N", dr=ones, dc=ones,
-                         r1=ones, c1=ones, row_order=ident,
-                         col_order=None, sf=sf, plan=plan, numeric=numeric,
-                         a=a)
-    xt = np.random.default_rng(0).standard_normal(n)
-    b = a.matvec(xt)
-    x, _ = iterative_refinement(a, b, lu.solve_factored(b), lu.solve_factored)
-    residual = float(np.linalg.norm(b - a.matvec(x))
-                     / max(np.linalg.norm(b), 1e-300))
+    try:
+        numeric = NumericFactorization(plan=plan, fronts=list(fronts),
+                                       tiny_pivots=int(tiny),
+                                       dtype=jnp.dtype(DTYPE))
+        n = a.n_rows
+        ones = np.ones(n)
+        ident = np.arange(n, dtype=np.int64)
+        lu = LUFactorization(n=n, options=Options(), equed="N", dr=ones,
+                             dc=ones, r1=ones, c1=ones, row_order=ident,
+                             col_order=None, sf=sf, plan=plan,
+                             numeric=numeric, a=a)
+        xt = np.random.default_rng(0).standard_normal(n)
+        b = a.matvec(xt)
+        x, _ = iterative_refinement(a, b, lu.solve_factored(b),
+                                    lu.solve_factored)
+        residual = float(np.linalg.norm(b - a.matvec(x))
+                         / max(np.linalg.norm(b), 1e-300))
+        solve_path = ("device" if lu.solve_path == "auto"
+                      and backend != "cpu" else "host")
+        if lu.solve_path == "host" and backend != "cpu":
+            solve_path = "host-fallback"
+    except Exception as e:                   # pragma: no cover
+        solve_path = f"failed: {type(e).__name__}: {e}"
 
     # Baseline: serial SuperLU (same code family as the reference) with
     # host CPU BLAS, factoring the identical matrix
@@ -117,7 +131,7 @@ def main():
                           shape=(a.n_rows, a.n_rows)).tocsc()
         t_cpu = min(_timeit(lambda: splu(A)) for _ in range(2))
         vs_baseline = round(t_cpu / t_dev, 2)
-    except ImportError:                      # pragma: no cover
+    except Exception:                        # pragma: no cover
         t_cpu = vs_baseline = None
 
     print(json.dumps({
@@ -129,6 +143,7 @@ def main():
         "baseline": "scipy.splu (serial SuperLU, f64, host BLAS), same matrix",
         "baseline_seconds": t_cpu,
         "residual": residual,
+        "solve_path": solve_path,
         "factor_seconds": t_dev,
         "flops": plan.flops,
         "tiny_pivots": int(tiny),

@@ -27,16 +27,16 @@ import numpy as np
 
 from superlu_dist_tpu.sparse.formats import SparseCSR, symmetrize_pattern
 from superlu_dist_tpu.utils.options import (
-    Options, Fact, RowPerm, IterRefine, default_factor_dtype)
+    Options, Fact, RowPerm, IterRefine, Trans, default_factor_dtype)
 from superlu_dist_tpu.utils.stats import Stats
-from superlu_dist_tpu.utils.errors import SuperLUError
+from superlu_dist_tpu.utils.errors import SuperLUError, SingularMatrixError
 from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
 from superlu_dist_tpu.rowperm.matching import maximum_product_matching
 from superlu_dist_tpu.ordering.dispatch import get_perm_c
 from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize, SymbolicFact
 from superlu_dist_tpu.numeric.plan import build_plan, FactorPlan
 from superlu_dist_tpu.numeric.factor import numeric_factorize, NumericFactorization
-from superlu_dist_tpu.solve.trisolve import lu_solve
+from superlu_dist_tpu.solve.trisolve import lu_solve, lu_solve_trans
 from superlu_dist_tpu.refine.ir import iterative_refinement
 
 
@@ -64,6 +64,12 @@ class LUFactorization:
     a_sym_indices: np.ndarray = None   # factorization was built on
     dev_solver: object = None          # lazy DeviceSolver (SolveInitialized
                                        # analog, pdgssvx.c:1330-1337)
+    solve_path: str = "auto"           # "auto" | "host" | "device"; "auto"
+                                       # falls back to host if the device
+                                       # solve ever fails (robustness over
+                                       # crash — the pdtest harness survives
+                                       # partial failures, TEST/pdtest.c)
+    solve_fallback_reason: str = None  # why the device path was abandoned
 
     # -- combined transforms --------------------------------------------------
     @property
@@ -86,6 +92,8 @@ class LUFactorization:
         (solve/device.py, the pdgstrs analog) so the factors never cross
         the host boundary; on CPU the host supernodal solve is used (f64,
         which also serves the refinement's correction solves)."""
+        if not self.numeric.finite:
+            raise SingularMatrixError(self.numeric.info_col)
         b = np.asarray(b)
         d = b * (self.R[:, None] if b.ndim > 1 else self.R)
         d = d[self.sigma]
@@ -94,13 +102,48 @@ class LUFactorization:
         z[self.sf.perm] = z_hat
         return z * (self.C[:, None] if b.ndim > 1 else self.C)
 
+    def solve_factored_trans(self, b: np.ndarray,
+                             conj: bool = False) -> np.ndarray:
+        """Solve Aᵀ·x = b (or Aᴴ·x with conj) through the same factors.
+
+        The reference's trans_t path (superlu_defs.h:628-657): with
+        M = P_σ·diag(R)·A·diag(C)·P_πᵀ the transpose system becomes
+        Mᵀ·(P_σ (x⊘R)) = P_π (C ⊙ b) — same transforms, mirrored order,
+        solved via Uᵀ then Lᵀ sweeps (solve/trisolve.lu_solve_trans)."""
+        if not self.numeric.finite:
+            raise SingularMatrixError(self.numeric.info_col)
+        b = np.asarray(b)
+        C = self.C[:, None] if b.ndim > 1 else self.C
+        R = self.R[:, None] if b.ndim > 1 else self.R
+        d = (b * C)[self.sf.perm]
+        w_hat = lu_solve_trans(self.numeric, d, conj=conj)
+        w = np.empty_like(w_hat)
+        w[self.sigma] = w_hat
+        return w * R
+
     def _solve_permuted(self, d: np.ndarray) -> np.ndarray:
         import jax
-        if jax.default_backend() != "cpu":
-            if self.dev_solver is None:
-                from superlu_dist_tpu.solve.device import DeviceSolver
-                self.dev_solver = DeviceSolver(self.numeric)
-            return self.dev_solver.solve(d)
+        use_device = (self.solve_path == "device"
+                      or (self.solve_path == "auto"
+                          and jax.default_backend() != "cpu"))
+        if use_device:
+            try:
+                if self.dev_solver is None:
+                    from superlu_dist_tpu.solve.device import DeviceSolver
+                    self.dev_solver = DeviceSolver(self.numeric)
+                return self.dev_solver.solve(d)
+            except Exception as e:
+                if self.solve_path != "auto":
+                    raise
+                # device path failed — permanently fall back to the host
+                # solve for this factorization rather than crash the run,
+                # but leave a diagnosable trace (reason + warning)
+                import warnings
+                self.solve_path = "host"
+                self.solve_fallback_reason = f"{type(e).__name__}: {e}"
+                warnings.warn("device triangular solve failed; falling back "
+                              f"to host solve ({self.solve_fallback_reason})",
+                              RuntimeWarning, stacklevel=2)
         return lu_solve(self.numeric, d)
 
 
@@ -176,13 +219,18 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         else:
             col_order = get_perm_c(options, a2, sym)
 
-    # ---- SYMBFACT (pdgssvx.c:1034-1118) ------------------------------------
+    # ---- ETREE + SYMBFACT (pdgssvx.c:1034-1118) ----------------------------
+    et0 = stats.utime["ETREE"]
     with stats.timer("SYMBFACT"):
         if reuse_symbolic:
             sf = lu.sf
         else:
             sf = symbolic_factorize(sym, col_order, relax=options.relax,
-                                    max_supernode=options.max_supernode)
+                                    max_supernode=options.max_supernode,
+                                    stats=stats)
+    # phases are disjoint like the reference's PhaseType: the etree part
+    # timed inside symbolic_factorize is carved out of SYMBFACT
+    stats.utime["SYMBFACT"] -= stats.utime["ETREE"] - et0
 
     # ---- DIST / plan (pdgssvx.c:1132-1166) ---------------------------------
     with stats.timer("DIST"):
@@ -215,6 +263,12 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
             f.block_until_ready()
     stats.ops["FACT"] += plan.flops
     stats.tiny_pivots += numeric.tiny_pivots
+    # memory observability (dQuerySpace_dist analog, SRC/dmemory_dist.c:73)
+    from superlu_dist_tpu.numeric.factor import query_space
+    space = query_space(numeric)
+    stats.observe_memory(space["total_bytes"])
+    stats.for_lu_bytes = space["for_lu_bytes"]
+    stats.pool_bytes = space["pool_bytes"]
 
     lu = LUFactorization(n=n, options=options, equed=equed, dr=dr, dc=dc,
                          r1=r1, c1=c1, row_order=row_order,
@@ -222,25 +276,46 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
                          numeric=numeric, anorm=anorm, a=a,
                          a_sym_indptr=sym.indptr, a_sym_indices=sym.indices)
     if not numeric.finite:
-        # exactly singular U and no tiny-pivot replacement: the reference
-        # returns the first zero-pivot index (pdgstrf.c:1920-1924); we flag
-        # singularity without localizing it (info = n+1 convention would lie)
-        return None, lu, stats, 1
+        # exactly singular U and no tiny-pivot replacement: info is the
+        # 1-based first zero-pivot column, like the reference's Allreduce-MIN
+        # of the first i with U(i,i)==0 (pdgstrf.c:1920-1924)
+        return None, lu, stats, numeric.info_col + 1
     return _solve_and_refine(options, a, b, lu, stats)
 
 
 def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                       lu: LUFactorization, stats: Stats):
     n = a.n_rows
+    # trans dispatch (reference trans_t, superlu_defs.h:628-657): TRANS and
+    # CONJ solve AᵀX=B / AᴴX=B through the same factors; refinement then
+    # needs the transposed operator for its residual SpMV
+    trans = options.trans
+    if trans == Trans.NOTRANS:
+        solve_fn, op = lu.solve_factored, a
+    else:
+        conj = trans == Trans.CONJ and np.issubdtype(
+            a.data.dtype, np.complexfloating)
+        solve_fn = lambda rhs: lu.solve_factored_trans(rhs, conj=conj)  # noqa: E731
+        op = a.transpose()
+        if conj:
+            op = SparseCSR(op.n_rows, op.n_cols, op.indptr, op.indices,
+                           op.data.conj())
     with stats.timer("SOLVE"):
-        x = lu.solve_factored(b)
+        x = solve_fn(b)
     nrhs = 1 if b.ndim == 1 else b.shape[1]
     stats.ops["SOLVE"] += 4.0 * lu.sf.nnz_L * nrhs  # fwd+back L,U sweeps
 
     info = 0
     if options.iter_refine != IterRefine.NOREFINE:
+        # SLU_SINGLE rounds the residual/correction to f32 (refinement
+        # stops at single eps); SLU_DOUBLE uses options.ir_dtype (f64
+        # default) — the reference's IterRefine tiers
+        residual_dtype = (np.float32
+                          if options.iter_refine == IterRefine.SLU_SINGLE
+                          else np.dtype(options.ir_dtype))
         with stats.timer("REFINE"):
-            x, berrs = iterative_refinement(a, b, x, lu.solve_factored)
+            x, berrs = iterative_refinement(op, b, x, solve_fn,
+                                            residual_dtype=residual_dtype)
         stats.refine_steps += len(berrs)
         lu.berrs = berrs
     if options.print_stat:

@@ -1,0 +1,185 @@
+"""ctypes seam to the native host-analysis library (slu_host.cpp).
+
+The reference's host analysis is C (SRC/etree.c, symbfact.c, mc64ad_dist.c,
+get_perm_c.c); ours is C++ compiled on first use with the toolchain baked
+into the image.  Python implementations remain the specification and the
+fallback: every entry point here degrades gracefully when the compiler is
+unavailable, and the test suite cross-checks native vs Python output.
+
+Set SLU_TPU_NO_NATIVE=1 to force the Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "slu_host.cpp")
+_LIB = os.path.join(_HERE, "_slu_host.so")
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_I64 = ctypes.POINTER(ctypes.c_int64)
+_F64 = ctypes.POINTER(ctypes.c_double)
+
+
+def _build() -> str | None:
+    """Compile the shared library if missing or stale; return path or None."""
+    try:
+        if (os.path.exists(_LIB)
+                and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
+            return _LIB
+        # per-process tmp name: concurrent first-use builds (pytest workers,
+        # bench + tests) must not interleave writes; os.replace is atomic
+        tmp = f"{_LIB}.{os.getpid()}.tmp"
+        subprocess.run(
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+             "-o", tmp, _SRC],
+            check=True, capture_output=True, timeout=300)
+        os.replace(tmp, _LIB)
+        return _LIB
+    except Exception:
+        return None
+
+
+def _load():
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("SLU_TPU_NO_NATIVE"):
+            return None
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(path)
+            lib.slu_etree.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
+            lib.slu_postorder.argtypes = [ctypes.c_int64, _I64, _I64]
+            lib.slu_symbolic.restype = ctypes.c_int64
+            lib.slu_symbolic.argtypes = [
+                ctypes.c_int64, _I64, _I64, _I64, ctypes.c_int64,
+                ctypes.c_int64, _I64, _I64, _I64, _I64, _I64,
+                ctypes.POINTER(_I64)]
+            lib.slu_free_i64.argtypes = [_I64]
+            lib.slu_mc64.restype = ctypes.c_int
+            lib.slu_mc64.argtypes = [ctypes.c_int64, _I64, _I64, _F64,
+                                     _I64, _F64, _F64]
+            lib.slu_mlnd.argtypes = [ctypes.c_int64, _I64, _I64,
+                                     ctypes.c_int64, ctypes.c_uint64, _I64]
+            _lib = lib
+        except Exception:
+            _lib = None
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _as_i64(a: np.ndarray) -> np.ndarray:
+    return np.ascontiguousarray(a, dtype=np.int64)
+
+
+def _ptr_i64(a: np.ndarray):
+    return a.ctypes.data_as(_I64)
+
+
+def _ptr_f64(a: np.ndarray):
+    return a.ctypes.data_as(_F64)
+
+
+def etree(n: int, indptr: np.ndarray, indices: np.ndarray):
+    """Native etree; returns parent array or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    parent = np.empty(n, dtype=np.int64)
+    lib.slu_etree(n, _ptr_i64(indptr), _ptr_i64(indices), _ptr_i64(parent))
+    return parent
+
+
+def postorder(parent: np.ndarray):
+    lib = _load()
+    if lib is None:
+        return None
+    parent = _as_i64(parent)
+    n = len(parent)
+    post = np.empty(n, dtype=np.int64)
+    lib.slu_postorder(n, _ptr_i64(parent), _ptr_i64(post))
+    return post
+
+
+def symbolic(n: int, indptr, indices, parent, relax: int, max_supernode: int):
+    """Native supernodal symbolic.  Returns (sn_start, col_to_sn, sn_parent,
+    sn_level, rows_ptr, rows_data) or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    parent = _as_i64(parent)
+    sn_start = np.empty(n + 1, dtype=np.int64)
+    col_to_sn = np.empty(n, dtype=np.int64)
+    sn_parent = np.empty(n, dtype=np.int64)
+    sn_level = np.empty(n, dtype=np.int64)
+    rows_ptr = np.empty(n + 1, dtype=np.int64)
+    rows_data_p = _I64()
+    ns = lib.slu_symbolic(n, _ptr_i64(indptr), _ptr_i64(indices),
+                          _ptr_i64(parent), relax, max_supernode,
+                          _ptr_i64(sn_start), _ptr_i64(col_to_sn),
+                          _ptr_i64(sn_parent), _ptr_i64(sn_level),
+                          _ptr_i64(rows_ptr), ctypes.byref(rows_data_p))
+    if ns < 0:
+        return None
+    total = int(rows_ptr[ns])
+    rows_data = np.ctypeslib.as_array(rows_data_p, shape=(max(total, 1),))[
+        :total].copy()
+    lib.slu_free_i64(rows_data_p)
+    return (sn_start[:ns + 1].copy(), col_to_sn, sn_parent[:ns].copy(),
+            sn_level[:ns].copy(), rows_ptr[:ns + 1].copy(), rows_data)
+
+
+def mc64(n: int, indptr, indices, absval):
+    """Native MC64 job=5.  Returns (col_match, u, v) or None if unavailable.
+    Raises ValueError on structural singularity."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    absval = np.ascontiguousarray(absval, dtype=np.float64)
+    col_match = np.empty(n, dtype=np.int64)
+    u = np.empty(n, dtype=np.float64)
+    v = np.empty(n, dtype=np.float64)
+    rc = lib.slu_mc64(n, _ptr_i64(indptr), _ptr_i64(indices),
+                      _ptr_f64(absval), _ptr_i64(col_match), _ptr_f64(u),
+                      _ptr_f64(v))
+    if rc != 0:
+        raise ValueError("structurally singular")
+    return col_match, u, v
+
+
+def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1):
+    """Native multilevel nested dissection; returns order or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    order = np.empty(n, dtype=np.int64)
+    lib.slu_mlnd(n, _ptr_i64(indptr), _ptr_i64(indices), leaf_size, seed,
+                 _ptr_i64(order))
+    return order

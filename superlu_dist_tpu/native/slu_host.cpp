@@ -1,0 +1,725 @@
+// Native host-analysis kernels for the TPU-native SuperLU_DIST framework.
+//
+// The reference implements its host analysis in C (SRC/etree.c, symbfact.c,
+// mc64ad_dist.c, get_perm_c.c + METIS); the Python twins in this package are
+// the specification and test oracle, but cannot reach the n≈1M problem class
+// (BASELINE.md config 4).  This library provides drop-in accelerated
+// versions behind a ctypes seam (superlu_dist_tpu/native/__init__.py):
+//
+//   slu_etree      — Liu's elimination-tree algorithm with path compression
+//                    (analog of sp_coletree_dist, SRC/etree.c:222)
+//   slu_postorder  — iterative DFS postorder (TreePostorder_dist analog)
+//   slu_symbolic   — relaxed-supernode partition + bottom-up supernodal row
+//                    structures + zero-fill chain merging (analog of
+//                    symbfact/relax_snode, SRC/symbfact.c:80,224) — exact
+//                    mirror of symbolic/symbfact.py semantics
+//   slu_mc64       — maximum-product bipartite matching with LP duals
+//                    ("MC64 job=5", analog of SRC/mc64ad_dist.c:121) — exact
+//                    mirror of rowperm/matching.py
+//   slu_mlnd       — multilevel nested dissection (coarsen → bisect → FM
+//                    refine → project) with vertex separators; the
+//                    METIS_AT_PLUS_A-quality general-graph ordering
+//                    (analog of SRC/get_perm_c.c:90,463-530)
+//
+// All indices are int64 (the XSDK_INDEX_SIZE=64 configuration of the
+// reference, superlu_defs.h:80-93): nnz(L) > 2^31 is reachable at the
+// target problem class.
+//
+// Build: g++ -O3 -shared -fPIC (see native/__init__.py; no external deps).
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <queue>
+#include <random>
+#include <utility>
+#include <vector>
+
+using i64 = int64_t;
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Elimination tree (Liu's algorithm, path compression).  Pattern must be
+// structurally symmetric; only entries j < i of row i are used.
+// ---------------------------------------------------------------------------
+void slu_etree(i64 n, const i64* indptr, const i64* indices, i64* parent) {
+  std::vector<i64> ancestor(n, -1);
+  for (i64 i = 0; i < n; ++i) parent[i] = -1;
+  for (i64 i = 0; i < n; ++i) {
+    for (i64 p = indptr[i]; p < indptr[i + 1]; ++p) {
+      i64 j = indices[p];
+      while (j != -1 && j < i) {
+        i64 nxt = ancestor[j];
+        ancestor[j] = i;
+        if (nxt == -1) {
+          parent[j] = i;
+          break;
+        }
+        j = nxt;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Postorder of the forest: children before parents, smallest-numbered child
+// first, roots in natural order.  post[k] = node visited k-th.
+// ---------------------------------------------------------------------------
+void slu_postorder(i64 n, const i64* parent, i64* post) {
+  // children lists via counting sort (stable => ascending child ids)
+  std::vector<i64> child_cnt(n + 1, 0);
+  for (i64 j = 0; j < n; ++j)
+    if (parent[j] >= 0) child_cnt[parent[j] + 1]++;
+  std::vector<i64> child_ptr(n + 1, 0);
+  for (i64 j = 0; j < n; ++j) child_ptr[j + 1] = child_ptr[j] + child_cnt[j + 1];
+  std::vector<i64> child_list(child_ptr[n]);
+  {
+    std::vector<i64> fill(child_ptr.begin(), child_ptr.end() - 1);
+    for (i64 j = 0; j < n; ++j)
+      if (parent[j] >= 0) child_list[fill[parent[j]]++] = j;
+  }
+  // iterative DFS; stack entries: (node, next-child cursor)
+  i64 out = 0;
+  std::vector<std::pair<i64, i64>> stack;
+  stack.reserve(64);
+  for (i64 r = 0; r < n; ++r) {
+    if (parent[r] != -1) continue;
+    stack.emplace_back(r, child_ptr[r]);
+    while (!stack.empty()) {
+      auto& top = stack.back();
+      if (top.second < child_ptr[top.first + 1]) {
+        i64 c = child_list[top.second++];
+        stack.emplace_back(c, child_ptr[c]);
+      } else {
+        post[out++] = top.first;
+        stack.pop_back();
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Supernodal symbolic factorization on a postordered symmetric pattern.
+// Mirror of symbolic/symbfact.py: relaxed leaf subtrees (<= relax cols),
+// bottom-up per-supernode row structures, zero-fill chain merging capped at
+// max_supernode.  Returns ns (supernode count) or -1 on error.
+// Outputs (caller-allocated): sn_start (n+1), col_to_sn (n), sn_parent (n),
+// sn_level (n), rows_ptr (n+1).  rows_data is malloc'd here (size
+// rows_ptr[ns]); caller frees via slu_free_i64.
+// ---------------------------------------------------------------------------
+i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
+                 const i64* parent, i64 relax, i64 max_supernode,
+                 i64* sn_start, i64* col_to_sn, i64* sn_parent,
+                 i64* sn_level, i64* rows_ptr, i64** rows_data) {
+  if (relax > max_supernode) relax = max_supernode;
+  // subtree counts (postordered labels: children have smaller ids)
+  std::vector<i64> cnt(n, 1);
+  for (i64 j = 0; j < n; ++j)
+    if (parent[j] >= 0) cnt[parent[j]] += cnt[j];
+  // relaxed roots -> contiguous leading partition
+  std::vector<i64> first, last;
+  first.reserve(n / (relax > 0 ? relax : 1) + 16);
+  for (i64 j = 0; j < n;) {
+    bool relaxed_root = false;
+    // find whether some relaxed root r has its subtree starting at j; the
+    // subtree of r covers [r-cnt[r]+1, r]: scan upward from j while counts
+    // allow.  Equivalent to python's precomputed flag per node; here walk
+    // the chain: r = j + ... cheapest: check each candidate root r >= j with
+    // r - cnt[r] + 1 == j and cnt[r] <= relax, take the largest such r.
+    // Since subtrees are nested, walk ancestors of j while they start at j.
+    i64 r = j;
+    i64 best = -1;
+    while (r < n && r - cnt[r] + 1 == j) {
+      bool is_root = (cnt[r] <= relax) &&
+                     (parent[r] < 0 || cnt[parent[r]] > relax);
+      if (is_root) best = r;
+      if (parent[r] < 0) break;
+      r = parent[r];
+      if (r - cnt[r] + 1 != j) break;
+    }
+    first.push_back(j);
+    if (best >= 0) {
+      relaxed_root = true;
+      j = best + 1;
+    } else {
+      j += 1;
+    }
+    last.push_back(j - 1);
+    (void)relaxed_root;
+  }
+  i64 ns0 = (i64)first.size();
+  std::vector<i64> c2s0(n);
+  for (i64 s = 0; s < ns0; ++s)
+    for (i64 j = first[s]; j <= last[s]; ++j) c2s0[j] = s;
+
+  std::vector<std::vector<i64>> rows_of(ns0);
+  std::vector<std::vector<i64>> kids(ns0);
+  std::vector<char> alive(ns0, 1);
+  // live supernode by last column
+  std::vector<i64> by_last(n, -1);
+  for (i64 s = 0; s < ns0; ++s) by_last[last[s]] = s;
+
+  std::vector<i64> buf;
+  // stamp array dedups row indices BEFORE sorting: sibling children share
+  // most of their row structure (ancestor separators), so this cuts the
+  // sort volume by the average multiplicity — the dominant cost at n~1e6
+  std::vector<i64> stamp(n, -1);
+  for (i64 s = 0; s < ns0; ++s) {
+    i64 l = last[s];
+    buf.clear();
+    auto push = [&](i64 r) {
+      if (stamp[r] != s) {
+        stamp[r] = s;
+        buf.push_back(r);
+      }
+    };
+    for (i64 j = first[s]; j <= l; ++j)
+      for (i64 p = indptr[j]; p < indptr[j + 1]; ++p)
+        if (indices[p] > l) push(indices[p]);
+    for (i64 g : kids[s])
+      for (i64 r : rows_of[g])
+        if (r > l) push(r);
+    std::sort(buf.begin(), buf.end());
+    rows_of[s] = buf;
+    // chain-merge predecessors while zero fill and within max_supernode
+    while (true) {
+      if (first[s] == 0) break;
+      i64 c = by_last[first[s] - 1];
+      if (c < 0 || !alive[c]) break;
+      if (last[s] - first[c] + 1 > max_supernode) break;
+      const auto& rc = rows_of[c];
+      if (rc.empty() || rc[0] != first[s] ||
+          (i64)rc.size() != (last[s] - first[s] + 1) + (i64)rows_of[s].size())
+        break;
+      by_last[last[c]] = -1;
+      alive[c] = 0;
+      first[s] = first[c];
+    }
+    if (!rows_of[s].empty()) kids[c2s0[rows_of[s][0]]].push_back(s);
+  }
+
+  // compact to live supernodes
+  i64 ns = 0;
+  std::vector<i64> live;
+  live.reserve(ns0);
+  for (i64 s = 0; s < ns0; ++s)
+    if (alive[s]) live.push_back(s);
+  ns = (i64)live.size();
+  i64 total_rows = 0;
+  for (i64 k = 0; k < ns; ++k) {
+    sn_start[k] = first[live[k]];
+    total_rows += (i64)rows_of[live[k]].size();
+  }
+  sn_start[ns] = n;
+  for (i64 k = 0; k < ns; ++k)
+    for (i64 j = sn_start[k]; j < sn_start[k + 1]; ++j) col_to_sn[j] = k;
+  i64* rd = (i64*)std::malloc(sizeof(i64) * (total_rows ? total_rows : 1));
+  if (!rd) return -1;
+  i64 off = 0;
+  for (i64 k = 0; k < ns; ++k) {
+    rows_ptr[k] = off;
+    const auto& r = rows_of[live[k]];
+    std::memcpy(rd + off, r.data(), sizeof(i64) * r.size());
+    off += (i64)r.size();
+  }
+  rows_ptr[ns] = off;
+  *rows_data = rd;
+  for (i64 k = 0; k < ns; ++k) {
+    sn_parent[k] = rows_ptr[k] < rows_ptr[k + 1] ? col_to_sn[rd[rows_ptr[k]]] : -1;
+    sn_level[k] = 0;
+  }
+  for (i64 k = 0; k < ns; ++k) {
+    i64 p = sn_parent[k];
+    if (p >= 0 && sn_level[p] < sn_level[k] + 1) sn_level[p] = sn_level[k] + 1;
+  }
+  return ns;
+}
+
+void slu_free_i64(i64* p) { std::free(p); }
+
+// ---------------------------------------------------------------------------
+// MC64 job=5: maximum-product matching + scalings via successive shortest
+// augmenting paths with potentials.  Inputs: CSC pattern, |a| values.
+// cost[k] = log(colmax_j) - log|a_k| (>= 0, +inf for zeros — excluded).
+// Outputs: col_match (col -> row, the row_order), u (col duals), v (row
+// duals).  Returns 0 ok, 1 structurally singular.
+// ---------------------------------------------------------------------------
+int slu_mc64(i64 n, const i64* indptr, const i64* indices,
+             const double* absval, i64* col_match_out, double* u, double* v) {
+  const double INF = 1e300;
+  std::vector<double> cost(indptr[n]);
+  std::vector<double> colmax(n, 0.0);
+  for (i64 j = 0; j < n; ++j)
+    for (i64 k = indptr[j]; k < indptr[j + 1]; ++k)
+      colmax[j] = std::max(colmax[j], absval[k]);
+  for (i64 j = 0; j < n; ++j) {
+    if (colmax[j] == 0.0) return 1;  // empty column
+    double lm = std::log(colmax[j]);
+    for (i64 k = indptr[j]; k < indptr[j + 1]; ++k)
+      cost[k] = absval[k] > 0.0 ? lm - std::log(absval[k]) : INF;
+  }
+  for (i64 i = 0; i < n; ++i) { u[i] = 0.0; v[i] = 0.0; }
+  std::vector<i64> row_match(n, -1), col_match(n, -1);
+  std::vector<double> dist(n);
+  std::vector<i64> pred(n);
+  std::vector<char> done(n);
+  std::vector<i64> tree_cols;
+  std::vector<double> d_col(n);
+  using QE = std::pair<double, i64>;
+  std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
+
+  for (i64 j0 = 0; j0 < n; ++j0) {
+    std::fill(dist.begin(), dist.end(), INF);
+    std::fill(pred.begin(), pred.end(), (i64)-1);
+    std::fill(done.begin(), done.end(), 0);
+    tree_cols.clear();
+    tree_cols.push_back(j0);
+    d_col[j0] = 0.0;
+    while (!heap.empty()) heap.pop();
+
+    auto relax_col = [&](i64 j, double base) {
+      for (i64 k = indptr[j]; k < indptr[j + 1]; ++k) {
+        if (cost[k] >= INF) continue;
+        i64 i = indices[k];
+        if (done[i]) continue;
+        double nd = base + cost[k] - u[j] - v[i];
+        if (nd < dist[i] - 1e-30) {
+          dist[i] = nd;
+          pred[i] = j;
+          heap.emplace(nd, i);
+        }
+      }
+    };
+    relax_col(j0, 0.0);
+    i64 found = -1;
+    double mind = 0.0;
+    while (!heap.empty()) {
+      auto [d, i] = heap.top();
+      heap.pop();
+      if (done[i] || d > dist[i]) continue;
+      done[i] = 1;
+      if (row_match[i] == -1) {
+        found = i;
+        mind = dist[i];
+        break;
+      }
+      i64 jn = row_match[i];
+      tree_cols.push_back(jn);
+      d_col[jn] = d;
+      relax_col(jn, d);
+    }
+    if (found == -1) return 1;  // no perfect matching
+    for (i64 i = 0; i < n; ++i)
+      if (done[i] && dist[i] <= mind) v[i] += dist[i] - mind;
+    for (i64 j : tree_cols) u[j] += mind - d_col[j];
+    // augment
+    i64 i = found;
+    while (i != -1) {
+      i64 j = pred[i];
+      i64 inext = col_match[j];
+      row_match[i] = j;
+      col_match[j] = i;
+      i = inext;
+      if (j == j0) break;
+    }
+  }
+  for (i64 j = 0; j < n; ++j) col_match_out[j] = col_match[j];
+  // convert duals so caller computes r = exp(v), c = exp(u)/colmax
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Multilevel nested dissection.
+//
+// Recursive: find a vertex separator of the (sub)graph via multilevel edge
+// bisection (heavy-edge-matching coarsening, greedy-growing initial
+// bisection, boundary-FM refinement) + vertex cover of the cut; order
+// part A, part B recursively, separator last.  Leaves (<= leaf_size) are
+// ordered by a local exact minimum-degree.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Graph {
+  i64 n;
+  std::vector<i64> xadj, adj;   // CSR, no self loops
+  std::vector<i64> vwgt, ewgt;  // vertex / edge weights
+};
+
+// Build coarse graph from matching map (cmap: fine vertex -> coarse id).
+Graph coarsen(const Graph& g, const std::vector<i64>& cmap, i64 cn) {
+  Graph c;
+  c.n = cn;
+  c.vwgt.assign(cn, 0);
+  for (i64 v = 0; v < g.n; ++v) c.vwgt[cmap[v]] += g.vwgt[v];
+  // bucket fine edges by coarse source, merge duplicates with a scratch map
+  std::vector<std::vector<std::pair<i64, i64>>> nbr(cn);
+  for (i64 v = 0; v < g.n; ++v) {
+    i64 cv = cmap[v];
+    for (i64 p = g.xadj[v]; p < g.xadj[v + 1]; ++p) {
+      i64 cu = cmap[g.adj[p]];
+      if (cu != cv) nbr[cv].emplace_back(cu, g.ewgt[p]);
+    }
+  }
+  c.xadj.assign(cn + 1, 0);
+  std::vector<std::pair<i64, i64>> tmp;
+  std::vector<std::vector<std::pair<i64, i64>>> merged(cn);
+  for (i64 v = 0; v < cn; ++v) {
+    auto& e = nbr[v];
+    std::sort(e.begin(), e.end());
+    tmp.clear();
+    for (auto& [t, w] : e) {
+      if (!tmp.empty() && tmp.back().first == t)
+        tmp.back().second += w;
+      else
+        tmp.emplace_back(t, w);
+    }
+    merged[v] = tmp;
+    c.xadj[v + 1] = c.xadj[v] + (i64)tmp.size();
+  }
+  c.adj.resize(c.xadj[cn]);
+  c.ewgt.resize(c.xadj[cn]);
+  for (i64 v = 0; v < cn; ++v) {
+    i64 o = c.xadj[v];
+    for (auto& [t, w] : merged[v]) {
+      c.adj[o] = t;
+      c.ewgt[o] = w;
+      ++o;
+    }
+  }
+  return c;
+}
+
+// Heavy-edge matching; returns coarse count, fills cmap.
+i64 hem_match(const Graph& g, std::vector<i64>& cmap, std::mt19937_64& rng) {
+  std::vector<i64> order(g.n);
+  for (i64 i = 0; i < g.n; ++i) order[i] = i;
+  std::shuffle(order.begin(), order.end(), rng);
+  cmap.assign(g.n, -1);
+  i64 cn = 0;
+  for (i64 v : order) {
+    if (cmap[v] != -1) continue;
+    i64 best = -1, bw = -1;
+    for (i64 p = g.xadj[v]; p < g.xadj[v + 1]; ++p) {
+      i64 u = g.adj[p];
+      if (cmap[u] == -1 && g.ewgt[p] > bw) {
+        bw = g.ewgt[p];
+        best = u;
+      }
+    }
+    cmap[v] = cn;
+    if (best != -1) cmap[best] = cn;
+    ++cn;
+  }
+  return cn;
+}
+
+// Greedy graph-growing bisection: BFS-grow part 0 from seed to ~half weight.
+void grow_bisect(const Graph& g, i64 seed, std::vector<char>& part) {
+  i64 total = 0;
+  for (i64 v = 0; v < g.n; ++v) total += g.vwgt[v];
+  part.assign(g.n, 1);
+  i64 w0 = 0;
+  std::vector<i64> q{seed};
+  std::vector<char> seen(g.n, 0);
+  seen[seed] = 1;
+  size_t head = 0;
+  i64 scan = 0;  // monotone cursor for disconnected-graph pickup
+  while (w0 * 2 < total) {
+    i64 v;
+    if (head < q.size()) {
+      v = q[head++];
+    } else {
+      while (scan < g.n && seen[scan]) ++scan;
+      if (scan == g.n) break;
+      v = scan;
+      seen[v] = 1;
+      q.push_back(v);
+      ++head;
+    }
+    part[v] = 0;
+    w0 += g.vwgt[v];
+    for (i64 p = g.xadj[v]; p < g.xadj[v + 1]; ++p) {
+      i64 u = g.adj[p];
+      if (!seen[u]) {
+        seen[u] = 1;
+        q.push_back(u);
+      }
+    }
+  }
+}
+
+i64 cut_of(const Graph& g, const std::vector<char>& part) {
+  i64 cut = 0;
+  for (i64 v = 0; v < g.n; ++v)
+    for (i64 p = g.xadj[v]; p < g.xadj[v + 1]; ++p)
+      if (part[v] != part[g.adj[p]]) cut += g.ewgt[p];
+  return cut / 2;
+}
+
+// Boundary FM refinement (simplified): passes of greedy single-vertex moves
+// with a tolerance on balance; stops when a pass improves nothing.
+void fm_refine(const Graph& g, std::vector<char>& part, double balance_tol) {
+  i64 total = 0;
+  for (i64 v = 0; v < g.n; ++v) total += g.vwgt[v];
+  i64 w[2] = {0, 0};
+  for (i64 v = 0; v < g.n; ++v) w[part[v]] += g.vwgt[v];
+  i64 maxside = (i64)(total * (0.5 + balance_tol));
+
+  std::vector<i64> gain(g.n);
+  auto compute_gain = [&](i64 v) {
+    i64 ext = 0, in = 0;
+    for (i64 p = g.xadj[v]; p < g.xadj[v + 1]; ++p) {
+      if (part[g.adj[p]] != part[v]) ext += g.ewgt[p];
+      else in += g.ewgt[p];
+    }
+    return ext - in;
+  };
+  for (int pass = 0; pass < 8; ++pass) {
+    // collect boundary vertices
+    std::vector<i64> cand;
+    for (i64 v = 0; v < g.n; ++v) {
+      bool boundary = false;
+      for (i64 p = g.xadj[v]; p < g.xadj[v + 1] && !boundary; ++p)
+        boundary = part[g.adj[p]] != part[v];
+      if (boundary) {
+        gain[v] = compute_gain(v);
+        cand.push_back(v);
+      }
+    }
+    std::sort(cand.begin(), cand.end(),
+              [&](i64 a, i64 b) { return gain[a] > gain[b]; });
+    i64 moved = 0;
+    for (i64 v : cand) {
+      i64 from = part[v], to = 1 - from;
+      if (w[to] + g.vwgt[v] > maxside) continue;
+      i64 gv = compute_gain(v);  // recompute: neighbors may have moved
+      if (gv <= 0) continue;
+      part[v] = (char)to;
+      w[from] -= g.vwgt[v];
+      w[to] += g.vwgt[v];
+      ++moved;
+    }
+    if (!moved) break;
+  }
+}
+
+// Multilevel 2-way partition of g; fills part (0/1 per vertex).
+void ml_bisect(const Graph& g0, std::vector<char>& part,
+               std::mt19937_64& rng) {
+  std::vector<Graph> levels;
+  std::vector<std::vector<i64>> cmaps;
+  levels.push_back(g0);
+  while (levels.back().n > 160) {
+    std::vector<i64> cmap;
+    const Graph& f = levels.back();
+    i64 cn = hem_match(f, cmap, rng);
+    if (cn > (i64)(0.95 * f.n)) break;  // coarsening stalled
+    Graph c = coarsen(f, cmap, cn);
+    cmaps.push_back(std::move(cmap));
+    levels.push_back(std::move(c));
+  }
+  // initial bisection at coarsest: best of a few grow seeds
+  const Graph& c = levels.back();
+  std::vector<char> best_part, cur;
+  i64 best_cut = -1;
+  std::uniform_int_distribution<i64> pick(0, c.n - 1);
+  for (int t = 0; t < 4; ++t) {
+    grow_bisect(c, pick(rng), cur);
+    fm_refine(c, cur, 0.05);
+    i64 cut = cut_of(c, cur);
+    if (best_cut < 0 || cut < best_cut) {
+      best_cut = cut;
+      best_part = cur;
+    }
+  }
+  part = best_part;
+  // project back with FM refinement at each level
+  for (i64 l = (i64)cmaps.size() - 1; l >= 0; --l) {
+    const Graph& f = levels[l];
+    std::vector<char> fpart(f.n);
+    for (i64 v = 0; v < f.n; ++v) fpart[v] = part[cmaps[l][v]];
+    fm_refine(f, fpart, 0.03);
+    part = std::move(fpart);
+  }
+}
+
+// Exact minimum-degree ordering of a small dense-ish subgraph (leaf).
+// nodes: global ids; writes ordered global ids to out.
+void leaf_md(const std::vector<i64>& nodes, const i64* indptr,
+             const i64* indices, const std::vector<i64>& glob2loc,
+             std::vector<i64>& out) {
+  i64 k = (i64)nodes.size();
+  if (k <= 2) {
+    for (i64 v : nodes) out.push_back(v);
+    return;
+  }
+  // local adjacency as bitsets over k (k <= ~256 so this is cheap)
+  i64 words = (k + 63) / 64;
+  std::vector<uint64_t> adj(k * words, 0);
+  auto set_bit = [&](i64 r, i64 c) { adj[r * words + c / 64] |= 1ull << (c % 64); };
+  auto test_bit = [&](i64 r, i64 c) {
+    return (adj[r * words + c / 64] >> (c % 64)) & 1ull;
+  };
+  for (i64 li = 0; li < k; ++li) {
+    i64 v = nodes[li];
+    for (i64 p = indptr[v]; p < indptr[v + 1]; ++p) {
+      i64 lj = glob2loc[indices[p]];
+      if (lj >= 0 && lj != li) {
+        set_bit(li, lj);
+        set_bit(lj, li);
+      }
+    }
+  }
+  std::vector<char> elim(k, 0);
+  std::vector<uint64_t> elim_mask(words, 0);  // bit set => eliminated
+  for (i64 step = 0; step < k; ++step) {
+    i64 best = -1, bestdeg = k + 1;
+    for (i64 v = 0; v < k; ++v) {
+      if (elim[v]) continue;
+      i64 deg = 0;
+      for (i64 w = 0; w < words; ++w) deg += __builtin_popcountll(adj[v * words + w]);
+      if (deg < bestdeg) {
+        bestdeg = deg;
+        best = v;
+      }
+    }
+    elim[best] = 1;
+    elim_mask[best / 64] |= 1ull << (best % 64);
+    out.push_back(nodes[best]);
+    // eliminate: connect neighbors pairwise (union rows), mask out
+    // eliminated vertices + self wordwise
+    for (i64 u = 0; u < k; ++u) {
+      if (elim[u] || !test_bit(u, best)) continue;
+      for (i64 w = 0; w < words; ++w)
+        adj[u * words + w] = (adj[u * words + w] | adj[best * words + w]) &
+                             ~elim_mask[w];
+      adj[u * words + u / 64] &= ~(1ull << (u % 64));
+    }
+  }
+}
+
+}  // namespace
+
+void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
+              uint64_t seed, i64* order_out) {
+  std::mt19937_64 rng(seed);
+  std::vector<i64> glob2loc(n, -1);
+  i64 pos = 0;
+  std::vector<i64> md_out;
+
+  // explicit work stack: (nodes, emit_flag).  Post-order: push separator
+  // emit first, then parts (LIFO => parts processed before the emit).
+  struct Item {
+    std::vector<i64> nodes;
+    bool emit;
+  };
+  std::vector<Item> work;
+  {
+    std::vector<i64> all(n);
+    for (i64 i = 0; i < n; ++i) all[i] = i;
+    work.push_back({std::move(all), false});
+  }
+  while (!work.empty()) {
+    Item it = std::move(work.back());
+    work.pop_back();
+    auto& nodes = it.nodes;
+    if (it.emit) {
+      for (i64 v : nodes) order_out[pos++] = v;
+      continue;
+    }
+    if ((i64)nodes.size() <= leaf_size) {
+      md_out.clear();
+      for (i64 v : nodes) glob2loc[v] = 1;  // mark (value set below)
+      for (i64 li = 0; li < (i64)nodes.size(); ++li) glob2loc[nodes[li]] = li;
+      leaf_md(nodes, indptr, indices, glob2loc, md_out);
+      for (i64 v : nodes) glob2loc[v] = -1;
+      for (i64 v : md_out) order_out[pos++] = v;
+      continue;
+    }
+    // build local subgraph
+    Graph g;
+    g.n = (i64)nodes.size();
+    for (i64 li = 0; li < g.n; ++li) glob2loc[nodes[li]] = li;
+    g.xadj.assign(g.n + 1, 0);
+    for (i64 li = 0; li < g.n; ++li) {
+      i64 v = nodes[li];
+      i64 deg = 0;
+      for (i64 p = indptr[v]; p < indptr[v + 1]; ++p) {
+        i64 lj = glob2loc[indices[p]];
+        if (lj >= 0 && lj != li) ++deg;
+      }
+      g.xadj[li + 1] = g.xadj[li] + deg;
+    }
+    g.adj.resize(g.xadj[g.n]);
+    g.ewgt.assign(g.xadj[g.n], 1);
+    g.vwgt.assign(g.n, 1);
+    for (i64 li = 0; li < g.n; ++li) {
+      i64 v = nodes[li], o = g.xadj[li];
+      for (i64 p = indptr[v]; p < indptr[v + 1]; ++p) {
+        i64 lj = glob2loc[indices[p]];
+        if (lj >= 0 && lj != li) g.adj[o++] = lj;
+      }
+    }
+    std::vector<char> part;
+    ml_bisect(g, part, rng);
+    // vertex separator from the edge cut: greedy cover — move to the
+    // separator the endpoint covering the most uncovered cut edges
+    // (approximates minimum vertex cover of the cut bipartite graph).
+    std::vector<char> insep(g.n, 0);
+    std::vector<i64> cutdeg(g.n, 0);
+    for (i64 v = 0; v < g.n; ++v)
+      for (i64 p = g.xadj[v]; p < g.xadj[v + 1]; ++p)
+        if (part[g.adj[p]] != part[v]) ++cutdeg[v];
+    std::vector<i64> by_cut;
+    for (i64 v = 0; v < g.n; ++v)
+      if (cutdeg[v] > 0) by_cut.push_back(v);
+    std::sort(by_cut.begin(), by_cut.end(),
+              [&](i64 a, i64 b) { return cutdeg[a] > cutdeg[b]; });
+    for (i64 v : by_cut) {
+      if (cutdeg[v] <= 0) continue;
+      bool uncovered = false;
+      for (i64 p = g.xadj[v]; p < g.xadj[v + 1] && !uncovered; ++p) {
+        i64 u = g.adj[p];
+        uncovered = part[u] != part[v] && !insep[u];
+      }
+      if (!uncovered) continue;
+      insep[v] = 1;
+    }
+    std::vector<i64> a_part, b_part, sep;
+    for (i64 v = 0; v < g.n; ++v) {
+      if (insep[v])
+        sep.push_back(nodes[v]);
+      else if (part[v] == 0)
+        a_part.push_back(nodes[v]);
+      else
+        b_part.push_back(nodes[v]);
+    }
+    for (i64 li = 0; li < g.n; ++li) glob2loc[nodes[li]] = -1;
+    // degenerate split (e.g. clique): local MD on the blob when the
+    // bitset cost (k^2/8 bytes) is affordable, natural order otherwise
+    if (a_part.empty() || b_part.empty()) {
+      std::sort(nodes.begin(), nodes.end());
+      if ((i64)nodes.size() <= 2048) {
+        md_out.clear();
+        for (i64 li = 0; li < (i64)nodes.size(); ++li)
+          glob2loc[nodes[li]] = li;
+        leaf_md(nodes, indptr, indices, glob2loc, md_out);
+        for (i64 v : nodes) glob2loc[v] = -1;
+        for (i64 v : md_out) order_out[pos++] = v;
+      } else {
+        for (i64 v : nodes) order_out[pos++] = v;
+      }
+      continue;
+    }
+    work.push_back({std::move(sep), true});
+    work.push_back({std::move(b_part), false});
+    work.push_back({std::move(a_part), false});
+  }
+  // pos == n expected; fill any deficit defensively (should not happen)
+  (void)pos;
+}
+
+}  // extern "C"

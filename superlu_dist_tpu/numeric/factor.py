@@ -70,9 +70,10 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     packed, counts = group_partial_factor(f, thresh, w,
                                           front_sharding=front_sharding,
                                           pivot_sharding=pivot_sharding)
-    # padded batch slots (ws == 0) are identity fronts; don't let a
-    # thresh > 1 count their unit pivots as tiny
-    tiny = jnp.sum(jnp.where(ws > 0, counts, 0))
+    # counts is (batch, w) per-column tiny flags; identity-padding columns
+    # (col >= ws, incl. whole padded batch slots with ws == 0) are unit
+    # pivots — don't let a thresh > 1 count them as tiny
+    tiny = jnp.sum(jnp.where(jnp.arange(w)[None, :] < ws[:, None], counts, 0))
     if u > 0:
         flat = packed.reshape(batch, m * m)
         if replicated is not None:
@@ -104,6 +105,10 @@ class NumericFactorization:
     dtype: object
     finite: bool = True       # False => an exact zero pivot propagated
                               # (only possible with replace_tiny=False)
+    info_col: int = -1        # first zero-pivot column (0-based, final
+                              # labeling) when not finite — the reference's
+                              # info>0 = first i with U(i,i)==0
+                              # (pdgstrf.c:1920-1924, Allreduce MIN)
     host_fronts: list = None  # lazily pulled numpy copies for the host solve
 
     def pull_to_host(self):
@@ -208,20 +213,51 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     fronts_out, tiny_total = fn(avals, thresh)
     fronts_out = list(fronts_out)
     finite = True
+    info_col = -1
     if not replace_tiny:
-        # singularity check: non-finite factors OR an exact zero on the U
-        # diagonal (a zero pivot in the last column of an unpadded front
-        # divides nothing during factorization, so isfinite alone misses it)
+        # singularity check + localization: a zero or non-finite U diagonal
+        # in a real (non-padding) column.  The earliest such global column
+        # is the reference's info>0 first-zero-pivot index
+        # (pdgstrf.c:1920-1924); a zero pivot in the last column of a front
+        # divides nothing during factorization, so isfinite alone misses it.
+        bad_cols = []
+        sn_start = plan.sf.sn_start
         for grp, f in zip(plan.groups, fronts_out):
-            diag = jnp.diagonal(f[:, :grp.w, :grp.w], axis1=1, axis2=2)
-            if not bool(jnp.isfinite(f).all()) or bool((diag == 0).any()):
-                finite = False
-                break
+            fh = np.asarray(f)
+            diag = np.diagonal(fh[:, :grp.w, :grp.w], axis1=1, axis2=2)
+            bad = (diag == 0) | ~np.isfinite(diag)
+            bad &= np.arange(grp.w)[None, :] < np.asarray(grp.ws)[:, None]
+            if bad.any():
+                slots, cols = np.nonzero(bad)
+                bad_cols.append(int((sn_start[grp.sns[slots]] + cols).min()))
+            else:
+                # off-diagonal-only contamination: attribute per SLOT, not
+                # per group — an unrelated subtree batched in the same
+                # group must not shift min(bad_cols) below the true pivot
+                # (contamination only flows to ancestors, whose columns
+                # are larger than the zero pivot's)
+                nf = ~np.isfinite(fh.reshape(fh.shape[0], -1)).all(axis=1)
+                if nf.any():
+                    bad_cols.append(int(sn_start[grp.sns[nf]].min()))
+        if bad_cols:
+            finite = False
+            info_col = min(bad_cols)
     return NumericFactorization(plan=plan, fronts=fronts_out,
                                 tiny_pivots=int(tiny_total), dtype=dtype,
-                                finite=finite)
+                                finite=finite, info_col=info_col)
 
 
 def factor_flops(plan: FactorPlan) -> float:
     """Flop count for stats (the ops[FACT] analog, SRC/util.c:513)."""
     return plan.flops
+
+
+def query_space(numeric: NumericFactorization) -> dict:
+    """Memory held by the factorization — the dQuerySpace_dist analog
+    (SRC/dmemory_dist.c:73): packed-front (L+U) bytes plus the transient
+    Schur update pool (the reference's 'expansions'/buffer gauges)."""
+    itemsize = np.dtype(numeric.dtype).itemsize
+    front_b = sum(int(np.prod(f.shape)) for f in numeric.fronts) * itemsize
+    pool_b = int(numeric.plan.pool_size) * itemsize
+    return {"for_lu_bytes": front_b, "pool_bytes": pool_b,
+            "total_bytes": front_b + pool_b}

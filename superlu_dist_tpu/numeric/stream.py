@@ -42,15 +42,32 @@ def _pad_to(arr: np.ndarray, length: int, fill) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel(dims, l_a, child_shapes, pool_size, dtype):
-    """Jitted group step for one shape key."""
+def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh=None):
+    """Jitted group step for one shape key (optionally mesh-sharded).
+
+    With a mesh, the dense factor math shards batch-over-"snode" and
+    columns-over-"panel" exactly like the fused executor (make_factor_fn);
+    the irregular gathers/scatters stay replicated (see factor.py notes on
+    the SPMD partitioner).  This is the VERDICT-r1 gap #3: the real-TPU
+    executor must be shardable where the fused whole-program jit won't
+    compile.
+    """
+    front_sharding = pivot_sharding = replicated = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        front_sharding = NamedSharding(mesh, P("snode", None, "panel"))
+        pivot_sharding = NamedSharding(mesh, P("snode", None, None))
+        replicated = NamedSharding(mesh, P(None, None))
 
     def step(avals, pool, thresh, a_slot, a_flat, a_src, ws, off, *child_arr):
         children = [(ub, child_arr[3 * i], child_arr[3 * i + 1],
                      child_arr[3 * i + 2])
                     for i, (ub, _) in enumerate(child_shapes)]
         return group_step(dims, avals, pool, thresh,
-                          a_slot, a_flat, a_src, ws, off, children)
+                          a_slot, a_flat, a_src, ws, off, children,
+                          front_sharding=front_sharding,
+                          pivot_sharding=pivot_sharding,
+                          replicated=replicated)
 
     # pool is threaded linearly through the group stream — donating it lets
     # XLA scatter in place instead of copying pool_size entries per group
@@ -63,9 +80,10 @@ class StreamExecutor:
     Reusable across refactorizations with the same plan (SamePattern tier).
     """
 
-    def __init__(self, plan: FactorPlan, dtype="float64"):
+    def __init__(self, plan: FactorPlan, dtype="float64", mesh=None):
         self.plan = plan
         self.dtype = str(jnp.dtype(dtype))
+        self.mesh = mesh
         n_avals = len(plan.pattern_indices)
         self._steps = []
         for grp in plan.groups:
@@ -100,10 +118,15 @@ class StreamExecutor:
         plan = self.plan
         pool = jnp.zeros(plan.pool_size, dtype=self.dtype)
         avals = jnp.asarray(avals, dtype=self.dtype)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P(None))
+            pool = jax.device_put(pool, rep)
+            avals = jax.device_put(avals, rep)
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
         for (key, a, child_arrs, nreal) in self._steps:
-            kern = _kernel(*key)
+            kern = _kernel(*key, self.mesh)
             packed, pool, t = kern(avals, pool, thresh, *a, *child_arrs)
             fronts.append(packed[:nreal] if packed.shape[0] != nreal
                           else packed)

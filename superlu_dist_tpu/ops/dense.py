@@ -46,7 +46,7 @@ def _fix_pivot(piv, thresh):
 def _lu_masked(a, thresh):
     """Unpivoted LU of a small block — scatter-free masked formulation.
 
-    Each step is one-hot selects + a full-matrix rank-1 update + `where`
+    Each step is masked selects + a full-matrix rank-1 update + `where`
     masks: no scatter/dynamic-update ops at all.  That matters twice on
     TPU: (a) masked dense updates vectorize on the VPU where scatters
     serialize, and (b) XLA's SPMD partitioner miscompiles vmapped
@@ -54,27 +54,37 @@ def _lu_masked(a, thresh):
     the factorization core must stay scatter-free to be mesh-shardable.
     The ~3× extra flops of full-width updates are negligible next to the
     Schur GEMMs.
+
+    Row/column/pivot extraction uses elementwise masked reductions rather
+    than one-hot dot products: a dot_general here would route through the
+    MXU at default precision (bf16 inputs on TPU), truncating the pivot row
+    and the pivot value itself every elimination step.
+
+    Returns (packed LU, tiny: (k,) int32 per-column tiny-pivot flags) —
+    per-column so callers can mask out identity-padding columns.
     """
     k = a.shape[0]
     idx = jnp.arange(k)
 
     def step(i, carry):
-        a, count = carry
-        e = (idx == i).astype(a.dtype)
-        row_i = e @ a                       # row i
-        col_i = a @ e                       # column i
-        piv, tiny = _fix_pivot(row_i @ e.astype(row_i.dtype), thresh)
+        a, flags = carry
+        sel = idx == i
+        e = sel.astype(a.dtype)
+        row_i = jnp.sum(a * e[:, None], axis=0)    # row i
+        col_i = jnp.sum(a * e[None, :], axis=1)    # column i
+        piv_raw = jnp.sum(row_i * e)
+        piv, tiny = _fix_pivot(piv_raw, thresh)
         below = (idx > i)
         l = jnp.where(below, col_i / piv, jnp.zeros_like(col_i))
         u = jnp.where(below, row_i, jnp.zeros_like(row_i))   # cols > i
         a = a - l[:, None] * u[None, :]
         # write multipliers + fixed pivot into column i
-        new_col = jnp.where(below, l, col_i) + (piv - row_i @ e) * e
-        a = a + (new_col - a @ e)[:, None] * e[None, :]
-        return a, count + tiny
+        new_col = jnp.where(below, l, col_i) + (piv - piv_raw) * e
+        cur_col = jnp.sum(a * e[None, :], axis=1)
+        a = a + (new_col - cur_col)[:, None] * e[None, :]
+        return a, flags + tiny * sel.astype(jnp.int32)
 
-    a, count = jax.lax.fori_loop(0, k, step, (a, jnp.zeros((), jnp.int32)))
-    return a, count
+    return jax.lax.fori_loop(0, k, step, (a, jnp.zeros(k, jnp.int32)))
 
 
 def lu_nopivot(a, thresh):
@@ -82,6 +92,8 @@ def lu_nopivot(a, thresh):
 
     Static shapes throughout; the trailing update is a single GEMM per
     recursion level, which is where XLA maps onto the MXU.
+
+    Returns (packed LU, tiny: (n,) int32 per-column tiny-pivot flags).
     """
     n = a.shape[0]
     if n <= _UNROLL:
@@ -97,7 +109,7 @@ def lu_nopivot(a, thresh):
     f22, c2 = lu_nopivot(s, thresh)
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, f22], axis=1)
-    return jnp.concatenate([top, bot], axis=0), c1 + c2
+    return jnp.concatenate([top, bot], axis=0), jnp.concatenate([c1, c2])
 
 
 def partial_front_factor(f, thresh, w):

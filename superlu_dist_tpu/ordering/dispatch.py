@@ -35,7 +35,13 @@ def get_perm_c(options: Options, a: SparseCSR,
         if grid_shape is not None:
             return geometric_nd(grid_shape)
         if n <= 400:
-            # MD beats BFS-ND on small irregular graphs, and is cheap there
+            # MD beats any ND on small irregular graphs, and is cheap there
             return minimum_degree(n, sym.indptr, sym.indices)
+        # multilevel ND (the METIS_AT_PLUS_A-quality path): coarsen →
+        # bisect → FM-refine → vertex separator, native/slu_host.cpp
+        from superlu_dist_tpu import native
+        order = native.mlnd(n, sym.indptr, sym.indices)
+        if order is not None:
+            return order
         return bfs_nd(n, sym.indptr, sym.indices)
     raise SuperLUError(f"unsupported ColPerm {cp}")

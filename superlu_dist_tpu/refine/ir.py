@@ -22,20 +22,28 @@ ITMAX = 20
 
 
 def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
-                         solve_fn, itmax: int = ITMAX):
+                         solve_fn, itmax: int = ITMAX,
+                         residual_dtype=np.float64):
     """Refine solve_fn-based solution x of A·x = b.
 
     solve_fn(r) must solve A·dx = r using the existing factorization
-    (including all scalings/permutations).  Returns (x, berr_history).
+    (including all scalings/permutations).  residual_dtype picks the
+    precision of the residual/accumulation (the reference's
+    SLU_SINGLE/SLU_DOUBLE tiers).  Returns (x, berr_history).
     """
+    residual_dtype = np.dtype(residual_dtype)
     b = np.asarray(b)
     squeeze = b.ndim == 1
     b2 = b[:, None] if squeeze else b
-    x2 = (x[:, None] if squeeze else x).astype(
-        np.promote_types(b.dtype, np.float64), copy=True)
-    eps = np.finfo(np.float64).eps
+    work = np.promote_types(b.dtype, residual_dtype)
+    if residual_dtype == np.float32:
+        # SLU_SINGLE caps the working precision at single even for f64 input
+        work = (np.complex64 if np.issubdtype(work, np.complexfloating)
+                else np.float32)
+    x2 = (x[:, None] if squeeze else x).astype(work, copy=True)
+    eps = float(np.finfo(residual_dtype).eps)
     safe1 = a.nnz + 1
-    safmin = np.finfo(np.float64).tiny
+    safmin = np.finfo(residual_dtype).tiny
     nrhs = b2.shape[1]
     berrs = []
     # per-RHS stopping state, like the reference's outer loop over RHS
@@ -43,11 +51,15 @@ def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
     lstres = np.full(nrhs, np.inf)
     active = np.ones(nrhs, dtype=bool)
     for _ in range(itmax):
-        r = b2 - a.matvec(x2)
+        # the residual is rounded to the working precision (SLU_SINGLE
+        # => f32): the refinement then cannot see — and so cannot correct —
+        # anything below single eps, the reference's tier semantics
+        r = (b2 - a.matvec(x2)).astype(work)
         # componentwise backward error per rhs (pdgsrfs.c:213-231)
         berr = np.empty(nrhs)
         for k in range(nrhs):
-            den = a.abs_matvec(np.abs(x2[:, k])) + np.abs(b2[:, k])
+            den = (a.abs_matvec(np.abs(x2[:, k]))
+                   + np.abs(b2[:, k])).astype(x2.real.dtype)
             den = np.where(den <= safe1 * safmin, den + safe1 * safmin, den)
             berr[k] = float(np.max(np.abs(r[:, k]) / den))
         berrs.append(berr.copy())

@@ -38,12 +38,24 @@ def maximum_product_matching(a, want_scalings: bool = True):
     indptr, indices = csc.indptr, csc.indices
     absval = np.abs(csc.data).astype(np.float64)
 
-    # costs: c_k = log(colmax_j) - log|a_k| >= 0; explicit zeros excluded
     colmax = np.zeros(n)
     cols = np.repeat(np.arange(n), np.diff(indptr))
     np.maximum.at(colmax, cols, absval)
     if np.any(colmax == 0):
         raise SuperLUError("structurally singular: empty column")
+
+    # native path (slu_host.cpp slu_mc64 — same algorithm, compiled)
+    from superlu_dist_tpu import native
+    if native.available():
+        try:
+            col_match, u_n, v_n = native.mc64(n, indptr, indices, absval)
+        except ValueError as e:
+            raise SuperLUError(f"structurally singular: {e}") from e
+        if not want_scalings:
+            return col_match, None, None
+        return (col_match,) + _scalings_from_duals(u_n, v_n, colmax)
+
+    # costs: c_k = log(colmax_j) - log|a_k| >= 0; explicit zeros excluded
     with np.errstate(divide="ignore"):
         cost = np.log(colmax[cols]) - np.log(absval)   # +inf for zeros
 
@@ -114,8 +126,14 @@ def maximum_product_matching(a, want_scalings: bool = True):
     row_order = col_match.copy()      # position j <- original row matched to col j
     if not want_scalings:
         return row_order, None, None
-    # r_i = exp(v_i), c_j = exp(u_j)/colmax_j  =>  matched |r_i a_ij c_j| = 1
+    return (row_order,) + _scalings_from_duals(u, v, colmax)
+
+
+def _scalings_from_duals(u: np.ndarray, v: np.ndarray, colmax: np.ndarray):
+    """r_i = exp(v_i), c_j = exp(u_j)/colmax_j => matched |r_i a_ij c_j| = 1
+    (the MC64 job=5 scaling recovery, shared by the native and Python
+    matching paths)."""
     cap = 700.0                       # keep exp() finite
     r = np.exp(np.clip(v, -cap, cap))
     c = np.exp(np.clip(u - np.log(colmax), -cap, cap))
-    return row_order, r, c
+    return r, c

@@ -22,16 +22,77 @@ import numpy as np
 from superlu_dist_tpu.numeric.factor import NumericFactorization
 
 
+def _promote(fact, rhs):
+    return np.array(rhs, dtype=np.promote_types(
+        np.asarray(rhs).dtype,
+        np.float64 if not np.issubdtype(fact.dtype, np.complexfloating)
+        else np.complex128))
+
+
+def lu_solve_trans(fact: NumericFactorization, rhs: np.ndarray,
+                   conj: bool = False) -> np.ndarray:
+    """Solve (L·U)ᵀ x = rhs (or (L·U)ᴴ x with conj=True), permuted labeling.
+
+    The reference solves AᵀX = B through the same factors (trans_t,
+    superlu_defs.h:628-657): Mᵀ = Uᵀ·Lᵀ, so the forward sweep is with Uᵀ
+    (lower triangular) walking supernodes ascending, the backward sweep
+    with Lᵀ (unit upper) descending — the mirror of lu_solve using the U12
+    blocks on the way down and L21 on the way up.
+    """
+    plan = fact.plan
+    sf = plan.sf
+    hosts = fact.pull_to_host()
+    y = _promote(fact, rhs)
+    squeeze = y.ndim == 1
+    if squeeze:
+        y = y[:, None]
+    ns = sf.n_supernodes
+    first = sf.sn_start[:-1]
+    last = sf.sn_start[1:] - 1
+
+    def blocks(s):
+        grp = plan.groups[plan.sn_group[s]]
+        f = hosts[plan.sn_group[s]][plan.sn_slot[s]]
+        w = int(last[s] - first[s] + 1)
+        u = len(sf.sn_rows[s])
+        W = grp.w
+        f11 = f[:w, :w]
+        l21 = f[W:W + u, :w]
+        u12 = f[:w, W:W + u]
+        if conj:
+            f11, l21, u12 = f11.conj(), l21.conj(), u12.conj()
+        return f11, l21, u12, w, u
+
+    # forward: Uᵀ y = d, supernodes ascending (Uᵀ is lower triangular)
+    for s in range(ns):
+        f11, l21, u12, w, u = blocks(s)
+        cols = slice(int(first[s]), int(last[s]) + 1)
+        u11t = np.triu(f11).T
+        yj = np.linalg.solve(u11t, y[cols])
+        y[cols] = yj
+        if u:
+            y[sf.sn_rows[s]] -= u12.astype(yj.dtype).T @ yj
+
+    # backward: Lᵀ x = y, descending (Lᵀ is unit upper triangular)
+    for s in range(ns - 1, -1, -1):
+        f11, l21, u12, w, u = blocks(s)
+        cols = slice(int(first[s]), int(last[s]) + 1)
+        t = y[cols]
+        if u:
+            t = t - l21.astype(t.dtype).T @ y[sf.sn_rows[s]]
+        l11t = (np.tril(f11, -1) + np.eye(w, dtype=f11.dtype)).T
+        y[cols] = np.linalg.solve(l11t, t)
+
+    return y[:, 0] if squeeze else y
+
+
 def lu_solve(fact: NumericFactorization, rhs: np.ndarray) -> np.ndarray:
     """Solve (L·U) x = rhs for rhs (n,) or (n, k), in the factor's permuted
     labeling."""
     plan = fact.plan
     sf = plan.sf
     hosts = fact.pull_to_host()
-    y = np.array(rhs, dtype=np.promote_types(np.asarray(rhs).dtype,
-                                             np.float64 if not np.issubdtype(
-                                                 fact.dtype, np.complexfloating)
-                                             else np.complex128))
+    y = _promote(fact, rhs)
     squeeze = y.ndim == 1
     if squeeze:
         y = y[:, None]

@@ -63,18 +63,32 @@ class SymbolicFact:
 
 
 def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
-                       relax: int = 20, max_supernode: int = 256) -> SymbolicFact:
+                       relax: int = 20, max_supernode: int = 256,
+                       stats=None) -> SymbolicFact:
     """Symbolic phase on a symmetrized pattern with a fill-reducing order.
 
     Returns all structures in the final (order ∘ postorder) labeling.
+    When `stats` is given, the etree+postorder step is timed into the ETREE
+    phase (the reference times sp_colorder separately from symbfact,
+    pdgssvx.c:1044-1073).
     """
+    import contextlib
+
+    from superlu_dist_tpu import native
+
     n = sym_pattern.n_rows
     relax = min(relax, max_supernode)
 
     # ---- permute, etree, postorder, combine --------------------------------
     b0 = sym_pattern.permute(order, order)
-    parent0 = etree_symmetric(n, b0.indptr, b0.indices)
-    post = postorder(parent0)
+    with (stats.timer("ETREE") if stats is not None
+          else contextlib.nullcontext()):
+        parent0 = native.etree(n, b0.indptr, b0.indices)
+        if parent0 is None:
+            parent0 = etree_symmetric(n, b0.indptr, b0.indices)
+        post = native.postorder(parent0)
+        if post is None:
+            post = postorder(parent0)
     inv_post = invert_perm(post)
     perm = np.asarray(order, dtype=np.int64)[post]
     old_parents = parent0[post]
@@ -85,6 +99,15 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
                        np.arange(sym_pattern.nnz, dtype=np.int64))
     b = tracer.permute(perm, perm)
     indptr, indices, value_perm = b.indptr, b.indices, b.data
+
+    # ---- supernode partition + row structures ------------------------------
+    nat = native.symbolic(n, indptr, indices, parent, relax, max_supernode)
+    if nat is not None:
+        sn_start, col_to_sn, sn_parent, sn_level, rows_ptr, rows_data = nat
+        sn_rows = np.split(rows_data, rows_ptr[1:-1])
+        us = np.diff(rows_ptr)
+        return _finish(n, perm, parent, sn_start, col_to_sn, sn_rows,
+                       sn_parent, sn_level, us, indptr, indices, value_perm)
 
     # ---- relaxed leaf supernodes (relax_snode analog) ----------------------
     # postordered labels => every subtree is a contiguous column range
@@ -170,8 +193,14 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
         if p >= 0:
             sn_level[p] = max(sn_level[p], sn_level[s] + 1)
 
-    widths = np.diff(sn_start)
     us = np.array([len(r) for r in sn_rows], dtype=np.int64)
+    return _finish(n, perm, parent, sn_start, col_to_sn, sn_rows, sn_parent,
+                   sn_level, us, indptr, indices, value_perm)
+
+
+def _finish(n, perm, parent, sn_start, col_to_sn, sn_rows, sn_parent,
+            sn_level, us, indptr, indices, value_perm) -> SymbolicFact:
+    widths = np.diff(sn_start)
     nnz_tri = int(np.sum(widths * (widths + 1) // 2))
     nnz_rect = int(np.sum(widths * us))
     w = widths.astype(float)

@@ -95,9 +95,12 @@ class Options:
     bucket_growth: float = 1.5        # geometric padding factor for front
                                       # size buckets (static-shape batching)
     min_bucket: int = 8               # smallest padded front dimension
-    # user-supplied permutations for MY_PERMC / MY_PERMR
-    user_perm_c = None
-    user_perm_r = None
+    # user-supplied permutations for MY_PERMC / MY_PERMR (real dataclass
+    # fields so Options(user_perm_c=...) works — the reference reads these
+    # from ScalePermstruct->perm_c/perm_r when ColPerm/RowPerm say MY_*).
+    # compare=False: ndarray values would make the generated __eq__ raise.
+    user_perm_c: object = dataclasses.field(default=None, compare=False)
+    user_perm_r: object = dataclasses.field(default=None, compare=False)
 
 
 def set_default_options() -> Options:

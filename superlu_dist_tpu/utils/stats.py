@@ -28,6 +28,8 @@ class Stats:
     refine_steps: int = 0         # reference: stat->RefineSteps
     peak_memory_bytes: int = 0
     current_memory_bytes: int = 0
+    for_lu_bytes: int = 0         # dQuerySpace_dist analog: packed L+U
+    pool_bytes: int = 0           # transient Schur update pool
 
     @contextlib.contextmanager
     def timer(self, phase: str):
@@ -39,9 +41,18 @@ class Stats:
             self.utime[phase] = self.utime.get(phase, 0.0) + time.perf_counter() - t0
 
     def log_memory(self, nbytes: int):
-        """Analog of log_memory (SRC/util.c:914)."""
+        """Analog of log_memory (SRC/util.c:914): delta-accounting (allocs
+        positive, frees negative) with a running peak."""
         self.current_memory_bytes += nbytes
         self.peak_memory_bytes = max(self.peak_memory_bytes, self.current_memory_bytes)
+
+    def observe_memory(self, nbytes: int):
+        """Replace the current gauge (the new allocation supersedes the
+        previous factorization's) — keeps peak correct when one Stats is
+        reused across refactorizations (the SamePattern time-stepping
+        pattern)."""
+        self.current_memory_bytes = nbytes
+        self.peak_memory_bytes = max(self.peak_memory_bytes, nbytes)
 
     def gflops(self, phase: str) -> float:
         t = self.utime.get(phase, 0.0)
@@ -62,6 +73,13 @@ class Stats:
             lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
         if self.refine_steps:
             lines.append(f"    refinement steps: {self.refine_steps}")
+        if self.for_lu_bytes:
+            # dQuerySpace_dist-style report (SRC/dmemory_dist.c:73)
+            lines.append(f"    L\\U storage {self.for_lu_bytes / 1e6:10.2f} MB"
+                         f"\tupdate pool {self.pool_bytes / 1e6:10.2f} MB")
+        if self.peak_memory_bytes:
+            lines.append(
+                f"    peak device memory {self.peak_memory_bytes / 1e6:10.2f} MB")
         lines.append("**************************************************")
         return "\n".join(lines)
 

@@ -1,0 +1,39 @@
+"""CLI driver tests (the pddrive / pdtest analog, EXAMPLE/pddrive.c:51)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.__main__ import main
+from superlu_dist_tpu.io import write_matrix_market
+from superlu_dist_tpu.models.gallery import poisson2d
+
+REF = "/root/reference/EXAMPLE"
+
+
+@pytest.fixture
+def mtx_file(tmp_path):
+    a = poisson2d(7)
+    path = str(tmp_path / "p2d.mtx")
+    write_matrix_market(path, a)
+    return path
+
+
+def test_cli_solves_generated_matrix(mtx_file, capsys):
+    rc = main(["-f", mtx_file])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "residual" in out and "FACT" in out
+
+
+def test_cli_trans_and_nrhs(mtx_file):
+    assert main(["-f", mtx_file, "--trans", "--nrhs", "2", "-q"]) == 0
+
+
+@pytest.mark.skipif(not os.path.exists(f"{REF}/g20.rua"),
+                    reason="no fixtures")
+def test_cli_reference_fixture(capsys):
+    rc = main(["-f", f"{REF}/g20.rua", "--colperm", "MMD"])
+    assert rc == 0
+    assert "residual" in capsys.readouterr().out

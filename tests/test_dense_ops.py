@@ -25,13 +25,14 @@ def test_lu_nopivot_matches_numpy(n, dtype):
     got, count = lu_nopivot(jnp.asarray(a), jnp.asarray(1e-300))
     want = np_lu_nopiv(a.copy())
     np.testing.assert_allclose(np.asarray(got), want, rtol=1e-10, atol=1e-10)
-    assert int(count) == 0
+    assert count.shape == (n,) and int(count.sum()) == 0
 
 
 def test_tiny_pivot_replacement():
     a = np.array([[1.0, 1.0], [1.0, 1.0]])   # second pivot exactly 0
     out, count = lu_nopivot(jnp.asarray(a), jnp.asarray(1e-8))
-    assert int(count) == 1
+    # per-column flags localize the tiny pivot to column 1
+    assert list(np.asarray(count)) == [0, 1]
     assert abs(np.asarray(out)[1, 1]) == pytest.approx(1e-8)
 
 

