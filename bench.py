@@ -20,7 +20,8 @@ reference accuracy.
 Prints ONE JSON line:
   {"metric": ..., "value": GFLOPS, "unit": "GFLOP/s", "vs_baseline": ...}
 
-Env knobs: BENCH_NX (grid edge, default 24 -> n=13824), BENCH_REPS.
+Env knobs: BENCH_NX (grid edge, default 48 -> n=110592), BENCH_REPS,
+BENCH_PEAK_F32_TFLOPS (MFU denominator).
 """
 
 import json
@@ -48,9 +49,15 @@ from superlu_dist_tpu.numeric.factor import NumericFactorization
 from superlu_dist_tpu.drivers.gssvx import LUFactorization
 from superlu_dist_tpu.refine.ir import iterative_refinement
 
-NX = int(os.environ.get("BENCH_NX", "24"))
+NX = int(os.environ.get("BENCH_NX", "48"))   # n = NX^3 = 110,592 default:
+# large enough that the big separator fronts drive the MXU (the r1 bench at
+# NX=24 was latency-bound, VERDICT weak #3), small enough that the Schur
+# pool + fronts fit single-chip HBM with headroom
 REPS = int(os.environ.get("BENCH_REPS", "5"))
 DTYPE = "float32"
+# v5e peak ~197 TFLOP/s bf16; f32 via HIGHEST-precision MXU passes ~1/4 of
+# that.  MFU is reported against the f32 figure.
+PEAK_F32 = float(os.environ.get("BENCH_PEAK_F32_TFLOPS", "49")) * 1e12
 # TPU-tuned blocking: wide supernodes feed the MXU (SURVEY.md §7 step 10 —
 # the reference's NSUP=128 is CPU-cache-sized) and keep the streamed
 # executor's kernel count small.
@@ -129,7 +136,8 @@ def main():
         from scipy.sparse.linalg import splu
         A = sp.csr_matrix((a.data, a.indices, a.indptr),
                           shape=(a.n_rows, a.n_rows)).tocsc()
-        t_cpu = min(_timeit(lambda: splu(A)) for _ in range(2))
+        base_reps = 2 if a.n_rows < 50_000 else 1
+        t_cpu = min(_timeit(lambda: splu(A)) for _ in range(base_reps))
         vs_baseline = round(t_cpu / t_dev, 2)
     except Exception:                        # pragma: no cover
         t_cpu = vs_baseline = None
@@ -146,6 +154,9 @@ def main():
         "solve_path": solve_path,
         "factor_seconds": t_dev,
         "flops": plan.flops,
+        "mfu_pct": round(100.0 * gflops * 1e9 / PEAK_F32, 2),
+        "n_kernels": ex.n_kernels,
+        "n_groups": len(plan.groups),
         "tiny_pivots": int(tiny),
     }))
 

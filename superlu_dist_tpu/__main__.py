@@ -87,14 +87,9 @@ def _options(args, **overrides):
 def _fabricate(a, nrhs, seed, trans=False):
     """xtrue + b = A·xtrue, like the EXAMPLE drivers
     (dcreate_matrix.c:147-148)."""
-    rng = np.random.default_rng(seed)
-    n = a.n_rows
-    shape = (n,) if nrhs == 1 else (n, nrhs)
-    xtrue = rng.standard_normal(shape)
-    if np.issubdtype(a.data.dtype, np.complexfloating):
-        xtrue = xtrue + 1j * rng.standard_normal(shape)
-    op = a.transpose() if trans else a
-    return xtrue, op.matvec(xtrue)
+    from superlu_dist_tpu.utils.precision import gen_xtrue, fill_rhs
+    xtrue = gen_xtrue(a.n_rows, nrhs, a.data.dtype, seed)
+    return xtrue, fill_rhs(a, xtrue, trans=trans)
 
 
 def _resid(a, x, b, trans=False):
@@ -115,9 +110,9 @@ def run_once(a, args) -> int:
     if info != 0:
         print(f"FAILED: info = {info} (first zero pivot, 1-based)")
         return 1
+    from superlu_dist_tpu.utils.precision import inf_norm_error
     res = _resid(a, x, b, trans=args.trans)
-    err = float(np.linalg.norm(np.ravel(x - xtrue), np.inf)
-                / max(float(np.linalg.norm(np.ravel(x), np.inf)), 1e-300))
+    err = inf_norm_error(x, xtrue)
     if not args.quiet:
         print(stats.report())
         berr = lu.berrs[-1] if lu.berrs else None

@@ -283,6 +283,35 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
     return _solve_and_refine(options, a, b, lu, stats)
 
 
+def gssvx_ABglobal(options: Options, a: SparseCSR, b: np.ndarray,
+                   lu: LUFactorization | None = None,
+                   stats: Stats | None = None):
+    """pdgssvx_ABglobal analog (SRC/pdgssvx_ABglobal.c:472).
+
+    The reference maintains two pipelines because its main driver takes a
+    *distributed* NRformat_loc matrix while ABglobal takes a *replicated*
+    one.  Here the host analysis always sees the global matrix (the
+    distributed input path is gssvx_dist below), so ABglobal coincides
+    with gssvx — kept as a named entry point for API parity.
+    """
+    return gssvx(options, a, b, lu=lu, stats=stats)
+
+
+def gssvx_dist(options: Options, parts, b: np.ndarray,
+               lu: LUFactorization | None = None,
+               stats: Stats | None = None):
+    """Solve from a distributed row-block matrix (the reference's primary
+    pdgssvx signature: NRformat_loc input, SRC/pdgssvx.c:505).
+
+    `parts` is a list of parallel.dist.DistributedCSR row blocks; they are
+    assembled host-side (the dReDistribute_A role, SRC/pddistribute.c:61 —
+    one gather instead of two all-to-alls, since the analysis is
+    single-address-space) and solved with the standard pipeline.
+    """
+    from superlu_dist_tpu.parallel.dist import gather_rows
+    return gssvx(options, gather_rows(parts), b, lu=lu, stats=stats)
+
+
 def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                       lu: LUFactorization, stats: Stats):
     n = a.n_rows

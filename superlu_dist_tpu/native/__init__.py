@@ -77,6 +77,8 @@ def _load():
                                      _I64, _F64, _F64]
             lib.slu_mlnd.argtypes = [ctypes.c_int64, _I64, _I64,
                                      ctypes.c_int64, ctypes.c_uint64, _I64]
+            lib.slu_positions.argtypes = [ctypes.c_int64, _I64, _I64, _I64,
+                                          _I64, _I64, _I64, _I64, _I64]
             _lib = lib
         except Exception:
             _lib = None
@@ -170,6 +172,25 @@ def mc64(n: int, indptr, indices, absval):
     if rc != 0:
         raise ValueError("structurally singular")
     return col_match, u, v
+
+
+def positions(s_arr, x_arr, first, last, snW, rows_ptr, rows_data):
+    """Batched front-position queries (plan building); None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    s_arr = _as_i64(s_arr)
+    x_arr = _as_i64(x_arr)
+    first = _as_i64(first)
+    last = _as_i64(last)
+    snW = _as_i64(snW)
+    rows_ptr = _as_i64(rows_ptr)
+    rows_data = _as_i64(rows_data)
+    pos = np.empty(len(s_arr), dtype=np.int64)
+    lib.slu_positions(len(s_arr), _ptr_i64(s_arr), _ptr_i64(x_arr),
+                      _ptr_i64(first), _ptr_i64(last), _ptr_i64(snW),
+                      _ptr_i64(rows_ptr), _ptr_i64(rows_data), _ptr_i64(pos))
+    return pos
 
 
 def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1):

@@ -161,28 +161,43 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
   std::vector<i64> by_last(n, -1);
   for (i64 s = 0; s < ns0; ++s) by_last[last[s]] = s;
 
-  std::vector<i64> buf;
-  // stamp array dedups row indices BEFORE sorting: sibling children share
-  // most of their row structure (ancestor separators), so this cuts the
-  // sort volume by the average multiplicity — the dominant cost at n~1e6
-  std::vector<i64> stamp(n, -1);
+  // Row structures via sorted-set unions: every piece (a child's row list,
+  // or this supernode's structural entries) is sorted, so fold them with
+  // set_union smallest-first instead of sorting the concatenation — the
+  // reference's symbolic does the analogous pruned merges column-by-column
+  // (symbfact.c:455); at n~1e6 this is the host-analysis hot spot.
+  std::vector<i64> buf, acc, tmp;
   for (i64 s = 0; s < ns0; ++s) {
     i64 l = last[s];
+    // structural piece (small): entries > l from this supernode's columns
     buf.clear();
-    auto push = [&](i64 r) {
-      if (stamp[r] != s) {
-        stamp[r] = s;
-        buf.push_back(r);
-      }
-    };
     for (i64 j = first[s]; j <= l; ++j)
       for (i64 p = indptr[j]; p < indptr[j + 1]; ++p)
-        if (indices[p] > l) push(indices[p]);
-    for (i64 g : kids[s])
-      for (i64 r : rows_of[g])
-        if (r > l) push(r);
+        if (indices[p] > l) buf.push_back(indices[p]);
     std::sort(buf.begin(), buf.end());
-    rows_of[s] = buf;
+    buf.erase(std::unique(buf.begin(), buf.end()), buf.end());
+    // children pieces: rows > l (sorted views), folded smallest-first
+    struct Piece { const i64* lo; const i64* hi; };
+    std::vector<Piece> pieces;
+    if (!buf.empty()) pieces.push_back({buf.data(), buf.data() + buf.size()});
+    for (i64 g : kids[s]) {
+      const auto& rg = rows_of[g];
+      const i64* lo = std::upper_bound(rg.data(), rg.data() + rg.size(), l);
+      if (lo != rg.data() + rg.size()) pieces.push_back({lo, rg.data() + rg.size()});
+    }
+    std::sort(pieces.begin(), pieces.end(),
+              [](const Piece& a, const Piece& b) {
+                return a.hi - a.lo < b.hi - b.lo;
+              });
+    acc.clear();
+    for (const auto& pc : pieces) {
+      tmp.clear();
+      tmp.reserve(acc.size() + (pc.hi - pc.lo));
+      std::set_union(acc.begin(), acc.end(), pc.lo, pc.hi,
+                     std::back_inserter(tmp));
+      acc.swap(tmp);
+    }
+    rows_of[s] = acc;
     // chain-merge predecessors while zero fill and within max_supernode
     while (true) {
       if (first[s] == 0) break;
@@ -238,6 +253,28 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
 }
 
 void slu_free_i64(i64* p) { std::free(p); }
+
+// ---------------------------------------------------------------------------
+// Batched front-position queries for plan building: for query q, the
+// position of global index x[q] within the front of supernode s[q] —
+// pivot columns map to x - first[s], below-diagonal rows to
+// W[s] + rank of x in rows(s) (binary search in the supernode's sorted
+// row list).  One C pass replaces ~30 numpy whole-array passes.
+// ---------------------------------------------------------------------------
+void slu_positions(i64 nq, const i64* s_arr, const i64* x_arr,
+                   const i64* first, const i64* last, const i64* snW,
+                   const i64* rows_ptr, const i64* rows_data, i64* pos) {
+  for (i64 q = 0; q < nq; ++q) {
+    i64 s = s_arr[q], x = x_arr[q];
+    if (x <= last[s]) {
+      pos[q] = x - first[s];
+    } else {
+      const i64* lo = rows_data + rows_ptr[s];
+      const i64* hi = rows_data + rows_ptr[s + 1];
+      pos[q] = snW[s] + (std::lower_bound(lo, hi, x) - lo);
+    }
+  }
+}
 
 // ---------------------------------------------------------------------------
 // MC64 job=5: maximum-product matching + scalings via successive shortest

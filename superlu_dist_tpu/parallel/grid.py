@@ -55,3 +55,37 @@ def gridinit(nprow: int, npcol: int, devices=None) -> ProcessGrid:
     dev = np.asarray(devices[:need]).reshape(nprow, npcol)
     return ProcessGrid(nprow=nprow, npcol=npcol,
                        mesh=Mesh(dev, axis_names=("snode", "panel")))
+
+
+def gridmap(device_ids, nprow: int, npcol: int) -> ProcessGrid:
+    """superlu_gridmap analog (SRC/superlu_grid.c:63): build the grid from an
+    explicit device-id list (arbitrary subset/order), the way the reference
+    lets callers map MPI ranks to grid positions."""
+    by_id = {d.id: d for d in jax.devices()}
+    try:
+        devices = [by_id[int(i)] for i in device_ids]
+    except KeyError as e:                       # pragma: no cover
+        raise ValueError(f"unknown device id {e}") from e
+    return gridinit(nprow, npcol, devices)
+
+
+def gridinit_multihost(nprow: int, npcol: int,
+                       coordinator_address: str | None = None,
+                       num_processes: int | None = None,
+                       process_id: int | None = None) -> ProcessGrid:
+    """Multi-host grid — what superlu_gridinit over a world communicator is
+    to the reference.
+
+    Initializes jax.distributed (idempotent) so every host contributes its
+    local chips to one global device list, then lays the nprow×npcol mesh
+    over jax.devices() — XLA routes mesh collectives over ICI within a
+    host/pod slice and DCN across, replacing the reference's MPI
+    row/column subcommunicators (superlu_grid.c:137-148).  On a single
+    process this degrades to gridinit.
+    """
+    if num_processes is not None and num_processes > 1:
+        if not jax.distributed.is_initialized():
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes, process_id=process_id)
+    return gridinit(nprow, npcol, jax.devices())

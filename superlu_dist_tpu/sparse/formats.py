@@ -13,10 +13,17 @@ numpy counting sorts.
 from __future__ import annotations
 
 import dataclasses
+import os
 
 import numpy as np
 
-INT = np.int32  # analog of int_t (superlu_defs.h:80-93); int64 variant later
+# analog of int_t (superlu_defs.h:80-93): the reference's XSDK_INDEX_SIZE=64
+# build switches every index to 64-bit; here SLU_TPU_INT64=1 does.  Pattern
+# indices only — all factor-side structures (symbolic rows, plan maps, the
+# native library) are unconditionally int64, so nnz(L) > 2^31 works either
+# way; this switch covers matrices whose nnz(A) itself exceeds int32.
+INT = (np.int64 if os.environ.get("SLU_TPU_INT64", "").lower()
+       in ("1", "true", "yes") else np.int32)
 
 
 def _aggregate_coo(n_rows, n_cols, rows, cols, vals):

@@ -142,6 +142,33 @@ def test_complex_end_to_end():
     run_and_check(a)
 
 
+def test_complex64_factor_with_refinement():
+    """The TPU-class complex path: c64 factors + c128 IR must recover full
+    accuracy (the z-twin of the f32+IR design; reference SRC/pzgstrf.c)."""
+    a = random_sparse(60, density=0.08, seed=7, dtype=np.complex128)
+    opts = Options(factor_dtype="float32")     # maps to complex64 factors
+    x, xtrue, lu, stats = run_and_check(a, opts)
+    assert str(lu.numeric.dtype) == "complex64"
+    np.testing.assert_allclose(x, xtrue, rtol=1e-9, atol=1e-9)
+    assert stats.refine_steps >= 1
+
+
+def test_complex64_device_solver_matches_host():
+    """DeviceSolver on complex factors (the pzgstrs analog path)."""
+    from superlu_dist_tpu.solve.device import DeviceSolver
+    from superlu_dist_tpu.solve.trisolve import lu_solve
+    a = random_sparse(50, density=0.1, seed=8, dtype=np.complex128)
+    opts = Options(iter_refine=IterRefine.NOREFINE, factor_dtype="float64")
+    b = np.ones(a.n_rows, dtype=np.complex128)
+    x, lu, stats, info = gssvx(opts, a, b)
+    assert info == 0
+    rng = np.random.default_rng(4)
+    d = rng.standard_normal(a.n_rows) + 1j * rng.standard_normal(a.n_rows)
+    got = DeviceSolver(lu.numeric).solve(d)
+    want = lu_solve(lu.numeric, d)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
+
+
 def test_exact_singularity_reported_without_replacement():
     """ReplaceTinyPivot=NO + singular A => info>0, like pdgstrf.c:234-241."""
     from superlu_dist_tpu.sparse.formats import coo_to_csr
