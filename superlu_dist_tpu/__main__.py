@@ -34,7 +34,8 @@ def _build_parser():
                             "METIS_AT_PLUS_A"],
                    help="fill-reducing column ordering")
     p.add_argument("--rowperm", default="MC64",
-                   choices=["NOROWPERM", "MC64", "LargeDiag_MC64"],
+                   choices=["NOROWPERM", "MC64", "LargeDiag_MC64",
+                            "AWPM", "LargeDiag_AWPM"],
                    help="numerical row pivoting strategy")
     p.add_argument("--no-equil", action="store_true",
                    help="disable equilibration (pdtest -e)")
@@ -68,8 +69,11 @@ def _options(args, **overrides):
                   "MMD_AT_PLUS_A": ColPerm.MMD_AT_PLUS_A,
                   "ND": ColPerm.ND_AT_PLUS_A,
                   "METIS_AT_PLUS_A": ColPerm.ND_AT_PLUS_A}[args.colperm],
-        row_perm=(RowPerm.NOROWPERM if args.rowperm == "NOROWPERM"
-                  else RowPerm.LargeDiag_MC64),
+        row_perm={"NOROWPERM": RowPerm.NOROWPERM,
+                  "MC64": RowPerm.LargeDiag_MC64,
+                  "LargeDiag_MC64": RowPerm.LargeDiag_MC64,
+                  "AWPM": RowPerm.LargeDiag_AWPM,
+                  "LargeDiag_AWPM": RowPerm.LargeDiag_AWPM}[args.rowperm],
         iter_refine=(IterRefine.NOREFINE if args.no_refine
                      else IterRefine.SLU_DOUBLE),
         trans=Trans.TRANS if args.trans else Trans.NOTRANS,

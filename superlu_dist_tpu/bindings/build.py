@@ -1,0 +1,37 @@
+"""Build libslu_tpu.so (the C/Fortran binding shim, see slu_tpu.h).
+
+Usage: python -m superlu_dist_tpu.bindings.build [outdir]
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def build(outdir: str | None = None) -> str:
+    outdir = outdir or _HERE
+    out = os.path.join(outdir, "libslu_tpu.so")
+    src = os.path.join(_HERE, "slu_tpu_capi.c")
+    if (os.path.exists(out)
+            and os.path.getmtime(out) >= os.path.getmtime(src)):
+        return out
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    tmp = f"{out}.{os.getpid()}.tmp"
+    subprocess.run(
+        ["gcc", "-O2", "-shared", "-fPIC", f"-I{inc}", f"-I{_HERE}",
+         "-o", tmp, src, f"-L{libdir}", f"-l{pyver}", "-ldl", "-lm",
+         f"-Wl,-rpath,{libdir}"],
+        check=True, capture_output=True)
+    os.replace(tmp, out)
+    return out
+
+
+if __name__ == "__main__":
+    print(build(sys.argv[1] if len(sys.argv) > 1 else None))

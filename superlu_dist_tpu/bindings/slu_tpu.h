@@ -1,0 +1,65 @@
+/*
+ * C API for the TPU-native SuperLU_DIST framework.
+ *
+ * Capability analog of the reference's C-callable library API (pdgssvx,
+ * SRC/pdgssvx.c:505) and of its handle-based Fortran wrapper layer
+ * (FORTRAN/superlu_c2f_dwrap.c:51-327): C and Fortran programs solve
+ * sparse A X = B through a solver runtime hosted in an embedded Python
+ * interpreter that drives the JAX/XLA compute path.  Factorization
+ * handles give the reference's Fact-reuse tiers (FACTORED re-solves).
+ *
+ * Matrix input: CSR with int64 indices (the XSDK 64-bit index build of the
+ * reference), double values.  Right-hand sides and solutions are
+ * column-major (Fortran order), n x nrhs.
+ *
+ * Fortran usage (ISO_C_BINDING): see superlu_mod.f90 next to this header.
+ *
+ * Link:  cc app.c -lslu_tpu $(python3-config --embed --ldflags)
+ *        with libslu_tpu.so built by bindings/build.py.
+ *
+ * All functions return 0 on success; > 0 mirrors pdgssvx's info (first
+ * zero pivot, 1-based); < 0 is a runtime/usage error.
+ */
+
+#ifndef SLU_TPU_H
+#define SLU_TPU_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Start the embedded solver runtime (idempotent).  backend may be NULL
+ * (session default), "cpu", or "tpu". */
+int slu_tpu_init(const char* backend);
+
+/* One-shot expert solve: equilibrate + row-permute + order + factor +
+ * solve + refine (the pdgssvx pipeline). */
+int slu_tpu_solve(int64_t n, int64_t nnz, const int64_t* indptr,
+                  const int64_t* indices, const double* values,
+                  const double* b, double* x, int64_t nrhs);
+
+/* Factor once, keep a handle (the dLUstruct_t analog held by the
+ * runtime); returns 0 and sets *handle on success. */
+int slu_tpu_factor(int64_t n, int64_t nnz, const int64_t* indptr,
+                   const int64_t* indices, const double* values,
+                   int64_t* handle);
+
+/* Re-solve with an existing factorization (Fact=FACTORED tier). */
+int slu_tpu_solve_factored(int64_t handle, int64_t n, const double* b,
+                           double* x, int64_t nrhs);
+
+/* Release a factorization handle. */
+int slu_tpu_free_handle(int64_t handle);
+
+/* Shut the runtime down.  TERMINAL for the process: CPython extension
+ * modules do not survive re-initialization, so any API call after this
+ * returns -4.  Only call when done with the solver for good. */
+void slu_tpu_finalize(void);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* SLU_TPU_H */

@@ -1,0 +1,61 @@
+! Fortran interface to the TPU-native SuperLU_DIST framework.
+!
+! Capability analog of the reference's handle-based Fortran-90 wrapper
+! (FORTRAN/superlu_mod.f90 + superlu_c2f_dwrap.c): thin ISO_C_BINDING
+! interfaces over the C API in slu_tpu.h.  Matrices are CSR with int64
+! indices; B/X are column-major n x nrhs, as a Fortran caller lays them
+! out naturally.
+!
+! Usage:
+!   use superlu_tpu
+!   info = slu_tpu_init(c_null_char)
+!   info = slu_tpu_solve(n, nnz, indptr, indices, values, b, x, nrhs)
+! Link against libslu_tpu.so (bindings/build.py) and the embedded-python
+! libs: $(python3-config --embed --ldflags).
+
+module superlu_tpu
+  use iso_c_binding
+  implicit none
+
+  interface
+     integer(c_int) function slu_tpu_init(backend) bind(C, name="slu_tpu_init")
+       import :: c_int, c_char
+       character(kind=c_char), dimension(*) :: backend
+     end function slu_tpu_init
+
+     integer(c_int) function slu_tpu_solve(n, nnz, indptr, indices, values, &
+          b, x, nrhs) bind(C, name="slu_tpu_solve")
+       import :: c_int, c_int64_t, c_double
+       integer(c_int64_t), value :: n, nnz, nrhs
+       integer(c_int64_t), dimension(*) :: indptr, indices
+       real(c_double), dimension(*) :: values, b
+       real(c_double), dimension(*) :: x
+     end function slu_tpu_solve
+
+     integer(c_int) function slu_tpu_factor(n, nnz, indptr, indices, values, &
+          handle) bind(C, name="slu_tpu_factor")
+       import :: c_int, c_int64_t, c_double
+       integer(c_int64_t), value :: n, nnz
+       integer(c_int64_t), dimension(*) :: indptr, indices
+       real(c_double), dimension(*) :: values
+       integer(c_int64_t) :: handle
+     end function slu_tpu_factor
+
+     integer(c_int) function slu_tpu_solve_factored(handle, n, b, x, nrhs) &
+          bind(C, name="slu_tpu_solve_factored")
+       import :: c_int, c_int64_t, c_double
+       integer(c_int64_t), value :: handle, n, nrhs
+       real(c_double), dimension(*) :: b
+       real(c_double), dimension(*) :: x
+     end function slu_tpu_solve_factored
+
+     integer(c_int) function slu_tpu_free_handle(handle) &
+          bind(C, name="slu_tpu_free_handle")
+       import :: c_int, c_int64_t
+       integer(c_int64_t), value :: handle
+     end function slu_tpu_free_handle
+
+     subroutine slu_tpu_finalize() bind(C, name="slu_tpu_finalize")
+     end subroutine slu_tpu_finalize
+  end interface
+end module superlu_tpu

@@ -31,7 +31,8 @@ from superlu_dist_tpu.utils.options import (
 from superlu_dist_tpu.utils.stats import Stats
 from superlu_dist_tpu.utils.errors import SuperLUError, SingularMatrixError
 from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
-from superlu_dist_tpu.rowperm.matching import maximum_product_matching
+from superlu_dist_tpu.rowperm.matching import (
+    maximum_product_matching, approximate_weight_matching)
 from superlu_dist_tpu.ordering.dispatch import get_perm_c
 from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize, SymbolicFact
 from superlu_dist_tpu.numeric.plan import build_plan, FactorPlan
@@ -200,6 +201,10 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         elif options.row_perm == RowPerm.LargeDiag_MC64:
             row_order, r1, c1 = maximum_product_matching(a1)
             a2 = a1.row_scale(r1).col_scale(c1).permute(perm_r=row_order)
+        elif options.row_perm == RowPerm.LargeDiag_AWPM:
+            row_order = approximate_weight_matching(a1)
+            r1 = c1 = np.ones(n)
+            a2 = a1.permute(perm_r=row_order)
         elif options.row_perm == RowPerm.MY_PERMR:
             row_order = np.asarray(options.user_perm_r, dtype=np.int64)
             r1 = c1 = np.ones(n)

@@ -79,6 +79,8 @@ def _load():
                                      ctypes.c_int64, ctypes.c_uint64, _I64]
             lib.slu_positions.argtypes = [ctypes.c_int64, _I64, _I64, _I64,
                                           _I64, _I64, _I64, _I64, _I64]
+            lib.slu_awpm.restype = ctypes.c_int
+            lib.slu_awpm.argtypes = [ctypes.c_int64, _I64, _I64, _F64, _I64]
             _lib = lib
         except Exception:
             _lib = None
@@ -191,6 +193,23 @@ def positions(s_arr, x_arr, first, last, snW, rows_ptr, rows_data):
                       _ptr_i64(first), _ptr_i64(last), _ptr_i64(snW),
                       _ptr_i64(rows_ptr), _ptr_i64(rows_data), _ptr_i64(pos))
     return pos
+
+
+def awpm(n: int, indptr, indices, absval):
+    """Approximate-weight perfect matching (HWPM analog); None if
+    unavailable.  Raises ValueError on structural singularity."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    absval = np.ascontiguousarray(absval, dtype=np.float64)
+    col_match = np.empty(n, dtype=np.int64)
+    rc = lib.slu_awpm(n, _ptr_i64(indptr), _ptr_i64(indices),
+                      _ptr_f64(absval), _ptr_i64(col_match))
+    if rc != 0:
+        raise ValueError("structurally singular")
+    return col_match
 
 
 def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1):

@@ -36,6 +36,30 @@
 #include <utility>
 #include <vector>
 
+#if defined(__GLIBC__)
+#include <malloc.h>
+// The symbolic/ordering phases allocate and free hundreds of thousands of
+// row-list vectors totalling ~GBs.  glibc serves large vectors by
+// mmap/munmap, so every reuse re-faults its pages — on slow virtualized
+// cores that dwarfs the actual merge work.  HeapScope keeps allocations on
+// the heap (no mmap, no trim) for the duration of one analysis call, then
+// restores the defaults and trims so the process does not retain the
+// transient GBs (a load-time global retune would).
+struct HeapScope {
+  HeapScope() {
+    mallopt(M_MMAP_MAX, 0);
+    mallopt(M_TRIM_THRESHOLD, -1);
+  }
+  ~HeapScope() {
+    mallopt(M_MMAP_MAX, 65536);
+    mallopt(M_TRIM_THRESHOLD, 128 * 1024);
+    malloc_trim(0);
+  }
+};
+#else
+struct HeapScope {};
+#endif
+
 using i64 = int64_t;
 
 extern "C" {
@@ -113,6 +137,7 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
                  const i64* parent, i64 relax, i64 max_supernode,
                  i64* sn_start, i64* col_to_sn, i64* sn_parent,
                  i64* sn_level, i64* rows_ptr, i64** rows_data) {
+  HeapScope heap_scope;
   if (relax > max_supernode) relax = max_supernode;
   // subtree counts (postordered labels: children have smaller ids)
   std::vector<i64> cnt(n, 1);
@@ -197,7 +222,10 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
                      std::back_inserter(tmp));
       acc.swap(tmp);
     }
-    rows_of[s] = acc;
+    // move (not copy): steals acc's buffer, avoiding a second pass over
+    // the ~nnz(L)-sized aggregate row volume
+    rows_of[s] = std::move(acc);
+    acc = std::vector<i64>();
     // chain-merge predecessors while zero fill and within max_supernode
     while (true) {
       if (first[s] == 0) break;
@@ -364,6 +392,77 @@ int slu_mc64(i64 n, const i64* indptr, const i64* indices,
   }
   for (i64 j = 0; j < n; ++j) col_match_out[j] = col_match[j];
   // convert duals so caller computes r = exp(v), c = exp(u)/colmax
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Approximate-weight perfect matching ("AWPM") — capability analog of the
+// reference's CombBLAS HWPM path (d_c2cpp_GetHWPM.cpp, dHWPM_CombBLAS.hpp):
+// a cheap, parallel-friendly alternative to exact MC64.  Greedy matching on
+// weight-sorted edges, then max-cardinality augmentation (BFS alternating
+// paths) to make it perfect.  Returns the permutation only (like HWPM — no
+// scalings).  0 ok, 1 structurally singular.
+// ---------------------------------------------------------------------------
+int slu_awpm(i64 n, const i64* indptr, const i64* indices,
+             const double* absval, i64* col_match_out) {
+  i64 nnz = indptr[n];
+  std::vector<i64> col_of(nnz);
+  for (i64 j = 0; j < n; ++j)
+    for (i64 k = indptr[j]; k < indptr[j + 1]; ++k) col_of[k] = j;
+  // only finite positive weights participate (NaN fails `> 0.0` and would
+  // otherwise break std::sort's strict-weak-ordering contract)
+  std::vector<i64> order;
+  order.reserve(nnz);
+  for (i64 k = 0; k < nnz; ++k)
+    if (absval[k] > 0.0) order.push_back(k);
+  std::sort(order.begin(), order.end(),
+            [&](i64 a, i64 b) { return absval[a] > absval[b]; });
+  std::vector<i64> row_match(n, -1), col_match(n, -1);
+  for (i64 k : order) {
+    i64 i = indices[k], j = col_of[k];
+    if (row_match[i] == -1 && col_match[j] == -1) {
+      row_match[i] = j;
+      col_match[j] = i;
+    }
+  }
+  // perfect the matching: BFS alternating paths from each unmatched column
+  // (explicit zeros are excluded, matching MC64's cost model — a zero
+  // diagonal anchor would defeat the purpose of the row permutation)
+  std::vector<i64> pred_row(n), queue_;
+  std::vector<i64> stamp(n, -1);
+  for (i64 j0 = 0; j0 < n; ++j0) {
+    if (col_match[j0] != -1) continue;
+    queue_.clear();
+    queue_.push_back(j0);
+    i64 found = -1;
+    for (size_t qh = 0; qh < queue_.size() && found == -1; ++qh) {
+      i64 j = queue_[qh];
+      for (i64 k = indptr[j]; k < indptr[j + 1]; ++k) {
+        i64 i = indices[k];
+        if (!(absval[k] > 0.0) || stamp[i] == j0) continue;
+        stamp[i] = j0;
+        pred_row[i] = j;
+        if (row_match[i] == -1) {
+          found = i;
+          break;
+        }
+        queue_.push_back(row_match[i]);
+      }
+    }
+    if (found == -1) return 1;     // no perfect matching exists
+    // backtrack: flip the alternating path (col_match[j] read before the
+    // overwrite is the row displaced from j, which continues the path)
+    i64 i = found;
+    while (true) {
+      i64 j = pred_row[i];
+      i64 displaced = col_match[j];
+      row_match[i] = j;
+      col_match[j] = i;
+      if (j == j0) break;
+      i = displaced;
+    }
+  }
+  for (i64 j = 0; j < n; ++j) col_match_out[j] = col_match[j];
   return 0;
 }
 
@@ -642,6 +741,7 @@ void leaf_md(const std::vector<i64>& nodes, const i64* indptr,
 
 void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
               uint64_t seed, i64* order_out) {
+  HeapScope heap_scope;
   std::mt19937_64 rng(seed);
   std::vector<i64> glob2loc(n, -1);
   i64 pos = 0;

@@ -129,6 +129,29 @@ def maximum_product_matching(a, want_scalings: bool = True):
     return (row_order,) + _scalings_from_duals(u, v, colmax)
 
 
+def approximate_weight_matching(a) -> np.ndarray:
+    """AWPM row permutation — the CombBLAS HWPM analog
+    (SRC/d_c2cpp_GetHWPM.cpp, dHWPM_CombBLAS.hpp:40): a cheap approximate
+    maximum-weight perfect matching (greedy on weight-sorted edges +
+    max-cardinality augmentation), permutation only, no scalings.
+
+    Falls back to the exact MC64 matching (without scalings) when the
+    native library is unavailable — exact is a valid "approximation".
+    """
+    csc = a if isinstance(a, SparseCSC) else a.tocsc()
+    n, m = csc.shape
+    if n != m:
+        raise SuperLUError("matching requires a square matrix")
+    from superlu_dist_tpu import native
+    if native.available():
+        try:
+            return native.awpm(n, csc.indptr, csc.indices, np.abs(csc.data))
+        except ValueError as e:
+            raise SuperLUError(f"structurally singular: {e}") from e
+    row_order, _, _ = maximum_product_matching(csc, want_scalings=False)
+    return row_order
+
+
 def _scalings_from_duals(u: np.ndarray, v: np.ndarray, colmax: np.ndarray):
     """r_i = exp(v_i), c_j = exp(u_j)/colmax_j => matched |r_i a_ij c_j| = 1
     (the MC64 job=5 scaling recovery, shared by the native and Python

@@ -50,7 +50,9 @@ class RowPerm(enum.Enum):
 
     NOROWPERM = 0
     LargeDiag_MC64 = 1      # maximum-product weighted bipartite matching
-    MY_PERMR = 2
+    LargeDiag_AWPM = 2      # approximate-weight perfect matching (the
+                            # CombBLAS HWPM analog — perm only, no scalings)
+    MY_PERMR = 3
 
 
 class IterRefine(enum.Enum):

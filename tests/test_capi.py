@@ -1,0 +1,34 @@
+"""C-binding smoke test: compile the C client against libslu_tpu.so and run
+it (the reference's FORTRAN/EXAMPLE binding tests, SURVEY.md §2.2 item 6)."""
+
+import os
+import subprocess
+import sys
+import sysconfig
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+BINDINGS = os.path.join(HERE, "..", "superlu_dist_tpu", "bindings")
+
+
+@pytest.mark.skipif(not os.path.exists("/usr/bin/gcc"), reason="no gcc")
+def test_c_client_roundtrip(tmp_path):
+    from superlu_dist_tpu.bindings.build import build
+    lib = build()
+    exe = str(tmp_path / "test_capi")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(BINDINGS, "test_capi.c"),
+         "-I", BINDINGS, "-o", exe, lib,
+         f"-L{libdir}", f"-l{pyver}", "-lm", "-ldl",
+         f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{os.path.abspath(BINDINGS)}"],
+        check=True, capture_output=True)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.abspath(os.path.join(HERE, ".."))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    res = subprocess.run([exe], capture_output=True, text=True, env=env,
+                         timeout=300)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "PASS" in res.stdout

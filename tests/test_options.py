@@ -83,6 +83,27 @@ def test_my_permc_and_permr():
     assert np.array_equal(lu.row_order, perm_r)
 
 
+def test_awpm_rowperm():
+    """LargeDiag_AWPM (the HWPM analog) must produce a valid row order that
+    solves matrices needing pivoting, without scalings."""
+    from superlu_dist_tpu.models.gallery import random_sparse
+    from superlu_dist_tpu.rowperm.matching import (
+        approximate_weight_matching)
+    a = random_sparse(80, density=0.08, seed=12)
+    order = approximate_weight_matching(a)
+    assert sorted(order) == list(range(80))
+    # the matched diagonal must be structurally nonzero everywhere
+    ad = a.permute(perm_r=order).to_dense()
+    assert (np.abs(np.diag(ad)) > 0).all()
+    xt = np.random.default_rng(1).standard_normal(80)
+    b = a.matvec(xt)
+    x, lu, stats, info = gssvx(
+        Options(row_perm=RowPerm.LargeDiag_AWPM), a, b)
+    assert info == 0
+    np.testing.assert_allclose(x, xt, rtol=1e-7, atol=1e-7)
+    assert np.all(lu.r1 == 1) and np.all(lu.c1 == 1)
+
+
 def test_slu_single_refinement():
     """SLU_SINGLE refines with an f32 residual: converges to ~single eps,
     not double."""
