@@ -84,6 +84,15 @@ def _time_factor(ex, avals, thresh, reps):
         t0 = time.perf_counter()
         out = jax.block_until_ready(ex(avals, thresh))
         times.append(time.perf_counter() - t0)
+    if ex.last_profile:
+        # kernel-shape trace (dgemm_mnk.dat analog) to stderr, top by time
+        import sys
+        top = sorted(ex.last_profile, key=lambda r: -r["seconds"])[:15]
+        for r in top:
+            print(f"# lvl={r['level']:<3d} B={r['batch']:<5d} m={r['m']:<5d} "
+                  f"w={r['w']:<5d} u={r['u']:<5d} {r['seconds']*1e3:8.2f} ms "
+                  f"{r['gflop']/max(r['seconds'],1e-12):8.1f} GF/s",
+                  file=sys.stderr)
     return min(times), out
 
 

@@ -149,12 +149,17 @@ class LUFactorization:
 
 
 def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
-          lu: LUFactorization | None = None, stats: Stats | None = None):
+          lu: LUFactorization | None = None, stats: Stats | None = None,
+          grid=None):
     """Solve A·X = B.  Returns (x, lu, stats, info).
 
     info = 0 on success; > 0 mirrors the reference's singularity reporting
     via tiny-pivot counts in stats (with ReplaceTinyPivot the factorization
     always completes, pdgstrf2.c:218-232).
+
+    `grid` is a parallel.grid.ProcessGrid (the reference passes gridinfo_t
+    to pdgssvx): the numeric factorization and device solve then run
+    sharded over the grid's mesh.
     """
     if stats is None:
         stats = Stats()
@@ -263,7 +268,9 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
     with stats.timer("FACT"):
         numeric = numeric_factorize(plan, bvals, anorm, dtype=dtype,
-                                    replace_tiny=options.replace_tiny_pivot)
+                                    replace_tiny=options.replace_tiny_pivot,
+                                    mesh=grid.mesh if grid is not None
+                                    else None)
         for f in numeric.fronts:
             f.block_until_ready()
     stats.ops["FACT"] += plan.flops

@@ -81,6 +81,7 @@ def _load():
                                           _I64, _I64, _I64, _I64, _I64]
             lib.slu_awpm.restype = ctypes.c_int
             lib.slu_awpm.argtypes = [ctypes.c_int64, _I64, _I64, _F64, _I64]
+            lib.slu_mmd.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
             _lib = lib
         except Exception:
             _lib = None
@@ -193,6 +194,18 @@ def positions(s_arr, x_arr, first, last, snW, rows_ptr, rows_data):
                       _ptr_i64(first), _ptr_i64(last), _ptr_i64(snW),
                       _ptr_i64(rows_ptr), _ptr_i64(rows_data), _ptr_i64(pos))
     return pos
+
+
+def mmd(n: int, indptr, indices):
+    """Exact-external-degree minimum-degree ordering; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    order = np.empty(n, dtype=np.int64)
+    lib.slu_mmd(n, _ptr_i64(indptr), _ptr_i64(indices), _ptr_i64(order))
+    return order
 
 
 def awpm(n: int, indptr, indices, absval):

@@ -396,6 +396,108 @@ int slu_mc64(i64 n, const i64* indptr, const i64* indices,
 }
 
 // ---------------------------------------------------------------------------
+// Exact-external-degree minimum-degree ordering on a quotient graph with
+// element absorption — the MMD capability analog (reference genmmd_dist_,
+// SRC/mmd.c, dispatched by get_perm_c.c:463-530).  Exact mirror of
+// ordering/minimum_degree.py (same tie-breaking: smallest vertex id on
+// equal degree), so the Python implementation remains the test oracle.
+// Sets are sorted vectors; element ids are n + elimination step.
+// ---------------------------------------------------------------------------
+namespace {
+
+using VSet = std::vector<i64>;  // sorted, unique
+
+VSet vset_union(const VSet& a, const VSet& b) {
+  VSet out;
+  out.reserve(a.size() + b.size());
+  std::set_union(a.begin(), a.end(), b.begin(), b.end(),
+                 std::back_inserter(out));
+  return out;
+}
+
+void vset_subtract(VSet& a, const VSet& b) {
+  if (a.empty() || b.empty()) return;
+  VSet out;
+  out.reserve(a.size());
+  std::set_difference(a.begin(), a.end(), b.begin(), b.end(),
+                      std::back_inserter(out));
+  a.swap(out);
+}
+
+void vset_erase(VSet& a, i64 x) {
+  auto it = std::lower_bound(a.begin(), a.end(), x);
+  if (it != a.end() && *it == x) a.erase(it);
+}
+
+void vset_insert(VSet& a, i64 x) {
+  auto it = std::lower_bound(a.begin(), a.end(), x);
+  if (it == a.end() || *it != x) a.insert(it, x);
+}
+
+}  // namespace
+
+void slu_mmd(i64 n, const i64* indptr, const i64* indices, i64* order_out) {
+  HeapScope heap_scope;
+  std::vector<VSet> adj(n);
+  for (i64 i = 0; i < n; ++i)
+    for (i64 p = indptr[i]; p < indptr[i + 1]; ++p) {
+      i64 j = indices[p];
+      if (j != i) {
+        adj[i].push_back(j);
+        adj[j].push_back(i);
+      }
+    }
+  for (i64 i = 0; i < n; ++i) {
+    std::sort(adj[i].begin(), adj[i].end());
+    adj[i].erase(std::unique(adj[i].begin(), adj[i].end()), adj[i].end());
+  }
+  std::vector<VSet> var_elems(n);          // element ids adjacent to var
+  std::vector<VSet> elem_vars(n);          // index k <-> element id n+k
+  std::vector<char> alive(n, 1);
+  std::vector<i64> degree(n);
+  using QE = std::pair<i64, i64>;
+  std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
+  for (i64 v = 0; v < n; ++v) {
+    degree[v] = (i64)adj[v].size();
+    heap.emplace(degree[v], v);
+  }
+
+  auto external = [&](i64 v) {
+    VSet s = adj[v];
+    for (i64 e : var_elems[v]) s = vset_union(s, elem_vars[e - n]);
+    vset_erase(s, v);
+    return s;
+  };
+
+  for (i64 k = 0; k < n; ++k) {
+    i64 v;
+    while (true) {
+      auto [d, u] = heap.top();
+      heap.pop();
+      if (alive[u] && d == degree[u]) {
+        v = u;
+        break;
+      }
+    }
+    order_out[k] = v;
+    alive[v] = 0;
+    VSet le = external(v);
+    const VSet absorbed = var_elems[v];     // copy: elements of v, absorbed
+    for (i64 e : absorbed) elem_vars[e - n].clear();
+    elem_vars[k] = le;
+    i64 eid = n + k;
+    for (i64 u : le) {
+      vset_erase(adj[u], v);
+      vset_subtract(adj[u], le);            // edges now covered by element
+      vset_subtract(var_elems[u], absorbed);
+      vset_insert(var_elems[u], eid);
+      degree[u] = (i64)external(u).size();
+      heap.emplace(degree[u], u);
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Approximate-weight perfect matching ("AWPM") — capability analog of the
 // reference's CombBLAS HWPM path (d_c2cpp_GetHWPM.cpp, dHWPM_CombBLAS.hpp):
 // a cheap, parallel-friendly alternative to exact MC64.  Greedy matching on

@@ -164,27 +164,29 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
     return jax.jit(fn)
 
 
-def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto"):
+def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
+                 mesh=None):
     """Executor for a plan, cached on the plan (SamePattern reuse tier).
 
     executor: "fused" (one XLA program — fast dispatch, compile grows with
     plan size), "stream" (per-bucket kernels — compile count is bounded,
     right for real TPU where program compile is expensive), or "auto"
-    (stream on accelerators, fused on CPU).
+    (stream on accelerators, fused on CPU).  mesh shards either executor
+    over ("snode", "panel").
     """
     if executor == "auto":
         executor = "fused" if jax.default_backend() == "cpu" else "stream"
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
-    key = (str(jnp.dtype(dtype)), executor)
+    key = (str(jnp.dtype(dtype)), executor, mesh)
     fn = cache.get(key)
     if fn is None:
         if executor == "stream":
             from superlu_dist_tpu.numeric.stream import StreamExecutor
-            fn = StreamExecutor(plan, dtype)
+            fn = StreamExecutor(plan, dtype, mesh=mesh)
         else:
-            fn = make_factor_fn(plan, dtype)
+            fn = make_factor_fn(plan, dtype, mesh=mesh)
         cache[key] = fn
     return fn
 
@@ -192,7 +194,8 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto"):
 def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       anorm: float, dtype="float64",
                       replace_tiny: bool = True,
-                      executor: str = "auto") -> NumericFactorization:
+                      executor: str = "auto",
+                      mesh=None) -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
@@ -209,7 +212,7 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)
     avals = jnp.asarray(pattern_values, dtype=dtype)
-    fn = get_executor(plan, dtype, executor)
+    fn = get_executor(plan, dtype, executor, mesh=mesh)
     fronts_out, tiny_total = fn(avals, thresh)
     fronts_out = list(fronts_out)
     finite = True

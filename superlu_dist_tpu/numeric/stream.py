@@ -84,6 +84,7 @@ class StreamExecutor:
         self.plan = plan
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
+        self.last_profile = None   # filled when SLU_TPU_PROFILE is set
         n_avals = len(plan.pattern_indices)
         self._steps = []
         for grp in plan.groups:
@@ -123,11 +124,31 @@ class StreamExecutor:
             rep = NamedSharding(self.mesh, P(None))
             pool = jax.device_put(pool, rep)
             avals = jax.device_put(avals, rep)
+        # kernel-shape trace (the reference's PROFlevel GEMM trace,
+        # pdgstrf.c:380-387 -> dgemm_mnk.dat): per-group synchronous timing.
+        # NOTE: blocking per group serializes the async dispatch stream, so
+        # profiled runs measure per-kernel cost, not end-to-end overlap.
+        import os
+        profile = bool(os.environ.get("SLU_TPU_PROFILE"))
+        if profile:
+            import time
+            self.last_profile = []
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
-        for (key, a, child_arrs, nreal) in self._steps:
+        for gi, (key, a, child_arrs, nreal) in enumerate(self._steps):
             kern = _kernel(*key, self.mesh)
+            if profile:
+                t0 = time.perf_counter()
             packed, pool, t = kern(avals, pool, thresh, *a, *child_arrs)
+            if profile:
+                jax.block_until_ready(packed)
+                (b, m, w, u), _, _, _, _ = key
+                grp = plan.groups[gi]
+                gflop = (2 / 3 * w**3 + 2 * w * w * u
+                         + 2 * w * u * u) * grp.batch / 1e9
+                self.last_profile.append({
+                    "level": grp.level, "batch": b, "m": m, "w": w, "u": u,
+                    "seconds": time.perf_counter() - t0, "gflop": gflop})
             fronts.append(packed[:nreal] if packed.shape[0] != nreal
                           else packed)
             tiny = tiny + t

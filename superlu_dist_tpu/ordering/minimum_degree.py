@@ -19,8 +19,16 @@ import numpy as np
 def minimum_degree(n: int, indptr: np.ndarray, indices: np.ndarray) -> np.ndarray:
     """Return an elimination order (order[k] = k-th pivot, old index).
 
-    Input is the symmetric adjacency pattern (diagonal ignored).
+    Input is the symmetric adjacency pattern (diagonal ignored).  Uses the
+    native implementation (slu_host.cpp slu_mmd — same algorithm and
+    tie-breaking, compiled) when available; this Python version is the
+    specification and fallback.
     """
+    from superlu_dist_tpu import native
+    order = native.mmd(n, indptr, indices)
+    if order is not None:
+        return order
+
     adj = [set() for _ in range(n)]
     for i in range(n):
         for j in indices[indptr[i]:indptr[i + 1]]:

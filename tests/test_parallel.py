@@ -81,6 +81,22 @@ def test_sharded_stream_matches_single(shape):
                                    rtol=1e-12, atol=1e-12)
 
 
+def test_gssvx_with_grid_matches_serial():
+    """The driver accepts a ProcessGrid (pdgssvx's gridinfo_t argument):
+    full pipeline sharded over the mesh == single-device result."""
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.utils.options import Options
+    a = poisson2d(11)
+    xt = np.random.default_rng(6).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    x0, _, _, info0 = gssvx(Options(), a, b)
+    grid = gridinit(4, 2)
+    x1, lu1, stats1, info1 = gssvx(Options(), a, b, grid=grid)
+    assert info0 == info1 == 0
+    np.testing.assert_allclose(x1, x0, rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(x1, xt, rtol=1e-8, atol=1e-8)
+
+
 def test_device_solve_on_sharded_factors():
     """The pdgstrs analog must work when the factors live sharded on the
     mesh (solve after a multi-chip factorization, no host round-trip)."""
