@@ -27,7 +27,8 @@ import numpy as np
 
 from superlu_dist_tpu.sparse.formats import SparseCSR, symmetrize_pattern
 from superlu_dist_tpu.utils.options import (
-    Options, Fact, RowPerm, IterRefine, Trans, default_factor_dtype)
+    Options, Fact, RowPerm, IterRefine, Trans, default_factor_dtype,
+    print_options)
 from superlu_dist_tpu.utils.stats import Stats
 from superlu_dist_tpu.utils.errors import SuperLUError, SingularMatrixError
 from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
@@ -163,6 +164,8 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
     """
     if stats is None:
         stats = Stats()
+    if options.print_stat:
+        print(print_options(options))
     n = a.n_rows
     if a.n_cols != n:
         raise SuperLUError("A must be square")

@@ -105,9 +105,37 @@ class Options:
     user_perm_r: object = dataclasses.field(default=None, compare=False)
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
 def set_default_options() -> Options:
-    """Analog of set_default_options_dist (SRC/util.c:376)."""
-    return Options()
+    """Analog of set_default_options_dist (SRC/util.c:376) + the sp_ienv
+    environment tier (SRC/sp_ienv.c:70-123): NREL (relax), NSUP (max
+    supernode), plus the TPU-native bucket knobs.
+    """
+    o = Options()
+    o.relax = _env_int("NREL", o.relax)
+    o.max_supernode = _env_int("NSUP", o.max_supernode)
+    o.min_bucket = _env_int("SLU_TPU_MIN_BUCKET", o.min_bucket)
+    return o
+
+
+def print_options(o: Options) -> str:
+    """print_options_dist analog (SRC/util.c:405-439)."""
+    lines = ["**************************************************",
+             ".. options:"]
+    for f in dataclasses.fields(o):
+        v = getattr(o, f.name)
+        if f.name in ("user_perm_c", "user_perm_r"):
+            # summarize, never dump an n-entry permutation into the banner
+            v = None if v is None else f"<perm len={len(v)}>"
+        lines.append(f"**    {f.name:<20s} {getattr(v, 'name', v)}")
+    lines.append("**************************************************")
+    return "\n".join(lines)
 
 
 def default_factor_dtype() -> str:

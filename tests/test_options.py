@@ -119,6 +119,28 @@ def test_slu_single_refinement():
     assert lu.berrs and lu.berrs[-1] < 1e-5
 
 
+def test_sp_ienv_env_tier(monkeypatch):
+    """NREL/NSUP env overrides (the sp_ienv_dist tier, SRC/sp_ienv.c)."""
+    from superlu_dist_tpu.utils.options import set_default_options
+    monkeypatch.setenv("NREL", "7")
+    monkeypatch.setenv("NSUP", "99")
+    o = set_default_options()
+    assert o.relax == 7 and o.max_supernode == 99
+    monkeypatch.setenv("NREL", "bogus")
+    assert set_default_options().relax == Options().relax
+
+
+def test_print_options_echo(capsys):
+    """print_options_dist analog + PrintStat echo."""
+    from superlu_dist_tpu.utils.options import print_options
+    s = print_options(Options())
+    assert "col_perm" in s and "ND_AT_PLUS_A" in s
+    a = poisson2d(5)
+    gssvx(Options(print_stat=True), a, np.ones(a.n_rows))
+    out = capsys.readouterr().out
+    assert ".. options:" in out and "FACT" in out
+
+
 def test_singularity_info_is_localized():
     """info must be the 1-based first zero-pivot column in the final
     labeling (pdgstrf.c:1920-1924), not a bare flag."""
