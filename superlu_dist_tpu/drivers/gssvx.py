@@ -132,7 +132,8 @@ class LUFactorization:
             try:
                 if self.dev_solver is None:
                     from superlu_dist_tpu.solve.device import DeviceSolver
-                    self.dev_solver = DeviceSolver(self.numeric)
+                    self.dev_solver = DeviceSolver(
+                        self.numeric, diag_inv=self.options.diag_inv)
                 return self.dev_solver.solve(d)
             except Exception as e:
                 if self.solve_path != "auto":

@@ -40,7 +40,7 @@ def _build() -> str | None:
         # bench + tests) must not interleave writes; os.replace is atomic
         tmp = f"{_LIB}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+            ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
              "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=300)
         os.replace(tmp, _LIB)
@@ -71,6 +71,11 @@ def _load():
                 ctypes.c_int64, _I64, _I64, _I64, ctypes.c_int64,
                 ctypes.c_int64, _I64, _I64, _I64, _I64, _I64,
                 ctypes.POINTER(_I64)]
+            lib.slu_symbolic_mt.restype = ctypes.c_int64
+            lib.slu_symbolic_mt.argtypes = [
+                ctypes.c_int64, _I64, _I64, _I64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64,
+                _I64, ctypes.POINTER(_I64)]
             lib.slu_free_i64.argtypes = [_I64]
             lib.slu_mc64.restype = ctypes.c_int
             lib.slu_mc64.argtypes = [ctypes.c_int64, _I64, _I64, _F64,
@@ -127,9 +132,11 @@ def postorder(parent: np.ndarray):
     return post
 
 
-def symbolic(n: int, indptr, indices, parent, relax: int, max_supernode: int):
-    """Native supernodal symbolic.  Returns (sn_start, col_to_sn, sn_parent,
-    sn_level, rows_ptr, rows_data) or None."""
+def symbolic(n: int, indptr, indices, parent, relax: int, max_supernode: int,
+             nthreads: int = 1):
+    """Native supernodal symbolic (nthreads > 1 => the symbfact_dist
+    analog, subtree-to-worker threads).  Returns (sn_start, col_to_sn,
+    sn_parent, sn_level, rows_ptr, rows_data) or None."""
     lib = _load()
     if lib is None:
         return None
@@ -142,11 +149,14 @@ def symbolic(n: int, indptr, indices, parent, relax: int, max_supernode: int):
     sn_level = np.empty(n, dtype=np.int64)
     rows_ptr = np.empty(n + 1, dtype=np.int64)
     rows_data_p = _I64()
-    ns = lib.slu_symbolic(n, _ptr_i64(indptr), _ptr_i64(indices),
-                          _ptr_i64(parent), relax, max_supernode,
-                          _ptr_i64(sn_start), _ptr_i64(col_to_sn),
-                          _ptr_i64(sn_parent), _ptr_i64(sn_level),
-                          _ptr_i64(rows_ptr), ctypes.byref(rows_data_p))
+    # slu_symbolic_mt with nthreads=1 IS the serial path (symbolic_impl
+    # dispatches internally), so one call site serves both
+    ns = lib.slu_symbolic_mt(n, _ptr_i64(indptr), _ptr_i64(indices),
+                             _ptr_i64(parent), relax, max_supernode,
+                             max(nthreads, 1), _ptr_i64(sn_start),
+                             _ptr_i64(col_to_sn), _ptr_i64(sn_parent),
+                             _ptr_i64(sn_level), _ptr_i64(rows_ptr),
+                             ctypes.byref(rows_data_p))
     if ns < 0:
         return None
     total = int(rows_ptr[ns])

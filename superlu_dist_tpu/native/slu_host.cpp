@@ -28,11 +28,13 @@
 // Build: g++ -O3 -shared -fPIC (see native/__init__.py; no external deps).
 
 #include <algorithm>
+#include <atomic>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
 #include <queue>
 #include <random>
+#include <thread>
 #include <utility>
 #include <vector>
 
@@ -133,10 +135,11 @@ void slu_postorder(i64 n, const i64* parent, i64* post) {
 // sn_level (n), rows_ptr (n+1).  rows_data is malloc'd here (size
 // rows_ptr[ns]); caller frees via slu_free_i64.
 // ---------------------------------------------------------------------------
-i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
-                 const i64* parent, i64 relax, i64 max_supernode,
-                 i64* sn_start, i64* col_to_sn, i64* sn_parent,
-                 i64* sn_level, i64* rows_ptr, i64** rows_data) {
+static i64 symbolic_impl(i64 n, const i64* indptr, const i64* indices,
+                         const i64* parent, i64 relax, i64 max_supernode,
+                         i64 nthreads, i64* sn_start, i64* col_to_sn,
+                         i64* sn_parent, i64* sn_level, i64* rows_ptr,
+                         i64** rows_data) {
   HeapScope heap_scope;
   if (relax > max_supernode) relax = max_supernode;
   // subtree counts (postordered labels: children have smaller ids)
@@ -191,8 +194,17 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
   // set_union smallest-first instead of sorting the concatenation — the
   // reference's symbolic does the analogous pruned merges column-by-column
   // (symbfact.c:455); at n~1e6 this is the host-analysis hot spot.
-  std::vector<i64> buf, acc, tmp;
-  for (i64 s = 0; s < ns0; ++s) {
+  //
+  // process_one computes supernode s's rows + chain-merges predecessors
+  // within [range_lo, s]; registration of s with its parent is the
+  // CALLER's job (serial: immediate; threaded: subtree roots defer to the
+  // sequential top phase).  The restriction to range_lo is the only
+  // divergence of the threaded result from serial output: chain merges
+  // cannot cross a subtree boundary (same class of difference as the
+  // reference's parallel symbolic vs serial, psymbfact.c:228-242 — a
+  // valid alternative supernode partition over identical fill).
+  auto process_one = [&](i64 s, i64 range_lo, std::vector<i64>& buf,
+                         std::vector<i64>& acc, std::vector<i64>& tmp) {
     i64 l = last[s];
     // structural piece (small): entries > l from this supernode's columns
     buf.clear();
@@ -230,7 +242,7 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
     while (true) {
       if (first[s] == 0) break;
       i64 c = by_last[first[s] - 1];
-      if (c < 0 || !alive[c]) break;
+      if (c < range_lo || !alive[c]) break;
       if (last[s] - first[c] + 1 > max_supernode) break;
       const auto& rc = rows_of[c];
       if (rc.empty() || rc[0] != first[s] ||
@@ -240,7 +252,65 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
       alive[c] = 0;
       first[s] = first[c];
     }
-    if (!rows_of[s].empty()) kids[c2s0[rows_of[s][0]]].push_back(s);
+  };
+
+  if (nthreads <= 1 || ns0 < 4 * nthreads) {
+    std::vector<i64> buf, acc, tmp;
+    for (i64 s = 0; s < ns0; ++s) {
+      process_one(s, 0, buf, acc, tmp);
+      if (!rows_of[s].empty()) kids[c2s0[rows_of[s][0]]].push_back(s);
+    }
+  } else {
+    // ---- threaded bottom-up (the psymbfact subtree-to-worker analog) ----
+    // The supernode tree is known upfront: parent supernode of s is the
+    // owner of etree-parent(last[s]) (the first below-diagonal row).
+    std::vector<i64> p0(ns0, -1), cnt_s(ns0, 1);
+    for (i64 s = 0; s < ns0; ++s)
+      if (parent[last[s]] >= 0) p0[s] = c2s0[parent[last[s]]];
+    for (i64 s = 0; s < ns0; ++s)
+      if (p0[s] >= 0) cnt_s[p0[s]] += cnt_s[s];
+    // subtree roots: contiguous id ranges [r-cnt_s[r]+1, r] small enough
+    // to balance, big enough to amortize a thread
+    i64 target = std::max<i64>(64, ns0 / (4 * nthreads));
+    std::vector<std::pair<i64, i64>> ranges;   // [lo, r] inclusive
+    std::vector<char> in_range(ns0, 0);
+    for (i64 r = 0; r < ns0; ++r) {
+      bool root = cnt_s[r] <= target &&
+                  (p0[r] < 0 || cnt_s[p0[r]] > target);
+      if (root && cnt_s[r] >= 16) {
+        ranges.emplace_back(r - cnt_s[r] + 1, r);
+        for (i64 s = r - cnt_s[r] + 1; s <= r; ++s) in_range[s] = 1;
+      }
+    }
+    std::vector<std::thread> pool;
+    std::atomic<size_t> next{0};
+    auto worker = [&]() {
+      std::vector<i64> buf, acc, tmp;
+      while (true) {
+        size_t t = next.fetch_add(1);
+        if (t >= ranges.size()) break;
+        auto [lo, hi] = ranges[t];
+        for (i64 s = lo; s <= hi; ++s) {
+          process_one(s, lo, buf, acc, tmp);
+          // register within the subtree only; roots defer to the top phase
+          if (s != hi && !rows_of[s].empty())
+            kids[c2s0[rows_of[s][0]]].push_back(s);
+        }
+      }
+    };
+    i64 nt = std::min<i64>(nthreads, (i64)ranges.size());
+    for (i64 t = 0; t < nt; ++t) pool.emplace_back(worker);
+    for (auto& th : pool) th.join();
+    // top phase (sequential): register subtree roots, then process the
+    // remaining supernodes in ascending order
+    for (auto [lo, hi] : ranges)
+      if (!rows_of[hi].empty()) kids[c2s0[rows_of[hi][0]]].push_back(hi);
+    std::vector<i64> buf, acc, tmp;
+    for (i64 s = 0; s < ns0; ++s) {
+      if (in_range[s]) continue;
+      process_one(s, 0, buf, acc, tmp);
+      if (!rows_of[s].empty()) kids[c2s0[rows_of[s][0]]].push_back(s);
+    }
   }
 
   // compact to live supernodes
@@ -278,6 +348,35 @@ i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
     if (p >= 0 && sn_level[p] < sn_level[k] + 1) sn_level[p] = sn_level[k] + 1;
   }
   return ns;
+}
+
+i64 slu_symbolic(i64 n, const i64* indptr, const i64* indices,
+                 const i64* parent, i64 relax, i64 max_supernode,
+                 i64* sn_start, i64* col_to_sn, i64* sn_parent,
+                 i64* sn_level, i64* rows_ptr, i64** rows_data) {
+  return symbolic_impl(n, indptr, indices, parent, relax, max_supernode, 1,
+                       sn_start, col_to_sn, sn_parent, sn_level, rows_ptr,
+                       rows_data);
+}
+
+// Parallel symbolic factorization — capability analog of symbfact_dist
+// (SRC/psymbfact.c:140): subtree-to-worker decomposition over the
+// supernode tree (known upfront from the etree), threads computing
+// independent subtrees' row structures bottom-up, a sequential pass for
+// the top separators.  Produces identical fill; supernode chain merges
+// cannot cross subtree boundaries, so the partition may differ slightly
+// from the serial one (the reference's parallel symbolic likewise
+// produces different-but-valid structures).
+i64 slu_symbolic_mt(i64 n, const i64* indptr, const i64* indices,
+                    const i64* parent, i64 relax, i64 max_supernode,
+                    i64 nthreads, i64* sn_start, i64* col_to_sn,
+                    i64* sn_parent, i64* sn_level, i64* rows_ptr,
+                    i64** rows_data) {
+  if (nthreads <= 0)
+    nthreads = (i64)std::max(1u, std::thread::hardware_concurrency());
+  return symbolic_impl(n, indptr, indices, parent, relax, max_supernode,
+                       nthreads, sn_start, col_to_sn, sn_parent, sn_level,
+                       rows_ptr, rows_data);
 }
 
 void slu_free_i64(i64* p) { std::free(p); }

@@ -33,10 +33,14 @@ def _bucket_nrhs(k: int) -> int:
 
 
 @functools.lru_cache(maxsize=None)
-def _fwd_kernel(batch, m, w, u, nrhs, n, dtype):
-    """x[cols] <- L11⁻¹(x[cols] − lsum[cols]); lsum[rows] += L21·x[cols]."""
+def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
+    """x[cols] <- L11⁻¹(x[cols] − lsum[cols]); lsum[rows] += L21·x[cols].
 
-    def step(fronts, x, lsum, first, rows, ws):
+    With use_inv, L11⁻¹ arrives precomputed and the triangular solve
+    becomes one batched GEMM (the reference's DiagInv fast path,
+    pdgstrs.c:1252-1396: dense X(k) = Linv(k)·b via dgemm)."""
+
+    def step(fronts, x, lsum, first, rows, ws, linv=None):
         k = jnp.arange(w)
         # padded pivot columns (k >= ws) would alias the NEXT supernode's
         # entries — clamp them to the dump row n-1 (factor cols/rows there
@@ -45,9 +49,12 @@ def _fwd_kernel(batch, m, w, u, nrhs, n, dtype):
                          first[:, None] + k, n - 1)      # (B, w)
         rhs = (x.at[cols].get(mode="fill", fill_value=0)
                - lsum.at[cols].get(mode="fill", fill_value=0))
-        l11 = fronts[:, :w, :w]
-        y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
-            l, b, lower=True, unit_diagonal=True))(l11, rhs)
+        if use_inv:
+            y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
+        else:
+            l11 = fronts[:, :w, :w]
+            y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
+                l, b, lower=True, unit_diagonal=True))(l11, rhs)
         x = x.at[cols].set(y, mode="drop")
         if u:
             contrib = jnp.matmul(fronts[:, w:, :w], y,
@@ -59,10 +66,10 @@ def _fwd_kernel(batch, m, w, u, nrhs, n, dtype):
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_kernel(batch, m, w, u, nrhs, n, dtype):
+def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
     """x[cols] <- U11⁻¹(x[cols] − U12·x[rows])."""
 
-    def step(fronts, x, first, rows, ws):
+    def step(fronts, x, first, rows, ws, uinv=None):
         k = jnp.arange(w)
         cols = jnp.where(k[None, :] < ws[:, None],
                          first[:, None] + k, n - 1)
@@ -71,12 +78,32 @@ def _bwd_kernel(batch, m, w, u, nrhs, n, dtype):
             xr = x.at[rows].get(mode="fill", fill_value=0)   # (B, u, nrhs)
             rhs = rhs - jnp.matmul(fronts[:, :w, w:], xr,
                                    precision=jax.lax.Precision.HIGHEST)
-        u11 = fronts[:, :w, :w]
-        y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
-            r, b, lower=False))(u11, rhs)
+        if use_inv:
+            y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
+        else:
+            u11 = fronts[:, :w, :w]
+            y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
+                r, b, lower=False))(u11, rhs)
         return x.at[cols].set(y, mode="drop")
 
     return jax.jit(step, donate_argnums=(1,))
+
+
+@functools.lru_cache(maxsize=None)
+def _diag_inv_kernel(w, dtype):
+    """Batched inverses of the packed diagonal blocks — the
+    pdCompute_Diag_Inv analog (SRC/pdgstrs.c:647, dtrtri per block)."""
+
+    def inv(fronts):
+        f11 = fronts[:, :w, :w]
+        eye = jnp.eye(w, dtype=fronts.dtype)
+        linv = jax.vmap(lambda l: jax.scipy.linalg.solve_triangular(
+            l, eye, lower=True, unit_diagonal=True))(f11)
+        uinv = jax.vmap(lambda r: jax.scipy.linalg.solve_triangular(
+            r, eye, lower=False))(f11)
+        return linv, uinv
+
+    return jax.jit(inv)
 
 
 class DeviceSolver:
@@ -87,14 +114,16 @@ class DeviceSolver:
     caches them behind SolveInitialized, pdgssvx.c:1330-1337).
     """
 
-    def __init__(self, fact: NumericFactorization):
+    def __init__(self, fact: NumericFactorization, diag_inv: bool = False):
         self.fact = fact
+        self.diag_inv = diag_inv
         plan = fact.plan
         sf = plan.sf
         self.n = plan.n
         first = sf.sn_start[:-1]
         self._groups = []
-        for grp in plan.groups:
+        self._invs = []
+        for grp, fronts in zip(plan.groups, fact.fronts):
             firsts = jnp.asarray(first[grp.sns])
             rows = np.full((grp.batch, grp.u), self.n, dtype=np.int64)
             for slot, s in enumerate(grp.sns):
@@ -102,6 +131,11 @@ class DeviceSolver:
                 rows[slot, :len(r)] = r
             self._groups.append((grp, firsts, jnp.asarray(rows),
                                  jnp.asarray(grp.ws)))
+            if diag_inv:
+                kern = _diag_inv_kernel(grp.w, str(jnp.dtype(fact.dtype)))
+                self._invs.append(kern(fronts))
+            else:
+                self._invs.append((None, None))
 
     def solve(self, rhs: np.ndarray) -> np.ndarray:
         """rhs (n,) or (n, k) in permuted labeling -> solution, same shape."""
@@ -116,16 +150,22 @@ class DeviceSolver:
         x = jnp.asarray(pad)        # slot n is the OOB dump row
         lsum = jnp.zeros_like(x)
         n1 = self.n + 1
+        use_inv = self.diag_inv
         # forward, levels ascending (groups are in level order)
-        for (grp, firsts, rows, ws), fronts in zip(self._groups, fact.fronts):
+        for (grp, firsts, rows, ws), fronts, (linv, _) in zip(
+                self._groups, fact.fronts, self._invs):
             kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                               str(dt))
-            x, lsum = kern(fronts, x, lsum, firsts, rows, ws)
+                               str(dt), use_inv)
+            x, lsum = (kern(fronts, x, lsum, firsts, rows, ws, linv)
+                       if use_inv else
+                       kern(fronts, x, lsum, firsts, rows, ws))
         # backward, levels descending
-        for (grp, firsts, rows, ws), fronts in zip(
-                reversed(self._groups), reversed(fact.fronts)):
+        for (grp, firsts, rows, ws), fronts, (_, uinv) in zip(
+                reversed(self._groups), reversed(fact.fronts),
+                reversed(self._invs)):
             kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                               str(dt))
-            x = kern(fronts, x, firsts, rows, ws)
+                               str(dt), use_inv)
+            x = (kern(fronts, x, firsts, rows, ws, uinv) if use_inv
+                 else kern(fronts, x, firsts, rows, ws))
         out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
         return out[:, 0] if squeeze else out

@@ -64,17 +64,27 @@ class SymbolicFact:
 
 def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
                        relax: int = 20, max_supernode: int = 256,
-                       stats=None) -> SymbolicFact:
+                       stats=None, nthreads: int | None = None) -> SymbolicFact:
     """Symbolic phase on a symmetrized pattern with a fill-reducing order.
 
     Returns all structures in the final (order ∘ postorder) labeling.
     When `stats` is given, the etree+postorder step is timed into the ETREE
     phase (the reference times sp_colorder separately from symbfact,
     pdgssvx.c:1044-1073).
+
+    nthreads > 1 (or SLU_TPU_SYMB_THREADS) uses the threaded native
+    symbolic — the symbfact_dist capability analog (SRC/psymbfact.c:140):
+    identical per-column fill, possibly different supernode chain merges
+    at subtree boundaries.
     """
     import contextlib
+    import os
 
     from superlu_dist_tpu import native
+
+    if nthreads is None:
+        from superlu_dist_tpu.utils.options import _env_int
+        nthreads = _env_int("SLU_TPU_SYMB_THREADS", 1)
 
     n = sym_pattern.n_rows
     relax = min(relax, max_supernode)
@@ -101,7 +111,8 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
     indptr, indices, value_perm = b.indptr, b.indices, b.data
 
     # ---- supernode partition + row structures ------------------------------
-    nat = native.symbolic(n, indptr, indices, parent, relax, max_supernode)
+    nat = native.symbolic(n, indptr, indices, parent, relax, max_supernode,
+                          nthreads=nthreads)
     if nat is not None:
         sn_start, col_to_sn, sn_parent, sn_level, rows_ptr, rows_data = nat
         sn_rows = np.split(rows_data, rows_ptr[1:-1])

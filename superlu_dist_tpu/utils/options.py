@@ -69,13 +69,24 @@ class Trans(enum.Enum):
     CONJ = 2
 
 
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
 @dataclasses.dataclass
 class Options:
     """Runtime options (analog of superlu_dist_options_t).
 
     Defaults follow set_default_options_dist (SRC/util.c:376-401):
     Fact=DOFACT, Equil=YES, ColPerm=METIS_AT_PLUS_A, RowPerm=LargeDiag_MC64,
-    ReplaceTinyPivot, IterRefine=DOUBLE, PrintStat=YES.
+    ReplaceTinyPivot, IterRefine=DOUBLE, PrintStat=YES.  The blocking knobs
+    read the sp_ienv environment tier (SRC/sp_ienv.c:70-123) at
+    construction: NREL (relax), NSUP (max supernode),
+    SLU_TPU_MIN_BUCKET — so `NSUP=99 python -m superlu_dist_tpu ...`
+    behaves like the reference.
     """
 
     fact: Fact = Fact.DOFACT
@@ -85,18 +96,27 @@ class Options:
     replace_tiny_pivot: bool = True
     iter_refine: IterRefine = IterRefine.SLU_DOUBLE
     trans: Trans = Trans.NOTRANS
+    diag_inv: bool = False       # DiagInv (reference default YES-iff-LAPACK,
+                                 # SRC/util.c:397-401): precompute inverted
+                                 # diagonal blocks so device solves replace
+                                 # triangular solves with batched GEMMs —
+                                 # pays off for repeated / many-RHS solves
     print_stat: bool = False
     # --- symbolic / blocking tuning (sp_ienv analogs, SRC/sp_ienv.c:70-123) ---
-    relax: int = 20              # NREL: amalgamate subtrees with <= relax cols
-    max_supernode: int = 256     # NSUP: cap supernode width.  The reference
-                                 # uses 128 (CPU-cache-sized); the MXU wants
-                                 # wider panels (SURVEY.md §7 step 10).
+    # NREL: amalgamate subtrees with <= relax cols
+    relax: int = dataclasses.field(
+        default_factory=lambda: _env_int("NREL", 20))
+    # NSUP: cap supernode width.  The reference uses 128 (CPU-cache-sized);
+    # the MXU wants wider panels (SURVEY.md §7 step 10).
+    max_supernode: int = dataclasses.field(
+        default_factory=lambda: _env_int("NSUP", 256))
     # --- TPU-native knobs -----------------------------------------------------
     factor_dtype: str | None = None   # None => float32 on TPU, float64 on CPU
     ir_dtype: str = "float64"         # residual precision for refinement
     bucket_growth: float = 1.5        # geometric padding factor for front
                                       # size buckets (static-shape batching)
-    min_bucket: int = 8               # smallest padded front dimension
+    min_bucket: int = dataclasses.field(   # smallest padded front dimension
+        default_factory=lambda: _env_int("SLU_TPU_MIN_BUCKET", 8))
     # user-supplied permutations for MY_PERMC / MY_PERMR (real dataclass
     # fields so Options(user_perm_c=...) works — the reference reads these
     # from ScalePermstruct->perm_c/perm_r when ColPerm/RowPerm say MY_*).
@@ -105,23 +125,11 @@ class Options:
     user_perm_r: object = dataclasses.field(default=None, compare=False)
 
 
-def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ[name])
-    except (KeyError, ValueError):
-        return default
-
-
 def set_default_options() -> Options:
-    """Analog of set_default_options_dist (SRC/util.c:376) + the sp_ienv
-    environment tier (SRC/sp_ienv.c:70-123): NREL (relax), NSUP (max
-    supernode), plus the TPU-native bucket knobs.
-    """
-    o = Options()
-    o.relax = _env_int("NREL", o.relax)
-    o.max_supernode = _env_int("NSUP", o.max_supernode)
-    o.min_bucket = _env_int("SLU_TPU_MIN_BUCKET", o.min_bucket)
-    return o
+    """Analog of set_default_options_dist (SRC/util.c:376).  The sp_ienv
+    environment tier applies to every Options() construction (see the
+    class docstring), so this is a plain constructor alias."""
+    return Options()
 
 
 def print_options(o: Options) -> str:

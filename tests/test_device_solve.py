@@ -28,16 +28,32 @@ def _factor(a, **opt_kw):
 
 
 @pytest.mark.parametrize("nrhs", [1, 3])
-def test_device_solver_matches_host(nrhs):
+@pytest.mark.parametrize("diag_inv", [False, True])
+def test_device_solver_matches_host(nrhs, diag_inv):
     a = poisson2d(9)
     lu = _factor(a)
     rng = np.random.default_rng(5)
     d = rng.standard_normal((a.n_rows, nrhs))
     d = d[:, 0] if nrhs == 1 else d
-    got = DeviceSolver(lu.numeric).solve(d)
+    got = DeviceSolver(lu.numeric, diag_inv=diag_inv).solve(d)
     want = lu_solve(lu.numeric, d)
     assert got.shape == want.shape
-    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-12)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
+
+
+def test_diag_inv_through_driver():
+    """Options.diag_inv (reference DiagInv, util.c:397-401) end-to-end."""
+    a = poisson2d(10)
+    n = a.n_rows
+    xt = np.random.default_rng(2).standard_normal(n)
+    b = a.matvec(xt)
+    x, lu, stats, info = gssvx(Options(diag_inv=True), a, b)
+    assert info == 0
+    lu.solve_path = "device"   # force the device path on the CPU backend
+    lu.dev_solver = None
+    x2 = lu.solve_factored(b)
+    assert lu.dev_solver.diag_inv
+    np.testing.assert_allclose(x2, x, rtol=1e-7, atol=1e-9)
 
 
 def test_device_solver_padded_buckets():

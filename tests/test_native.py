@@ -86,6 +86,51 @@ def test_mc64_matches_python():
             np.exp(np.clip(u - np.log(colmax), -700, 700)), c, rtol=1e-10)
 
 
+def _per_column_fill(sf):
+    """Per-column below-diagonal fill counts — invariant across valid
+    supernode partitions of the same (zero-fill-merged) structure."""
+    last = sf.sn_start[1:] - 1
+    out = np.empty(sf.n, dtype=np.int64)
+    for s in range(sf.n_supernodes):
+        for j in range(int(sf.sn_start[s]), int(sf.sn_start[s + 1])):
+            out[j] = (last[s] - j) + len(sf.sn_rows[s])
+    return out
+
+
+@pytest.mark.parametrize("nthreads", [2, 4])
+def test_threaded_symbolic_same_fill(nthreads):
+    """The threaded symbolic (symbfact_dist analog) must produce the same
+    per-column fill as serial; the supernode partition may differ only by
+    boundary chain merges."""
+    from superlu_dist_tpu.models.gallery import poisson3d
+    for sym in _cases() + [symmetrize_pattern(poisson3d(8))]:
+        n = sym.n_rows
+        order = np.arange(n)
+        ser = symbolic_factorize(sym, order, relax=4, max_supernode=64)
+        par = symbolic_factorize(sym, order, relax=4, max_supernode=64,
+                                 nthreads=nthreads)
+        assert np.array_equal(_per_column_fill(ser), _per_column_fill(par))
+        assert par.nnz_L >= ser.nnz_L   # fewer merges => never less padding
+
+
+def test_threaded_symbolic_end_to_end():
+    """Solve through a threaded-symbolic factorization."""
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.models.gallery import poisson2d
+    import os
+    a = poisson2d(12)
+    xt = np.random.default_rng(0).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    os.environ["SLU_TPU_SYMB_THREADS"] = "4"
+    try:
+        x, lu, stats, info = gssvx(Options(), a, b)
+    finally:
+        del os.environ["SLU_TPU_SYMB_THREADS"]
+    assert info == 0
+    np.testing.assert_allclose(x, xt, rtol=1e-8, atol=1e-8)
+
+
 def test_mmd_matches_python():
     """Native exact-MD must match the Python oracle bit-for-bit (same
     algorithm, same tie-breaking)."""
