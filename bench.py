@@ -17,54 +17,159 @@ and recovers f64 accuracy via iterative refinement (GESP + IR, SURVEY.md
 §7 hard-part 1); the residual printed is AFTER refinement and must be at
 reference accuracy.
 
+Robustness (the pdtest discipline, TEST/pdtest.c — count failures, still
+report): ONE JSON line always prints.  A watchdog emits whatever has been
+measured if the wall budget expires (a wedged device tunnel must not
+produce an empty round — round-1 lesson, VERDICT weak #1); an unreachable
+accelerator triggers a CPU-backend rerun so the line still carries real
+numbers, marked backend="cpu".
+
 Prints ONE JSON line:
   {"metric": ..., "value": GFLOPS, "unit": "GFLOP/s", "vs_baseline": ...}
 
 Env knobs: BENCH_NX (grid edge, default 48 -> n=110592), BENCH_REPS,
-BENCH_PEAK_F32_TFLOPS (MFU denominator).
+BENCH_DEADLINE_S (watchdog, default 1350), BENCH_PEAK_F32_TFLOPS (MFU
+denominator), BENCH_NO_PROBE (skip the device-reachability probe).
 """
 
 import json
 import os
+import sys
+import threading
 import time
 
 import numpy as np
-import jax
-import jax.numpy as jnp
 
-jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               ".cache", "jax"))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-
-from superlu_dist_tpu.models.gallery import poisson3d
-from superlu_dist_tpu.sparse.formats import symmetrize_pattern
-from superlu_dist_tpu.utils.options import Options
-from superlu_dist_tpu.ordering.dispatch import get_perm_c
-from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
-from superlu_dist_tpu.numeric.plan import build_plan
-from superlu_dist_tpu.numeric.stream import StreamExecutor
-from superlu_dist_tpu.numeric.factor import NumericFactorization
-from superlu_dist_tpu.drivers.gssvx import LUFactorization
-from superlu_dist_tpu.refine.ir import iterative_refinement
-
-NX = int(os.environ.get("BENCH_NX", "48"))   # n = NX^3 = 110,592 default:
-# large enough that the big separator fronts drive the MXU (the r1 bench at
-# NX=24 was latency-bound, VERDICT weak #3), small enough that the Schur
-# pool + fronts fit single-chip HBM with headroom
-REPS = int(os.environ.get("BENCH_REPS", "5"))
-DTYPE = "float32"
-# v5e peak ~197 TFLOP/s bf16; f32 via HIGHEST-precision MXU passes ~1/4 of
-# that.  MFU is reported against the f32 figure.
-PEAK_F32 = float(os.environ.get("BENCH_PEAK_F32_TFLOPS", "49")) * 1e12
-# TPU-tuned blocking: wide supernodes feed the MXU (SURVEY.md §7 step 10 —
-# the reference's NSUP=128 is CPU-cache-sized) and keep the streamed
-# executor's kernel count small.
-RELAX, MAX_SUPER, MIN_BUCKET, GROWTH = 256, 1024, 64, 2.0
+RESULT = {"metric": "lu_factor_gflops_poisson3d", "value": None,
+          "unit": "GFLOP/s", "vs_baseline": None, "phase": "startup"}
+_PRINTED = threading.Lock()
+_DONE = False
 
 
-def _prepare():
+def _emit(final: bool):
+    global _DONE
+    with _PRINTED:
+        if _DONE:
+            return
+        snap = dict(RESULT)      # snapshot: main thread mutates RESULT
+        if not final:
+            snap["timeout"] = True
+        print(json.dumps(snap), flush=True)
+        _DONE = True             # only after a successful print
+
+
+def _log(msg: str):
+    print(f"[bench +{time.perf_counter() - T0:7.1f}s] {msg}",
+          file=sys.stderr, flush=True)
+
+
+T0 = time.perf_counter()
+DEADLINE = float(os.environ.get("BENCH_DEADLINE_S", "1350"))
+
+
+def _watchdog():
+    time.sleep(DEADLINE)
+    _log(f"watchdog fired in phase '{RESULT.get('phase')}' — emitting "
+         "partial result")
+    try:
+        _emit(final=False)
+    finally:
+        os._exit(0)
+
+
+def _probe_device(timeout_s: float = 240.0) -> bool:
+    """Can the configured backend run a trivial program?  Run in a thread:
+    a wedged tunnel blocks forever rather than raising (observed: remote
+    worker OOM-killed mid-run leaves jax.devices() hanging)."""
+    ok = []
+
+    def run():
+        try:
+            import jax
+            import jax.numpy as jnp
+            y = (jnp.ones((128, 128)) @ jnp.ones((128, 128)))
+            jax.block_until_ready(y)
+            ok.append(jax.default_backend())
+        except Exception as e:                      # pragma: no cover
+            _log(f"device probe error: {type(e).__name__}: {e}")
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if ok:
+        _log(f"device probe ok, backend={ok[0]}")
+        return True
+    _log("device probe FAILED (timeout or error)")
+    return False
+
+
+def main():
+    threading.Thread(target=_watchdog, daemon=True).start()
+
+    if not os.environ.get("BENCH_NO_PROBE") and not _probe_device():
+        # accelerator unreachable: rerun on the CPU backend so the driver
+        # still gets a real measurement (marked backend=cpu)
+        _log("falling back to CPU backend in a fresh process")
+        import subprocess
+        env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_NO_PROBE="1",
+                   BENCH_DEADLINE_S=str(max(60, DEADLINE
+                                            - (time.perf_counter() - T0)
+                                            - 30)))
+        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, stdout=subprocess.PIPE)
+        out = r.stdout.decode().strip().splitlines()
+        global _DONE
+        with _PRINTED:
+            _DONE = True
+        print(out[-1] if out else json.dumps(
+            {**RESULT, "phase": "cpu-fallback-failed"}), flush=True)
+        return
+
+    import jax
+    import jax.numpy as jnp
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        # env JAX_PLATFORMS is overridden by the session's accelerator
+        # plugin at interpreter start; only an in-process config update
+        # reliably pins the CPU backend (same recipe as tests/conftest.py)
+        jax.config.update("jax_platforms", "cpu")
+
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                   ".cache", "jax"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    from superlu_dist_tpu.numeric.factor import NumericFactorization
+    from superlu_dist_tpu.drivers.gssvx import LUFactorization
+    from superlu_dist_tpu.refine.ir import iterative_refinement
+
+    NX = int(os.environ.get("BENCH_NX", "48"))   # n = NX^3 = 110,592:
+    # large enough that the big separator fronts drive the MXU (the r1
+    # bench at NX=24 was latency-bound, VERDICT weak #3); with compact
+    # (lpanel, upanel) factor storage the whole factorization fits
+    # single-chip HBM (~8 GB at NX=48 vs 16 GB on v5e)
+    REPS = int(os.environ.get("BENCH_REPS", "3"))
+    DTYPE = "float32"
+    # v5e peak ~197 TFLOP/s bf16; f32 via HIGHEST-precision MXU passes
+    # ~1/4 of that.  MFU is reported against the f32 figure.
+    PEAK_F32 = float(os.environ.get("BENCH_PEAK_F32_TFLOPS", "49")) * 1e12
+    # TPU-tuned blocking: wide supernodes feed the MXU (SURVEY.md §7 step
+    # 10 — the reference's NSUP=128 is CPU-cache-sized) and keep the
+    # streamed executor's kernel count small.
+    RELAX, MAX_SUPER, MIN_BUCKET, GROWTH = 256, 1024, 64, 2.0
+
+    backend = jax.default_backend()
+    RESULT["backend"] = backend
+    RESULT["phase"] = "prepare"
+
     a = poisson3d(NX)
     opts = Options()
     sym = symmetrize_pattern(a)
@@ -72,53 +177,63 @@ def _prepare():
     sf = symbolic_factorize(sym, col_order, relax=RELAX,
                             max_supernode=MAX_SUPER)
     plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH)
-    avals = sym.data[sf.value_perm].astype(DTYPE)
-    thresh = np.sqrt(np.finfo(DTYPE).eps) * a.norm_max()
-    return a, sf, plan, avals, np.asarray(thresh, DTYPE)
+    avals_np = sym.data[sf.value_perm].astype(DTYPE)
+    thresh_np = np.asarray(np.sqrt(np.finfo(DTYPE).eps) * a.norm_max(),
+                           DTYPE)
+    n = a.n_rows
+    RESULT["metric"] = f"lu_factor_gflops_poisson3d_n{n}_{DTYPE}"
+    RESULT["flops"] = plan.flops
+    RESULT["n_groups"] = len(plan.groups)
+    _log(f"prepared n={n} groups={len(plan.groups)} "
+         f"flops={plan.flops / 1e9:.0f} GF")
 
-
-def _time_factor(ex, avals, thresh, reps):
-    out = jax.block_until_ready(ex(avals, thresh))     # warm (compile)
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        out = jax.block_until_ready(ex(avals, thresh))
-        times.append(time.perf_counter() - t0)
-    if ex.last_profile:
-        # kernel-shape trace (dgemm_mnk.dat analog) to stderr, top by time
-        import sys
-        top = sorted(ex.last_profile, key=lambda r: -r["seconds"])[:15]
-        for r in top:
-            print(f"# lvl={r['level']:<3d} B={r['batch']:<5d} m={r['m']:<5d} "
-                  f"w={r['w']:<5d} u={r['u']:<5d} {r['seconds']*1e3:8.2f} ms "
-                  f"{r['gflop']/max(r['seconds'],1e-12):8.1f} GF/s",
-                  file=sys.stderr)
-    return min(times), out
-
-
-def main():
-    a, sf, plan, avals_np, thresh_np = _prepare()
-
-    backend = jax.default_backend()
+    RESULT["phase"] = "factor-compile"
     ex = StreamExecutor(plan, DTYPE)
+    RESULT["offload"] = ex.offload
+    RESULT["n_kernels"] = ex.n_kernels
     avals = jnp.asarray(avals_np)
     thresh = jnp.asarray(thresh_np)
-    t_dev, (fronts, tiny) = _time_factor(ex, avals, thresh, REPS)
-    gflops = plan.flops / t_dev / 1e9
+    out = ex(avals, thresh)
+    jax.block_until_ready(out[0])
+    _log(f"warm (compile) done, kernels={ex.n_kernels}, "
+         f"offload={ex.offload}")
+
+    RESULT["phase"] = "factor-time"
+    times = []
+    for rep in range(REPS):
+        t0 = time.perf_counter()
+        out = ex(avals, thresh)
+        jax.block_until_ready(out[0])
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        # progressive: every rep updates the reported number, so a
+        # watchdog fire mid-loop still carries a real measurement
+        t_dev = min(times)
+        RESULT["value"] = round(plan.flops / t_dev / 1e9, 2)
+        RESULT["factor_seconds"] = t_dev
+        RESULT["mfu_pct"] = round(100.0 * plan.flops / t_dev / PEAK_F32, 2)
+        _log(f"rep {rep}: {dt:.3f}s -> "
+             f"{plan.flops / dt / 1e9:.1f} GFLOP/s")
+    fronts, tiny = out
+    RESULT["tiny_pivots"] = int(tiny)
+    if ex.last_profile:
+        # kernel-shape trace (dgemm_mnk.dat analog) to stderr, top by time
+        top = sorted(ex.last_profile, key=lambda r: -r["seconds"])[:15]
+        for r in top:
+            print(f"# lvl={r['level']:<3d} B={r['batch']:<5d} "
+                  f"m={r['m']:<5d} w={r['w']:<5d} u={r['u']:<5d} "
+                  f"{r['seconds'] * 1e3:8.2f} ms "
+                  f"{r['gflop'] / max(r['seconds'], 1e-12):8.1f} GF/s",
+                  file=sys.stderr)
 
     # Everything past this point (solve, residual, CPU baseline) must not
     # be able to zero the factor GFLOPS: each phase degrades independently
-    # and the JSON line always prints (the pdtest harness likewise counts
-    # failures and still reports, TEST/pdtest.c).
-    residual = solve_path = None
-    # residual through the full solve + f64 iterative refinement (GESP
-    # semantics: f32 factors, refined solution; pdgsrfs.c:120) — via the
-    # driver's own solve path (no equil/rowperm: identity transforms)
+    # and the JSON line always prints.
+    RESULT["phase"] = "solve-residual"
     try:
         numeric = NumericFactorization(plan=plan, fronts=list(fronts),
                                        tiny_pivots=int(tiny),
                                        dtype=jnp.dtype(DTYPE))
-        n = a.n_rows
         ones = np.ones(n)
         ident = np.arange(n, dtype=np.int64)
         lu = LUFactorization(n=n, options=Options(), equed="N", dr=ones,
@@ -129,52 +244,47 @@ def main():
         b = a.matvec(xt)
         x, _ = iterative_refinement(a, b, lu.solve_factored(b),
                                     lu.solve_factored)
-        residual = float(np.linalg.norm(b - a.matvec(x))
-                         / max(np.linalg.norm(b), 1e-300))
+        RESULT["residual"] = float(np.linalg.norm(b - a.matvec(x))
+                                   / max(np.linalg.norm(b), 1e-300))
         solve_path = ("device" if lu.solve_path == "auto"
-                      and backend != "cpu" else "host")
+                      and backend != "cpu" and not numeric.on_host
+                      else "host")
         if lu.solve_path == "host" and backend != "cpu":
             solve_path = "host-fallback"
-    except Exception as e:                   # pragma: no cover
-        solve_path = f"failed: {type(e).__name__}: {e}"
+        RESULT["solve_path"] = solve_path
+        _log(f"residual {RESULT['residual']:.2e} via {solve_path} solve")
+    except Exception as e:                       # pragma: no cover
+        RESULT["solve_path"] = f"failed: {type(e).__name__}: {e}"
+        _log(f"solve phase failed: {e}")
 
     # Baseline: serial SuperLU (same code family as the reference) with
     # host CPU BLAS, factoring the identical matrix
+    RESULT["phase"] = "cpu-baseline"
     try:
         import scipy.sparse as sp
         from scipy.sparse.linalg import splu
         A = sp.csr_matrix((a.data, a.indices, a.indptr),
-                          shape=(a.n_rows, a.n_rows)).tocsc()
-        base_reps = 2 if a.n_rows < 50_000 else 1
-        t_cpu = min(_timeit(lambda: splu(A)) for _ in range(base_reps))
-        vs_baseline = round(t_cpu / t_dev, 2)
-    except Exception:                        # pragma: no cover
-        t_cpu = vs_baseline = None
+                          shape=(n, n)).tocsc()
+        t0 = time.perf_counter()
+        splu(A)
+        t_cpu = time.perf_counter() - t0
+        RESULT["baseline_seconds"] = t_cpu
+        RESULT["baseline"] = ("scipy.splu (serial SuperLU, f64, host BLAS),"
+                              " same matrix")
+        RESULT["vs_baseline"] = round(t_cpu / RESULT["factor_seconds"], 2)
+        _log(f"scipy splu baseline {t_cpu:.2f}s -> "
+             f"vs_baseline {RESULT['vs_baseline']}x")
+    except Exception as e:                        # pragma: no cover
+        _log(f"baseline failed: {e}")
 
-    print(json.dumps({
-        "metric": f"lu_factor_gflops_poisson3d_n{a.n_rows}_{DTYPE}",
-        "value": round(gflops, 2),
-        "unit": "GFLOP/s",
-        "vs_baseline": vs_baseline,
-        "backend": backend,
-        "baseline": "scipy.splu (serial SuperLU, f64, host BLAS), same matrix",
-        "baseline_seconds": t_cpu,
-        "residual": residual,
-        "solve_path": solve_path,
-        "factor_seconds": t_dev,
-        "flops": plan.flops,
-        "mfu_pct": round(100.0 * gflops * 1e9 / PEAK_F32, 2),
-        "n_kernels": ex.n_kernels,
-        "n_groups": len(plan.groups),
-        "tiny_pivots": int(tiny),
-    }))
-
-
-def _timeit(fn):
-    t0 = time.perf_counter()
-    fn()
-    return time.perf_counter() - t0
+    RESULT["phase"] = "done"
+    _emit(final=True)
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:           # the ONE-JSON-line contract holds
+        RESULT.setdefault("error", f"{type(e).__name__}: {e}")
+        _emit(final=True)
+        raise

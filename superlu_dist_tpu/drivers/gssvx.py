@@ -127,7 +127,11 @@ class LUFactorization:
         import jax
         use_device = (self.solve_path == "device"
                       or (self.solve_path == "auto"
-                          and jax.default_backend() != "cpu"))
+                          and jax.default_backend() != "cpu"
+                          # offloaded (host-resident) factors solve on the
+                          # host — re-uploading them each solve would cost
+                          # more than the device solve saves
+                          and not self.numeric.on_host))
         if use_device:
             try:
                 if self.dev_solver is None:
@@ -275,8 +279,10 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
                                     replace_tiny=options.replace_tiny_pivot,
                                     mesh=grid.mesh if grid is not None
                                     else None)
-        for f in numeric.fronts:
-            f.block_until_ready()
+        for lp, up in numeric.fronts:
+            if hasattr(lp, "block_until_ready"):
+                lp.block_until_ready()
+                up.block_until_ready()
     stats.ops["FACT"] += plan.flops
     stats.tiny_pivots += numeric.tiny_pivots
     # memory observability (dQuerySpace_dist analog, SRC/dmemory_dist.c:73)

@@ -67,23 +67,20 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     f = f.reshape(batch, m, m)
     if front_sharding is not None:
         f = wsc(f, front_sharding)
-    packed, counts = group_partial_factor(f, thresh, w,
-                                          front_sharding=front_sharding,
-                                          pivot_sharding=pivot_sharding)
+    lpanel, upanel, schur, counts = group_partial_factor(
+        f, thresh, w, front_sharding=front_sharding,
+        pivot_sharding=pivot_sharding)
     # counts is (batch, w) per-column tiny flags; identity-padding columns
     # (col >= ws, incl. whole padded batch slots with ws == 0) are unit
     # pivots — don't let a thresh > 1 count them as tiny
     tiny = jnp.sum(jnp.where(jnp.arange(w)[None, :] < ws[:, None], counts, 0))
     if u > 0:
-        flat = packed.reshape(batch, m * m)
+        vals = schur.reshape(batch, u * u)
         if replicated is not None:
-            flat = wsc(flat, replicated)
-        i = jnp.arange(u)
-        src_flat = ((w + i)[:, None] * m + (w + i)[None, :]).reshape(-1)
-        vals = flat[:, src_flat]                       # (batch, u*u)
+            vals = wsc(vals, replicated)
         dst = off[:, None] + jnp.arange(u * u)         # off==pool_size drops
         pool = pool.at[dst].set(vals, mode="drop")
-    return packed, pool, tiny
+    return (lpanel, upanel), pool, tiny
 
 
 def _group_arrays(grp):
@@ -100,7 +97,10 @@ class NumericFactorization:
     superlu_ddefs.h:186-191)."""
 
     plan: FactorPlan
-    fronts: list              # per group: (B, M, M) device array, packed LU
+    fronts: list              # per group: (lpanel (B,M,w), upanel (B,w,u))
+                              # — packed L (diag block over L21) and U12;
+                              # the eliminated A22 is never stored (its
+                              # Schur update lives transiently in the pool)
     tiny_pivots: int
     dtype: object
     finite: bool = True       # False => an exact zero pivot propagated
@@ -111,11 +111,19 @@ class NumericFactorization:
                               # (pdgstrf.c:1920-1924, Allreduce MIN)
     host_fronts: list = None  # lazily pulled numpy copies for the host solve
 
+    @property
+    def on_host(self) -> bool:
+        """True when the factors already live in host memory (either the
+        executor streamed them off-device — offload mode — or we run on
+        the CPU backend)."""
+        return bool(self.fronts) and isinstance(self.fronts[0][0], np.ndarray)
+
     def pull_to_host(self):
         """Transfer factors to host once (the dSolveInit analog,
         SRC/pdutil.c:690 — solve-side setup cached across solves)."""
         if self.host_fronts is None:
-            self.host_fronts = [np.asarray(f) for f in self.fronts]
+            self.host_fronts = [(np.asarray(lp), np.asarray(up))
+                                for lp, up in self.fronts]
         return self.host_fronts
 
 
@@ -225,9 +233,9 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         # divides nothing during factorization, so isfinite alone misses it.
         bad_cols = []
         sn_start = plan.sf.sn_start
-        for grp, f in zip(plan.groups, fronts_out):
-            fh = np.asarray(f)
-            diag = np.diagonal(fh[:, :grp.w, :grp.w], axis1=1, axis2=2)
+        for grp, (lp, up) in zip(plan.groups, fronts_out):
+            lph = np.asarray(lp)
+            diag = np.diagonal(lph[:, :grp.w, :grp.w], axis1=1, axis2=2)
             bad = (diag == 0) | ~np.isfinite(diag)
             bad &= np.arange(grp.w)[None, :] < np.asarray(grp.ws)[:, None]
             if bad.any():
@@ -239,7 +247,9 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                 # group must not shift min(bad_cols) below the true pivot
                 # (contamination only flows to ancestors, whose columns
                 # are larger than the zero pivot's)
-                nf = ~np.isfinite(fh.reshape(fh.shape[0], -1)).all(axis=1)
+                nf = ~np.isfinite(lph.reshape(lph.shape[0], -1)).all(axis=1)
+                nf |= ~np.isfinite(np.asarray(up).reshape(
+                    lph.shape[0], -1)).all(axis=1)
                 if nf.any():
                     bad_cols.append(int(sn_start[grp.sns[nf]].min()))
         if bad_cols:
@@ -260,7 +270,8 @@ def query_space(numeric: NumericFactorization) -> dict:
     (SRC/dmemory_dist.c:73): packed-front (L+U) bytes plus the transient
     Schur update pool (the reference's 'expansions'/buffer gauges)."""
     itemsize = np.dtype(numeric.dtype).itemsize
-    front_b = sum(int(np.prod(f.shape)) for f in numeric.fronts) * itemsize
+    front_b = sum(int(np.prod(lp.shape)) + int(np.prod(up.shape))
+                  for lp, up in numeric.fronts) * itemsize
     pool_b = int(numeric.plan.pool_size) * itemsize
     return {"for_lu_bytes": front_b, "pool_bytes": pool_b,
             "total_bytes": front_b + pool_b}

@@ -30,6 +30,9 @@ from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.numeric.factor import group_step
 
 
+_OFFLOAD_LAG = 8   # groups of factored panels allowed in flight device-side
+
+
 def _bucket_len(n: int, lo: int = 8) -> int:
     """Next power of two (min lo) — pads arrays so shapes repeat."""
     return max(lo, 1 << int(np.ceil(np.log2(max(n, 1)))))
@@ -80,10 +83,33 @@ class StreamExecutor:
     Reusable across refactorizations with the same plan (SamePattern tier).
     """
 
-    def __init__(self, plan: FactorPlan, dtype="float64", mesh=None):
+    def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
+                 offload: str = "auto"):
+        """offload: "none" keeps every factored panel on the device;
+        "host" streams each group's (lpanel, upanel) to host memory as
+        soon as it is produced (copy_to_host_async overlaps the next
+        groups' compute), so device memory holds only the Schur pool plus
+        the in-flight group — the factor-size wall that limits single-chip
+        problem size (a 16 GB v5e holds ~n=50k padded f32 factors;
+        streaming lifts that to host-RAM scale, the same reason the
+        reference's GPU path keeps factors in host memory and ships only
+        panels to the accelerator, dSchCompUdt-cuda.c:194-241).
+        "auto" offloads iff the padded factor bytes exceed
+        SLU_TPU_FRONT_BYTES_LIMIT (default 6e9) on an accelerator backend.
+        """
+        import os
         self.plan = plan
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
+        if offload == "auto":
+            limit = float(os.environ.get("SLU_TPU_FRONT_BYTES_LIMIT", 6e9))
+            itemsize = jnp.dtype(dtype).itemsize
+            padded = sum(
+                _bucket_len(g.batch, 1) * (g.m * g.w + g.w * g.u)
+                for g in plan.groups) * itemsize
+            offload = ("host" if padded > limit
+                       and jax.default_backend() != "cpu" else "none")
+        self.offload = offload
         self.last_profile = None   # filled when SLU_TPU_PROFILE is set
         n_avals = len(plan.pattern_indices)
         self._steps = []
@@ -139,9 +165,9 @@ class StreamExecutor:
             kern = _kernel(*key, self.mesh)
             if profile:
                 t0 = time.perf_counter()
-            packed, pool, t = kern(avals, pool, thresh, *a, *child_arrs)
+            (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)
             if profile:
-                jax.block_until_ready(packed)
+                jax.block_until_ready(lp)
                 (b, m, w, u), _, _, _, _ = key
                 grp = plan.groups[gi]
                 gflop = (2 / 3 * w**3 + 2 * w * w * u
@@ -149,7 +175,26 @@ class StreamExecutor:
                 self.last_profile.append({
                     "level": grp.level, "batch": b, "m": m, "w": w, "u": u,
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
-            fronts.append(packed[:nreal] if packed.shape[0] != nreal
-                          else packed)
+            if lp.shape[0] != nreal:
+                lp, up = lp[:nreal], up[:nreal]
+            if self.offload == "host":
+                # start the D2H transfer now; it overlaps the following
+                # groups' kernels (the copy-back stream of the reference's
+                # GPU path, dSchCompUdt-cuda.c:238-241).  Materialize with
+                # a lag of a few groups so the device never holds more
+                # than the in-flight window of factored panels.
+                lp.copy_to_host_async()
+                up.copy_to_host_async()
+                fronts.append((lp, up))
+                if len(fronts) > _OFFLOAD_LAG:
+                    i = len(fronts) - 1 - _OFFLOAD_LAG
+                    dlp, dup = fronts[i]
+                    fronts[i] = (np.asarray(dlp), np.asarray(dup))
+            else:
+                fronts.append((lp, up))
             tiny = tiny + t
+        if self.offload == "host":
+            fronts = [(lp if isinstance(lp, np.ndarray) else np.asarray(lp),
+                       up if isinstance(up, np.ndarray) else np.asarray(up))
+                      for lp, up in fronts]
         return tuple(fronts), tiny

@@ -140,33 +140,42 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
     partitioner miscompiles vmapped scatter-updates with a sharded minor
     dimension (observed on jax 0.9.0), and splitting a tiny LU across
     chips would be latency-dominated anyway.
+
+    Returns (lpanel (B,m,w), upanel (B,w,u), schur (B,u,u), tiny (B,w)).
+    lpanel stacks the packed diagonal block (L11 unit-lower + U11) over
+    L21; upanel is U12.  The Schur block is returned separately — the
+    caller scatters it into the update pool and then drops it, so the
+    stored factors are only the n_L + n_U panels the solves read (the
+    reference likewise keeps L in Lnzval_bc_ptr and U in Unzval_br_ptr and
+    never stores the eliminated A22, superlu_ddefs.h:97-183).
     """
     from jax.lax import with_sharding_constraint as wsc
     m = fronts.shape[-1]
+    b = fronts.shape[0]
     f11_in = fronts[:, :w, :w]
     if pivot_sharding is not None:
         f11_in = wsc(f11_in, pivot_sharding)
     f11, tiny = jax.vmap(lambda x: lu_nopivot(x, thresh))(f11_in)
     if w == m:
-        if front_sharding is not None:
-            f11 = wsc(f11, front_sharding)
-        return f11, tiny
+        if pivot_sharding is not None:
+            f11 = wsc(f11, pivot_sharding)
+        u = 0
+        return f11, jnp.zeros((b, w, u), fronts.dtype), \
+            jnp.zeros((b, u, u), fronts.dtype), tiny
     a12 = fronts[:, :w, w:]
     a21 = fronts[:, w:, :w]
     a22 = fronts[:, w:, w:]
-    u12 = jax.vmap(lambda l, b: solve_triangular(l, b, lower=True,
-                                                 unit_diagonal=True))(f11, a12)
-    l21 = jax.vmap(lambda u, b: solve_triangular(u, b.T, trans=1,
-                                                 lower=False).T)(f11, a21)
+    u12 = jax.vmap(lambda l, b_: solve_triangular(l, b_, lower=True,
+                                                  unit_diagonal=True))(f11, a12)
+    l21 = jax.vmap(lambda u_, b_: solve_triangular(u_, b_.T, trans=1,
+                                                   lower=False).T)(f11, a21)
     s = a22 - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
     if front_sharding is not None:
         s = wsc(s, front_sharding)
-    top = jnp.concatenate([f11, u12], axis=2)
-    bot = jnp.concatenate([l21, s], axis=2)
-    out = jnp.concatenate([top, bot], axis=1)
+    lpanel = jnp.concatenate([f11, l21], axis=1)
     if front_sharding is not None:
-        out = wsc(out, front_sharding)
-    return out, tiny
+        lpanel = wsc(lpanel, front_sharding)
+    return lpanel, u12, s, tiny
 
 
 @functools.lru_cache(maxsize=None)

@@ -40,7 +40,7 @@ def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
     becomes one batched GEMM (the reference's DiagInv fast path,
     pdgstrs.c:1252-1396: dense X(k) = Linv(k)·b via dgemm)."""
 
-    def step(fronts, x, lsum, first, rows, ws, linv=None):
+    def step(lpanel, x, lsum, first, rows, ws, linv=None):
         k = jnp.arange(w)
         # padded pivot columns (k >= ws) would alias the NEXT supernode's
         # entries — clamp them to the dump row n-1 (factor cols/rows there
@@ -52,12 +52,12 @@ def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
         if use_inv:
             y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
         else:
-            l11 = fronts[:, :w, :w]
+            l11 = lpanel[:, :w, :w]
             y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
                 l, b, lower=True, unit_diagonal=True))(l11, rhs)
         x = x.at[cols].set(y, mode="drop")
         if u:
-            contrib = jnp.matmul(fronts[:, w:, :w], y,
+            contrib = jnp.matmul(lpanel[:, w:, :], y,
                                  precision=jax.lax.Precision.HIGHEST)
             lsum = lsum.at[rows].add(contrib, mode="drop")
         return x, lsum
@@ -69,24 +69,24 @@ def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
 def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
     """x[cols] <- U11⁻¹(x[cols] − U12·x[rows])."""
 
-    def step(fronts, x, first, rows, ws, uinv=None):
+    def step(lpanel, upanel, x, first, rows, ws, uinv=None):
         k = jnp.arange(w)
         cols = jnp.where(k[None, :] < ws[:, None],
                          first[:, None] + k, n - 1)
         rhs = x.at[cols].get(mode="fill", fill_value=0)
         if u:
             xr = x.at[rows].get(mode="fill", fill_value=0)   # (B, u, nrhs)
-            rhs = rhs - jnp.matmul(fronts[:, :w, w:], xr,
+            rhs = rhs - jnp.matmul(upanel, xr,
                                    precision=jax.lax.Precision.HIGHEST)
         if use_inv:
             y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
         else:
-            u11 = fronts[:, :w, :w]
+            u11 = lpanel[:, :w, :w]
             y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
                 r, b, lower=False))(u11, rhs)
         return x.at[cols].set(y, mode="drop")
 
-    return jax.jit(step, donate_argnums=(1,))
+    return jax.jit(step, donate_argnums=(2,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -94,9 +94,9 @@ def _diag_inv_kernel(w, dtype):
     """Batched inverses of the packed diagonal blocks — the
     pdCompute_Diag_Inv analog (SRC/pdgstrs.c:647, dtrtri per block)."""
 
-    def inv(fronts):
-        f11 = fronts[:, :w, :w]
-        eye = jnp.eye(w, dtype=fronts.dtype)
+    def inv(lpanel):
+        f11 = lpanel[:, :w, :w]
+        eye = jnp.eye(w, dtype=lpanel.dtype)
         linv = jax.vmap(lambda l: jax.scipy.linalg.solve_triangular(
             l, eye, lower=True, unit_diagonal=True))(f11)
         uinv = jax.vmap(lambda r: jax.scipy.linalg.solve_triangular(
@@ -123,7 +123,7 @@ class DeviceSolver:
         first = sf.sn_start[:-1]
         self._groups = []
         self._invs = []
-        for grp, fronts in zip(plan.groups, fact.fronts):
+        for grp, (lp, up) in zip(plan.groups, fact.fronts):
             firsts = jnp.asarray(first[grp.sns])
             rows = np.full((grp.batch, grp.u), self.n, dtype=np.int64)
             for slot, s in enumerate(grp.sns):
@@ -133,7 +133,7 @@ class DeviceSolver:
                                  jnp.asarray(grp.ws)))
             if diag_inv:
                 kern = _diag_inv_kernel(grp.w, str(jnp.dtype(fact.dtype)))
-                self._invs.append(kern(fronts))
+                self._invs.append(kern(jnp.asarray(lp)))
             else:
                 self._invs.append((None, None))
 
@@ -152,20 +152,20 @@ class DeviceSolver:
         n1 = self.n + 1
         use_inv = self.diag_inv
         # forward, levels ascending (groups are in level order)
-        for (grp, firsts, rows, ws), fronts, (linv, _) in zip(
+        for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
                 self._groups, fact.fronts, self._invs):
             kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
                                str(dt), use_inv)
-            x, lsum = (kern(fronts, x, lsum, firsts, rows, ws, linv)
+            x, lsum = (kern(lp, x, lsum, firsts, rows, ws, linv)
                        if use_inv else
-                       kern(fronts, x, lsum, firsts, rows, ws))
+                       kern(lp, x, lsum, firsts, rows, ws))
         # backward, levels descending
-        for (grp, firsts, rows, ws), fronts, (_, uinv) in zip(
+        for (grp, firsts, rows, ws), (lp, up), (_, uinv) in zip(
                 reversed(self._groups), reversed(fact.fronts),
                 reversed(self._invs)):
             kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
                                str(dt), use_inv)
-            x = (kern(fronts, x, firsts, rows, ws, uinv) if use_inv
-                 else kern(fronts, x, firsts, rows, ws))
+            x = (kern(lp, up, x, firsts, rows, ws, uinv) if use_inv
+                 else kern(lp, up, x, firsts, rows, ws))
         out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
         return out[:, 0] if squeeze else out

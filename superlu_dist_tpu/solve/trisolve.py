@@ -52,13 +52,14 @@ def lu_solve_trans(fact: NumericFactorization, rhs: np.ndarray,
 
     def blocks(s):
         grp = plan.groups[plan.sn_group[s]]
-        f = hosts[plan.sn_group[s]][plan.sn_slot[s]]
+        lp, up = hosts[plan.sn_group[s]]
+        lp, up = lp[plan.sn_slot[s]], up[plan.sn_slot[s]]
         w = int(last[s] - first[s] + 1)
         u = len(sf.sn_rows[s])
         W = grp.w
-        f11 = f[:w, :w]
-        l21 = f[W:W + u, :w]
-        u12 = f[:w, W:W + u]
+        f11 = lp[:w, :w]
+        l21 = lp[W:W + u, :w]
+        u12 = up[:w, :u]
         if conj:
             f11, l21, u12 = f11.conj(), l21.conj(), u12.conj()
         return f11, l21, u12, w, u
@@ -102,13 +103,14 @@ def lu_solve(fact: NumericFactorization, rhs: np.ndarray) -> np.ndarray:
 
     def blocks(s):
         grp = plan.groups[plan.sn_group[s]]
-        f = hosts[plan.sn_group[s]][plan.sn_slot[s]]
+        lp, up = hosts[plan.sn_group[s]]
+        lp, up = lp[plan.sn_slot[s]], up[plan.sn_slot[s]]
         w = int(last[s] - first[s] + 1)
         u = len(sf.sn_rows[s])
         W = grp.w
-        f11 = f[:w, :w]
-        l21 = f[W:W + u, :w]
-        u12 = f[:w, W:W + u]
+        f11 = lp[:w, :w]
+        l21 = lp[W:W + u, :w]
+        u12 = up[:w, :u]
         return f11, l21, u12, w, u
 
     # forward: supernodes in column order = topological (children first)

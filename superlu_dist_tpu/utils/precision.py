@@ -49,6 +49,6 @@ def get_diag_u(numeric) -> np.ndarray:
         g = int(plan.sn_group[s])
         slot = int(plan.sn_slot[s])
         w = sf.sn_width(s)
-        f = hosts[g][slot]
-        out[sf.sn_start[s]:sf.sn_start[s] + w] = np.diagonal(f)[:w]
+        lp = hosts[g][0][slot]
+        out[sf.sn_start[s]:sf.sn_start[s] + w] = np.diagonal(lp)[:w]
     return out

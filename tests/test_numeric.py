@@ -32,18 +32,19 @@ def extract_lu(sf, plan, fact):
     hosts = fact.pull_to_host()
     for s in range(sf.n_supernodes):
         grp = plan.groups[plan.sn_group[s]]
-        f = hosts[plan.sn_group[s]][plan.sn_slot[s]]
+        lp, up = hosts[plan.sn_group[s]]
+        lp, up = lp[plan.sn_slot[s]], up[plan.sn_slot[s]]
         fcol, lcol = int(sf.sn_start[s]), int(sf.sn_start[s + 1]) - 1
         w = lcol - fcol + 1
         u = len(sf.sn_rows[s])
         W = grp.w
         cols = np.arange(fcol, lcol + 1)
-        L[np.ix_(cols, cols)] = np.tril(f[:w, :w], -1) + np.eye(w)
-        U[np.ix_(cols, cols)] = np.triu(f[:w, :w])
+        L[np.ix_(cols, cols)] = np.tril(lp[:w, :w], -1) + np.eye(w)
+        U[np.ix_(cols, cols)] = np.triu(lp[:w, :w])
         if u:
             rows = sf.sn_rows[s]
-            L[np.ix_(rows, cols)] = f[W:W + u, :w]
-            U[np.ix_(cols, rows)] = f[:w, W:W + u]
+            L[np.ix_(rows, cols)] = lp[W:W + u, :w]
+            U[np.ix_(cols, rows)] = up[:w, :u]
     return L, U
 
 
@@ -99,18 +100,19 @@ def extract_lu_complex(sf, plan, fact):
     hosts = fact.pull_to_host()
     for s in range(sf.n_supernodes):
         grp = plan.groups[plan.sn_group[s]]
-        f = hosts[plan.sn_group[s]][plan.sn_slot[s]]
+        lp, up = hosts[plan.sn_group[s]]
+        lp, up = lp[plan.sn_slot[s]], up[plan.sn_slot[s]]
         fcol, lcol = int(sf.sn_start[s]), int(sf.sn_start[s + 1]) - 1
         w = lcol - fcol + 1
         u = len(sf.sn_rows[s])
         W = grp.w
         cols = np.arange(fcol, lcol + 1)
-        L[np.ix_(cols, cols)] = np.tril(f[:w, :w], -1) + np.eye(w)
-        U[np.ix_(cols, cols)] = np.triu(f[:w, :w])
+        L[np.ix_(cols, cols)] = np.tril(lp[:w, :w], -1) + np.eye(w)
+        U[np.ix_(cols, cols)] = np.triu(lp[:w, :w])
         if u:
             rows = sf.sn_rows[s]
-            L[np.ix_(rows, cols)] = f[W:W + u, :w]
-            U[np.ix_(cols, rows)] = f[:w, W:W + u]
+            L[np.ix_(rows, cols)] = lp[W:W + u, :w]
+            U[np.ix_(cols, rows)] = up[:w, :u]
     return L, U
 
 

@@ -47,8 +47,10 @@ def test_sharded_factor_matches_single_device(shape):
     fn = make_factor_fn(plan, "float64", mesh=grid.mesh)
     fronts, tiny = fn(jnp.asarray(avals), jnp.asarray(thresh))
     assert int(tiny) == int(ref_tiny)
-    for f, r in zip(fronts, ref_fronts):
-        np.testing.assert_allclose(np.asarray(f), np.asarray(r),
+    for (lp, up), (rlp, rup) in zip(fronts, ref_fronts):
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(rlp),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(up), np.asarray(rup),
                                    rtol=1e-12, atol=1e-12)
 
 
@@ -60,8 +62,9 @@ def test_stream_matches_fused():
     ex = StreamExecutor(plan, "float64")
     gf, gt = ex(jnp.asarray(avals), jnp.asarray(thresh))
     assert int(gt) == int(rt)
-    for a, b in zip(gf, rf):
-        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    for (lp, up), (rlp, rup) in zip(gf, rf):
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(rlp))
+        np.testing.assert_array_equal(np.asarray(up), np.asarray(rup))
 
 
 @pytest.mark.parametrize("shape", [(4, 2), (8, 1)])
@@ -76,8 +79,10 @@ def test_sharded_stream_matches_single(shape):
     ex = StreamExecutor(plan, "float64", mesh=grid.mesh)
     gf, gt = ex(jnp.asarray(avals), jnp.asarray(thresh))
     assert int(gt) == int(rt)
-    for a, b in zip(gf, rf):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+    for (lp, up), (rlp, rup) in zip(gf, rf):
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(rlp),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(up), np.asarray(rup),
                                    rtol=1e-12, atol=1e-12)
 
 
