@@ -111,10 +111,16 @@ def main():
         # still gets a real measurement (marked backend=cpu)
         _log("falling back to CPU backend in a fresh process")
         import subprocess
+        # the child must finish before the PARENT watchdog fires, or its
+        # real measurement is discarded — cap its budget to our remaining
+        # time (never extend it)
+        remaining = DEADLINE - (time.perf_counter() - T0)
+        if remaining < 45:
+            _log("no time left for a CPU fallback run")
+            _emit(final=True)
+            return
         env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_NO_PROBE="1",
-                   BENCH_DEADLINE_S=str(max(60, DEADLINE
-                                            - (time.perf_counter() - T0)
-                                            - 30)))
+                   BENCH_DEADLINE_S=str(remaining - 30))
         r = subprocess.run([sys.executable, os.path.abspath(__file__)],
                            env=env, stdout=subprocess.PIPE)
         out = r.stdout.decode().strip().splitlines()

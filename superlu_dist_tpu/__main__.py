@@ -30,8 +30,8 @@ def _build_parser():
     p.add_argument("-s", "--nrhs", type=int, default=1,
                    help="number of right-hand sides (pdtest -s)")
     p.add_argument("--colperm", default="METIS_AT_PLUS_A",
-                   choices=["NATURAL", "MMD", "MMD_AT_PLUS_A", "ND",
-                            "METIS_AT_PLUS_A"],
+                   choices=["NATURAL", "MMD", "MMD_AT_PLUS_A", "MMD_ATA",
+                            "COLAMD", "ND", "METIS_AT_PLUS_A"],
                    help="fill-reducing column ordering")
     p.add_argument("--rowperm", default="MC64",
                    choices=["NOROWPERM", "MC64", "LargeDiag_MC64",
@@ -67,6 +67,8 @@ def _options(args, **overrides):
         col_perm={"NATURAL": ColPerm.NATURAL,
                   "MMD": ColPerm.MMD_AT_PLUS_A,
                   "MMD_AT_PLUS_A": ColPerm.MMD_AT_PLUS_A,
+                  "MMD_ATA": ColPerm.MMD_ATA,
+                  "COLAMD": ColPerm.COLAMD,
                   "ND": ColPerm.ND_AT_PLUS_A,
                   "METIS_AT_PLUS_A": ColPerm.ND_AT_PLUS_A}[args.colperm],
         row_perm={"NOROWPERM": RowPerm.NOROWPERM,

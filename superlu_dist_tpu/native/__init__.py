@@ -66,11 +66,9 @@ def _load():
             lib = ctypes.CDLL(path)
             lib.slu_etree.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
             lib.slu_postorder.argtypes = [ctypes.c_int64, _I64, _I64]
-            lib.slu_symbolic.restype = ctypes.c_int64
-            lib.slu_symbolic.argtypes = [
-                ctypes.c_int64, _I64, _I64, _I64, ctypes.c_int64,
-                ctypes.c_int64, _I64, _I64, _I64, _I64, _I64,
-                ctypes.POINTER(_I64)]
+            # (slu_symbolic — the serial alias — stays exported for the C
+            # ABI but Python always calls the _mt entry, which dispatches
+            # serial at nthreads=1)
             lib.slu_symbolic_mt.restype = ctypes.c_int64
             lib.slu_symbolic_mt.argtypes = [
                 ctypes.c_int64, _I64, _I64, _I64, ctypes.c_int64,
@@ -87,6 +85,12 @@ def _load():
             lib.slu_awpm.restype = ctypes.c_int
             lib.slu_awpm.argtypes = [ctypes.c_int64, _I64, _I64, _F64, _I64]
             lib.slu_mmd.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
+            lib.slu_colamd.argtypes = [ctypes.c_int64, ctypes.c_int64,
+                                       _I64, _I64, _I64]
+            lib.slu_ata_pattern.restype = ctypes.c_int64
+            lib.slu_ata_pattern.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, _I64, _I64, ctypes.c_int64,
+                _I64, ctypes.POINTER(_I64)]
             _lib = lib
         except Exception:
             _lib = None
@@ -233,6 +237,41 @@ def awpm(n: int, indptr, indices, absval):
     if rc != 0:
         raise ValueError("structurally singular")
     return col_match
+
+
+def colamd(n_rows: int, n_cols: int, indptr, indices):
+    """COLAMD-class approximate column MD ordering; None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    order = np.empty(n_cols, dtype=np.int64)
+    lib.slu_colamd(n_rows, n_cols, _ptr_i64(indptr), _ptr_i64(indices),
+                   _ptr_i64(order))
+    return order
+
+
+def ata_pattern(n_rows: int, n_cols: int, indptr, indices,
+                dense_row: int = 0):
+    """Symmetric adjacency of AᵀA (getata_dist analog); None if
+    unavailable.  dense_row > 0 drops rows longer than that."""
+    lib = _load()
+    if lib is None:
+        return None
+    indptr = _as_i64(indptr)
+    indices = _as_i64(indices)
+    out_ptr = np.empty(n_cols + 1, dtype=np.int64)
+    buf = _I64()
+    total = int(lib.slu_ata_pattern(n_rows, n_cols, _ptr_i64(indptr),
+                                    _ptr_i64(indices), dense_row,
+                                    _ptr_i64(out_ptr), ctypes.byref(buf)))
+    try:
+        out_idx = np.ctypeslib.as_array(buf, shape=(max(total, 1),))[
+            :total].copy()
+    finally:
+        lib.slu_free_i64(buf)
+    return out_ptr, out_idx
 
 
 def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1):

@@ -29,6 +29,7 @@
 
 #include <algorithm>
 #include <atomic>
+#include <cmath>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -594,6 +595,159 @@ void slu_mmd(i64 n, const i64* indptr, const i64* indices, i64* order_out) {
       heap.emplace(degree[u], u);
     }
   }
+}
+
+// ---------------------------------------------------------------------------
+// COLAMD-class approximate column minimum-degree ordering — capability
+// analog of the reference's colamd (SRC/colamd.c, dispatched for
+// colperm_t COLAMD, get_perm_c.c:463-530).  Fresh implementation of the
+// published algorithm idea: order the columns of A by approximate minimum
+// degree in AᵀA *without forming AᵀA* — the rows of A are the initial
+// quotient-graph elements, eliminating a column merges every element that
+// contains it into one fill element, and a column's score is the sum of
+// its live element sizes (an upper bound on its AᵀA external degree).
+// Dense rows are dropped from the analysis and dense columns ordered
+// last, as colamd does, so one dense stripe cannot poison every score.
+// ---------------------------------------------------------------------------
+void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
+                const i64* indices, i64* order_out) {
+  HeapScope heap_scope;
+  const i64 dense_row =
+      std::max<i64>(16, (i64)(10.0 * std::sqrt((double)n_cols)));
+  const i64 dense_col =
+      std::max<i64>(16, (i64)(10.0 * std::sqrt((double)std::max<i64>(
+                                         n_rows, 1))));
+  // elements: ids 0..n_rows-1 are rows of A; n_rows+k is the k-th fill
+  // element.  col_elems[j] lists the live elements containing column j.
+  std::vector<VSet> elem_cols(n_rows);
+  std::vector<VSet> col_elems(n_cols);
+  std::vector<char> elem_alive(n_rows, 0);
+  for (i64 r = 0; r < n_rows; ++r) {
+    i64 len = indptr[r + 1] - indptr[r];
+    if (len > dense_row) continue;  // dense row: excluded from scores
+    VSet& cols = elem_cols[r];
+    cols.assign(indices + indptr[r], indices + indptr[r + 1]);
+    std::sort(cols.begin(), cols.end());
+    cols.erase(std::unique(cols.begin(), cols.end()), cols.end());
+    elem_alive[r] = 1;
+    for (i64 j : cols) col_elems[j].push_back(r);
+  }
+  std::vector<char> col_alive(n_cols, 1);
+  std::vector<i64> score(n_cols, 0);
+  std::vector<i64> dense_cols;
+  using QE = std::pair<i64, i64>;
+  std::priority_queue<QE, std::vector<QE>, std::greater<QE>> heap;
+  auto col_score = [&](i64 j) {
+    i64 s = 0;
+    for (i64 e : col_elems[j])
+      if (elem_alive[e]) s += (i64)elem_cols[e].size() - 1;
+    return std::min<i64>(std::max<i64>(s, 0), n_cols - 1);
+  };
+  for (i64 j = 0; j < n_cols; ++j) {
+    if ((i64)col_elems[j].size() > dense_col) {
+      col_alive[j] = 0;
+      dense_cols.push_back(j);   // ordered last, by original degree
+      continue;
+    }
+    score[j] = col_score(j);
+    heap.emplace(score[j], j);
+  }
+  // dense columns must not linger inside the elements they touch
+  for (i64 j : dense_cols)
+    for (i64 e : col_elems[j]) vset_erase(elem_cols[e], j);
+  std::sort(dense_cols.begin(), dense_cols.end(), [&](i64 a, i64 b) {
+    i64 da = col_elems[a].size(), db = col_elems[b].size();
+    return da != db ? da < db : a < b;
+  });
+
+  elem_cols.resize(n_rows + n_cols);       // room for fill elements
+  elem_alive.resize(n_rows + n_cols, 0);
+  i64 k = 0;
+  i64 n_live = n_cols - (i64)dense_cols.size();
+  while (k < n_live) {
+    i64 c;
+    while (true) {
+      auto [s, j] = heap.top();
+      heap.pop();
+      if (col_alive[j] && s == score[j]) {
+        c = j;
+        break;
+      }
+    }
+    order_out[k] = c;
+    col_alive[c] = 0;
+    // merge every live element containing c into one fill element
+    VSet merged;
+    VSet absorbed;
+    for (i64 e : col_elems[c])
+      if (elem_alive[e]) {
+        merged = vset_union(merged, elem_cols[e]);
+        absorbed.push_back(e);
+        elem_alive[e] = 0;
+        elem_cols[e].clear();
+        elem_cols[e].shrink_to_fit();
+      }
+    std::sort(absorbed.begin(), absorbed.end());
+    vset_erase(merged, c);
+    // drop dead columns so element sizes track live structure
+    VSet live;
+    live.reserve(merged.size());
+    for (i64 j : merged)
+      if (col_alive[j]) live.push_back(j);
+    i64 eid = n_rows + k;
+    elem_cols[eid] = live;
+    elem_alive[eid] = 1;
+    for (i64 j : live) {
+      vset_subtract(col_elems[j], absorbed);
+      col_elems[j].push_back(eid);          // eid > all current entries
+      score[j] = col_score(j);
+      heap.emplace(score[j], j);
+    }
+    ++k;
+  }
+  for (i64 j : dense_cols) order_out[k++] = j;
+}
+
+// ---------------------------------------------------------------------------
+// Pattern of AᵀA (getata_dist analog, SRC/get_perm_c.c:164) for the
+// MMD_ATA ordering: every row of A is a clique over its column support.
+// Emits a symmetric adjacency (no diagonal) in CSR form.  Rows longer
+// than dense_row are dropped (one dense row would produce an O(n²)
+// clique; colamd applies the same pruning).  Single pass: the adjacency
+// is built once and the index array allocated here — caller copies and
+// releases it with slu_free_i64 (same protocol as slu_symbolic_mt).
+// Returns total adjacency length.
+// ---------------------------------------------------------------------------
+i64 slu_ata_pattern(i64 n_rows, i64 n_cols, const i64* indptr,
+                    const i64* indices, i64 dense_row,
+                    i64* out_indptr, i64** out_indices) {
+  HeapScope heap_scope;
+  std::vector<VSet> adj(n_cols);
+  for (i64 r = 0; r < n_rows; ++r) {
+    i64 len = indptr[r + 1] - indptr[r];
+    if (len <= 1 || (dense_row > 0 && len > dense_row)) continue;
+    VSet cols(indices + indptr[r], indices + indptr[r + 1]);
+    std::sort(cols.begin(), cols.end());
+    cols.erase(std::unique(cols.begin(), cols.end()), cols.end());
+    for (i64 j : cols) {
+      VSet others;
+      others.reserve(cols.size() - 1);
+      for (i64 u : cols)
+        if (u != j) others.push_back(u);
+      adj[j] = vset_union(adj[j], others);
+    }
+  }
+  i64 total = 0;
+  out_indptr[0] = 0;
+  for (i64 j = 0; j < n_cols; ++j) {
+    total += (i64)adj[j].size();
+    out_indptr[j + 1] = total;
+  }
+  i64* out = (i64*)std::malloc(std::max<i64>(total, 1) * sizeof(i64));
+  for (i64 j = 0; j < n_cols; ++j)
+    std::copy(adj[j].begin(), adj[j].end(), out + out_indptr[j]);
+  *out_indices = out;
+  return total;
 }
 
 // ---------------------------------------------------------------------------

@@ -26,6 +26,17 @@ def get_perm_c(options: Options, a: SparseCSR,
         if options.user_perm_c is None:
             raise SuperLUError("ColPerm=MY_PERMC but user_perm_c is None")
         return np.asarray(options.user_perm_c, dtype=np.int64)
+    if cp == ColPerm.COLAMD:
+        # approximate column MD directly on A — no AᵀA, no symmetrization
+        from superlu_dist_tpu.ordering.colamd import colamd_order
+        return colamd_order(a.n_rows, a.n_cols, a.indptr, a.indices)
+    if cp == ColPerm.MMD_ATA:
+        # exact MD on the explicit AᵀA pattern (getata_dist analog)
+        from superlu_dist_tpu.ordering.colamd import ata_adjacency
+        dense = max(16, int(10.0 * np.sqrt(a.n_cols)))
+        ptr, idx = ata_adjacency(a.n_rows, a.n_cols, a.indptr, a.indices,
+                                 dense_row=dense)
+        return minimum_degree(n, ptr, idx)
     if sym is None:
         sym = symmetrize_pattern(a)
     if cp == ColPerm.MMD_AT_PLUS_A:

@@ -39,9 +39,11 @@ class ColPerm(enum.Enum):
 
     NATURAL = 0
     MMD_AT_PLUS_A = 1       # minimum degree on pattern of A^T + A
-    ND_AT_PLUS_A = 2        # BFS nested dissection (METIS_AT_PLUS_A analog)
+    ND_AT_PLUS_A = 2        # multilevel nested dissection (METIS analog)
     METIS_AT_PLUS_A = 2     # alias: the reference default maps to our ND
     MY_PERMC = 3            # user-supplied permutation
+    MMD_ATA = 4             # minimum degree on pattern of A^T A
+    COLAMD = 5              # approximate column MD directly on A
 
 
 class RowPerm(enum.Enum):
