@@ -80,6 +80,9 @@ def _load():
                                      _I64, _F64, _F64]
             lib.slu_mlnd.argtypes = [ctypes.c_int64, _I64, _I64,
                                      ctypes.c_int64, ctypes.c_uint64, _I64]
+            lib.slu_mlnd_mt.argtypes = [ctypes.c_int64, _I64, _I64,
+                                        ctypes.c_int64, ctypes.c_uint64,
+                                        ctypes.c_int64, _I64]
             lib.slu_positions.argtypes = [ctypes.c_int64, _I64, _I64, _I64,
                                           _I64, _I64, _I64, _I64, _I64]
             lib.slu_awpm.restype = ctypes.c_int
@@ -87,6 +90,17 @@ def _load():
             lib.slu_mmd.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
             lib.slu_colamd.argtypes = [ctypes.c_int64, ctypes.c_int64,
                                        _I64, _I64, _I64]
+            lib.slu_tree_attach.restype = ctypes.c_void_p
+            lib.slu_tree_attach.argtypes = [
+                ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64]
+            lib.slu_tree_detach.argtypes = [ctypes.c_void_p,
+                                            ctypes.c_char_p, ctypes.c_int64]
+            lib.slu_tree_bcast.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                           _F64, ctypes.c_int64]
+            lib.slu_tree_reduce_sum.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int64, _F64,
+                                                ctypes.c_int64]
             lib.slu_ata_pattern.restype = ctypes.c_int64
             lib.slu_ata_pattern.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, _I64, _I64, ctypes.c_int64,
@@ -274,14 +288,25 @@ def ata_pattern(n_rows: int, n_cols: int, indptr, indices,
     return out_ptr, out_idx
 
 
-def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1):
-    """Native multilevel nested dissection; returns order or None."""
+def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1,
+         nthreads: int | None = None):
+    """Native multilevel nested dissection; returns order or None.
+
+    nthreads > 1 (or SLU_TPU_ND_THREADS) maps independent separator
+    subtrees onto threads — the parallel-ordering capability analog of
+    the reference's ParMETIS path (SRC/get_perm_c_parmetis.c:104,255:
+    separator tree built by 2^q processes).  The result is deterministic
+    for a given (seed, leaf_size) regardless of nthreads: every subtree
+    derives its RNG stream from its tree path, not from thread timing.
+    """
     lib = _load()
     if lib is None:
         return None
+    if nthreads is None:
+        nthreads = int(os.environ.get("SLU_TPU_ND_THREADS", "1") or 1)
     indptr = _as_i64(indptr)
     indices = _as_i64(indices)
     order = np.empty(n, dtype=np.int64)
-    lib.slu_mlnd(n, _ptr_i64(indptr), _ptr_i64(indices), leaf_size, seed,
-                 _ptr_i64(order))
+    lib.slu_mlnd_mt(n, _ptr_i64(indptr), _ptr_i64(indices), leaf_size, seed,
+                    max(int(nthreads), 1), _ptr_i64(order))
     return order

@@ -623,12 +623,16 @@ void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
   std::vector<VSet> col_elems(n_cols);
   std::vector<char> elem_alive(n_rows, 0);
   for (i64 r = 0; r < n_rows; ++r) {
-    i64 len = indptr[r + 1] - indptr[r];
-    if (len > dense_row) continue;  // dense row: excluded from scores
     VSet& cols = elem_cols[r];
     cols.assign(indices + indptr[r], indices + indptr[r + 1]);
     std::sort(cols.begin(), cols.end());
     cols.erase(std::unique(cols.begin(), cols.end()), cols.end());
+    // dense test on the DEDUPED length — the Python oracle dedups first
+    if ((i64)cols.size() > dense_row) {
+      cols.clear();
+      cols.shrink_to_fit();
+      continue;  // dense row: excluded from scores
+    }
     elem_alive[r] = 1;
     for (i64 j : cols) col_elems[j].push_back(r);
   }
@@ -722,25 +726,29 @@ i64 slu_ata_pattern(i64 n_rows, i64 n_cols, const i64* indptr,
                     const i64* indices, i64 dense_row,
                     i64* out_indptr, i64** out_indices) {
   HeapScope heap_scope;
+  // append every row-clique contribution, then one sort+unique per column
+  // at emission — O(sum row_len^2) appends instead of the quadratic
+  // repeated set-union a popular column would otherwise pay
   std::vector<VSet> adj(n_cols);
   for (i64 r = 0; r < n_rows; ++r) {
-    i64 len = indptr[r + 1] - indptr[r];
-    if (len <= 1 || (dense_row > 0 && len > dense_row)) continue;
     VSet cols(indices + indptr[r], indices + indptr[r + 1]);
     std::sort(cols.begin(), cols.end());
     cols.erase(std::unique(cols.begin(), cols.end()), cols.end());
-    for (i64 j : cols) {
-      VSet others;
-      others.reserve(cols.size() - 1);
+    // dense test on the DEDUPED length — matches the Python oracle
+    if ((i64)cols.size() <= 1
+        || (dense_row > 0 && (i64)cols.size() > dense_row))
+      continue;
+    for (i64 j : cols)
       for (i64 u : cols)
-        if (u != j) others.push_back(u);
-      adj[j] = vset_union(adj[j], others);
-    }
+        if (u != j) adj[j].push_back(u);
   }
   i64 total = 0;
   out_indptr[0] = 0;
   for (i64 j = 0; j < n_cols; ++j) {
-    total += (i64)adj[j].size();
+    VSet& a = adj[j];
+    std::sort(a.begin(), a.end());
+    a.erase(std::unique(a.begin(), a.end()), a.end());
+    total += (i64)a.size();
     out_indptr[j + 1] = total;
   }
   i64* out = (i64*)std::malloc(std::max<i64>(total, 1) * sizeof(i64));
@@ -1092,46 +1100,37 @@ void leaf_md(const std::vector<i64>& nodes, const i64* indptr,
   }
 }
 
-}  // namespace
+inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
 
-void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
-              uint64_t seed, i64* order_out) {
-  HeapScope heap_scope;
-  std::mt19937_64 rng(seed);
-  std::vector<i64> glob2loc(n, -1);
-  i64 pos = 0;
-  std::vector<i64> md_out;
-
-  // explicit work stack: (nodes, emit_flag).  Post-order: push separator
-  // emit first, then parts (LIFO => parts processed before the emit).
-  struct Item {
-    std::vector<i64> nodes;
-    bool emit;
-  };
-  std::vector<Item> work;
-  {
-    std::vector<i64> all(n);
-    for (i64 i = 0; i < n; ++i) all[i] = i;
-    work.push_back({std::move(all), false});
+// One nested-dissection task: emits the post-order [A..., B..., sep...]
+// into `out`.  `glob2loc` is an n-sized scratch owned by this task's
+// thread (every entry it writes is restored to -1 before returning or
+// recursing into a spawned sibling).  While depth < spawn_depth the A
+// branch runs on a freshly spawned std::thread with its own scratch —
+// the subtree-to-thread mapping that makes this the ParMETIS-analog
+// parallel ordering (reference get_perm_c_parmetis.c:104,255: separator
+// tree computed by 2^q processes).
+void mlnd_rec(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
+              std::vector<i64> nodes, uint64_t seed, i64 spawn_depth,
+              i64 depth, std::vector<i64>& glob2loc, std::vector<i64>& out) {
+  std::mt19937_64 rng(splitmix64(seed));
+  if ((i64)nodes.size() <= leaf_size) {
+    for (i64 li = 0; li < (i64)nodes.size(); ++li) glob2loc[nodes[li]] = li;
+    leaf_md(nodes, indptr, indices, glob2loc, out);
+    for (i64 v : nodes) glob2loc[v] = -1;
+    return;
   }
-  while (!work.empty()) {
-    Item it = std::move(work.back());
-    work.pop_back();
-    auto& nodes = it.nodes;
-    if (it.emit) {
-      for (i64 v : nodes) order_out[pos++] = v;
-      continue;
-    }
-    if ((i64)nodes.size() <= leaf_size) {
-      md_out.clear();
-      for (i64 v : nodes) glob2loc[v] = 1;  // mark (value set below)
-      for (i64 li = 0; li < (i64)nodes.size(); ++li) glob2loc[nodes[li]] = li;
-      leaf_md(nodes, indptr, indices, glob2loc, md_out);
-      for (i64 v : nodes) glob2loc[v] = -1;
-      for (i64 v : md_out) order_out[pos++] = v;
-      continue;
-    }
-    // build local subgraph
+  // build local subgraph — scoped so the O(edges) Graph and all bisection
+  // scratch are destroyed BEFORE the recursion (a recursion path must hold
+  // only its own partition lists, not every ancestor's subgraph, or memory
+  // grows to O(E·depth) at the n≈1M target class)
+  std::vector<i64> a_part, b_part, sep;
+  {
     Graph g;
     g.n = (i64)nodes.size();
     for (i64 li = 0; li < g.n; ++li) glob2loc[nodes[li]] = li;
@@ -1159,7 +1158,6 @@ void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
     ml_bisect(g, part, rng);
     // vertex separator from the edge cut: greedy cover — move to the
     // separator the endpoint covering the most uncovered cut edges
-    // (approximates minimum vertex cover of the cut bipartite graph).
     std::vector<char> insep(g.n, 0);
     std::vector<i64> cutdeg(g.n, 0);
     for (i64 v = 0; v < g.n; ++v)
@@ -1180,7 +1178,6 @@ void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
       if (!uncovered) continue;
       insep[v] = 1;
     }
-    std::vector<i64> a_part, b_part, sep;
     for (i64 v = 0; v < g.n; ++v) {
       if (insep[v])
         sep.push_back(nodes[v]);
@@ -1190,28 +1187,287 @@ void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
         b_part.push_back(nodes[v]);
     }
     for (i64 li = 0; li < g.n; ++li) glob2loc[nodes[li]] = -1;
-    // degenerate split (e.g. clique): local MD on the blob when the
-    // bitset cost (k^2/8 bytes) is affordable, natural order otherwise
-    if (a_part.empty() || b_part.empty()) {
-      std::sort(nodes.begin(), nodes.end());
-      if ((i64)nodes.size() <= 2048) {
-        md_out.clear();
-        for (i64 li = 0; li < (i64)nodes.size(); ++li)
-          glob2loc[nodes[li]] = li;
-        leaf_md(nodes, indptr, indices, glob2loc, md_out);
-        for (i64 v : nodes) glob2loc[v] = -1;
-        for (i64 v : md_out) order_out[pos++] = v;
-      } else {
-        for (i64 v : nodes) order_out[pos++] = v;
-      }
-      continue;
-    }
-    work.push_back({std::move(sep), true});
-    work.push_back({std::move(b_part), false});
-    work.push_back({std::move(a_part), false});
   }
-  // pos == n expected; fill any deficit defensively (should not happen)
-  (void)pos;
+  // degenerate split (e.g. clique): local MD on the blob when the
+  // bitset cost (k^2/8 bytes) is affordable, natural order otherwise
+  if (a_part.empty() || b_part.empty()) {
+    std::sort(nodes.begin(), nodes.end());
+    if ((i64)nodes.size() <= 2048) {
+      for (i64 li = 0; li < (i64)nodes.size(); ++li)
+        glob2loc[nodes[li]] = li;
+      leaf_md(nodes, indptr, indices, glob2loc, out);
+      for (i64 v : nodes) glob2loc[v] = -1;
+    } else {
+      for (i64 v : nodes) out.push_back(v);
+    }
+    return;
+  }
+  nodes.clear();
+  nodes.shrink_to_fit();
+  uint64_t sa = splitmix64(seed * 2 + 1), sb = splitmix64(seed * 2 + 2);
+  if (depth < spawn_depth) {
+    std::vector<i64> a_out, b_out;
+    std::thread t([&, sa]() {
+      std::vector<i64> scratch(n, -1);
+      mlnd_rec(n, indptr, indices, leaf_size, std::move(a_part), sa,
+               spawn_depth, depth + 1, scratch, a_out);
+    });
+    mlnd_rec(n, indptr, indices, leaf_size, std::move(b_part), sb,
+             spawn_depth, depth + 1, glob2loc, b_out);
+    t.join();
+    out.insert(out.end(), a_out.begin(), a_out.end());
+    out.insert(out.end(), b_out.begin(), b_out.end());
+  } else {
+    mlnd_rec(n, indptr, indices, leaf_size, std::move(a_part), sa,
+             spawn_depth, depth + 1, glob2loc, out);
+    mlnd_rec(n, indptr, indices, leaf_size, std::move(b_part), sb,
+             spawn_depth, depth + 1, glob2loc, out);
+  }
+  out.insert(out.end(), sep.begin(), sep.end());
+}
+
+}  // namespace
+
+void slu_mlnd_mt(i64 n, const i64* indptr, const i64* indices,
+                 i64 leaf_size, uint64_t seed, i64 nthreads,
+                 i64* order_out) {
+  HeapScope heap_scope;
+  // spawn_depth d gives up to 2^d concurrent subtree tasks (plus the
+  // separator work in their ancestors) — the subtree-to-process mapping
+  // of the reference's parallel ordering (get_perm_c_parmetis.c:255)
+  i64 hc = (i64)std::thread::hardware_concurrency();
+  if (hc <= 0) hc = 1;
+  if (nthreads > hc) nthreads = hc;   // oversubscription only wastes
+  if (nthreads < 1) nthreads = 1;     // scratch memory; a huge env value
+                                      // must not exhaust pthreads
+  i64 spawn_depth = 0;
+  while ((1ll << spawn_depth) < nthreads) ++spawn_depth;
+  std::vector<i64> all(n);
+  for (i64 i = 0; i < n; ++i) all[i] = i;
+  std::vector<i64> glob2loc(n, -1);
+  std::vector<i64> out;
+  out.reserve(n);
+  mlnd_rec(n, indptr, indices, leaf_size, std::move(all), seed,
+           spawn_depth, 0, glob2loc, out);
+  for (i64 i = 0; i < (i64)out.size() && i < n; ++i) order_out[i] = out[i];
+}
+
+void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
+              uint64_t seed, i64* order_out) {
+  slu_mlnd_mt(n, indptr, indices, leaf_size, seed, 1, order_out);
+}
+
+// ---------------------------------------------------------------------------
+// Async tree broadcast / reduction over shared memory — capability analog
+// of the reference's C++11 tree-collective engine (TreeBcast_slu.hpp,
+// TreeReduce_slu.hpp, TreeInterface.cpp) that drives the distributed
+// triangular solve.  Same topology rule: flat tree up to 8 ranks, binary
+// beyond (TreeBcast_slu.hpp:17-29).  The reference's transport is MPI
+// point-to-point; the TPU-native host runtime uses a POSIX shared-memory
+// segment with per-rank sequence/ack counters — single-node multi-process
+// orchestration, while on-device collectives ride XLA/ICI (parallel/grid).
+//
+// Layout of the segment: header {n_ranks, max_len}, then per rank:
+//   seq  (atomic u64): last operation index this rank has published
+//   ack  (atomic u64): cumulative count of child reads of this rank's slot
+//   buf  (max_len doubles)
+// Each collective call site must be reached by every rank in the same
+// order (the usual collective contract); op indices are tracked per
+// attached handle.
+// ---------------------------------------------------------------------------
+}  // extern "C"
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace slu_tree {
+
+struct RankSlot {
+  std::atomic<uint64_t> seq;
+  std::atomic<uint64_t> ack;
+};
+
+struct Header {
+  i64 n_ranks;
+  i64 max_len;
+};
+
+struct Handle {
+  Header* hdr = nullptr;
+  RankSlot* slots = nullptr;   // n_ranks
+  double* bufs = nullptr;      // n_ranks * max_len
+  i64 rank = -1;
+  uint64_t op = 0;             // shared across bcast+reduce: every rank
+                               // reaches the collectives in the same order
+  uint64_t my_reads = 0;       // total reads ever promised on my slot
+  size_t map_len = 0;
+  void* base = nullptr;
+};
+
+inline size_t seg_size(i64 n_ranks, i64 max_len) {
+  return sizeof(Header) + (size_t)n_ranks * sizeof(RankSlot)
+         + (size_t)n_ranks * (size_t)max_len * sizeof(double);
+}
+
+// flat <= 8 ranks (every rank a direct child of the root), binary above —
+// expressed on the root-relative virtual rank v = (rank - root) mod n
+inline i64 parent_of(i64 v, i64 n) {
+  if (v == 0) return -1;
+  if (n <= 8) return 0;
+  return (v - 1) / 2;
+}
+
+inline void children_of(i64 v, i64 n, i64* out, i64* n_out) {
+  *n_out = 0;
+  if (n <= 8) {
+    if (v == 0)
+      for (i64 c = 1; c < n; ++c) out[(*n_out)++] = c;
+    return;
+  }
+  for (i64 c = 2 * v + 1; c <= 2 * v + 2 && c < n; ++c)
+    out[(*n_out)++] = c;
+}
+
+inline void backoff(int& spins) {
+  if (++spins < 1024) return;
+  ::usleep(50);
+}
+
+}  // namespace slu_tree
+
+extern "C" {
+
+void* slu_tree_attach(const char* name, i64 n_ranks, i64 max_len,
+                      i64 rank, i64 create) {
+  using namespace slu_tree;
+  size_t len = seg_size(n_ranks, max_len);
+  int fd = create ? ::shm_open(name, O_CREAT | O_RDWR, 0600)
+                  : ::shm_open(name, O_RDWR, 0600);
+  if (fd < 0) return nullptr;
+  if (create) {
+    if (::ftruncate(fd, (off_t)len) != 0) {
+      ::close(fd);
+      return nullptr;
+    }
+  } else {
+    // creator may still be between shm_open and ftruncate: mapping a
+    // zero-length segment SIGBUSes on first touch.  Wait (bounded) for
+    // the segment to reach full size.
+    struct stat st;
+    int tries = 0;
+    while (::fstat(fd, &st) == 0 && (size_t)st.st_size < len) {
+      if (++tries > 100000) {       // ~10 s
+        ::close(fd);
+        return nullptr;
+      }
+      ::usleep(100);
+    }
+  }
+  void* base = ::mmap(nullptr, len, PROT_READ | PROT_WRITE, MAP_SHARED,
+                      fd, 0);
+  ::close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  auto* h = new Handle;
+  h->base = base;
+  h->map_len = len;
+  h->hdr = (Header*)base;
+  h->slots = (RankSlot*)((char*)base + sizeof(Header));
+  h->bufs = (double*)((char*)base + sizeof(Header)
+                      + (size_t)n_ranks * sizeof(RankSlot));
+  h->rank = rank;
+  if (create) {
+    h->hdr->n_ranks = n_ranks;
+    h->hdr->max_len = max_len;
+    for (i64 r = 0; r < n_ranks; ++r) {
+      h->slots[r].seq.store(0, std::memory_order_relaxed);
+      h->slots[r].ack.store(0, std::memory_order_relaxed);
+    }
+  }
+  return h;
+}
+
+void slu_tree_detach(void* vh, const char* name, i64 unlink_seg) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  if (!h) return;
+  ::munmap(h->base, h->map_len);
+  if (unlink_seg) ::shm_unlink(name);
+  delete h;
+}
+
+// Broadcast buf (len doubles) from root to all ranks.  Every rank calls
+// with its own buf; non-roots receive into it.  Publish protocol: before
+// overwriting my slot I wait until every read promised by my PREVIOUS
+// publishes has been acked (cumulative counter), so a slow child can
+// still be copying op t while the tree races ahead to t+1 elsewhere.
+void slu_tree_bcast(void* vh, i64 root, double* buf, i64 len) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  i64 n = h->hdr->n_ranks;
+  uint64_t op = ++h->op;
+  if (n == 1) return;
+  root = ((root % n) + n) % n;   // normalize (root=-1 idiom, bad input)
+  i64 v = (h->rank - root + n) % n;
+  i64 kids[8];
+  i64 n_kids = 0;
+  children_of(v, n, kids, &n_kids);
+  RankSlot& mine = h->slots[h->rank];
+  double* my_buf = h->bufs + (size_t)h->rank * h->hdr->max_len;
+  int spins = 0;
+  if (v != 0) {
+    i64 p_rank = (parent_of(v, n) + root) % n;
+    RankSlot& ps = h->slots[p_rank];
+    while (ps.seq.load(std::memory_order_acquire) < op) backoff(spins);
+    std::memcpy(buf, h->bufs + (size_t)p_rank * h->hdr->max_len,
+                (size_t)len * sizeof(double));
+    ps.ack.fetch_add(1, std::memory_order_acq_rel);
+  }
+  if (n_kids) {
+    spins = 0;
+    while (mine.ack.load(std::memory_order_acquire) < h->my_reads)
+      backoff(spins);
+    std::memcpy(my_buf, buf, (size_t)len * sizeof(double));
+    mine.seq.store(op, std::memory_order_release);
+    h->my_reads += (uint64_t)n_kids;
+  }
+}
+
+// Sum-reduce buf (len doubles) onto the root: on return the root's buf
+// holds the elementwise sum of every rank's input; other ranks' bufs are
+// clobbered with their subtree partial.
+void slu_tree_reduce_sum(void* vh, i64 root, double* buf, i64 len) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  i64 n = h->hdr->n_ranks;
+  uint64_t op = ++h->op;
+  if (n == 1) return;
+  root = ((root % n) + n) % n;   // normalize (root=-1 idiom, bad input)
+  i64 v = (h->rank - root + n) % n;
+  i64 kids[8];
+  i64 n_kids = 0;
+  children_of(v, n, kids, &n_kids);
+  RankSlot& mine = h->slots[h->rank];
+  double* my_buf = h->bufs + (size_t)h->rank * h->hdr->max_len;
+  int spins = 0;
+  for (i64 c = 0; c < n_kids; ++c) {
+    i64 c_rank = (kids[c] + root) % n;
+    RankSlot& cs = h->slots[c_rank];
+    spins = 0;
+    while (cs.seq.load(std::memory_order_acquire) < op) backoff(spins);
+    const double* cb = h->bufs + (size_t)c_rank * h->hdr->max_len;
+    for (i64 i = 0; i < len; ++i) buf[i] += cb[i];
+    cs.ack.fetch_add(1, std::memory_order_acq_rel);
+  }
+  if (v != 0) {                 // publish subtree partial for my parent
+    spins = 0;
+    while (mine.ack.load(std::memory_order_acquire) < h->my_reads)
+      backoff(spins);
+    std::memcpy(my_buf, buf, (size_t)len * sizeof(double));
+    mine.seq.store(op, std::memory_order_release);
+    h->my_reads += 1;
+  }
 }
 
 }  // extern "C"

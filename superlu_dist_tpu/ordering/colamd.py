@@ -25,6 +25,14 @@ import heapq
 import numpy as np
 
 
+def dense_row_threshold(n: int) -> int:
+    """Single definition of the colamd dense-row/column heuristic cutoff
+    (entries > 10·sqrt(n) ⇒ sidelined).  Used by the Python oracle, the
+    MMD_ATA dispatch, and mirrored by the C++ fast path
+    (slu_host.cpp slu_colamd / slu_ata_pattern — keep in sync)."""
+    return max(16, int(10.0 * np.sqrt(max(n, 1))))
+
+
 def colamd_order(n_rows: int, n_cols: int, indptr: np.ndarray,
                  indices: np.ndarray) -> np.ndarray:
     """Return order[k] = old index of the k-th pivot column."""
@@ -36,8 +44,8 @@ def colamd_order(n_rows: int, n_cols: int, indptr: np.ndarray,
 
 
 def _colamd_py(n_rows, n_cols, indptr, indices):
-    dense_row = max(16, int(10.0 * np.sqrt(n_cols)))
-    dense_col = max(16, int(10.0 * np.sqrt(max(n_rows, 1))))
+    dense_row = dense_row_threshold(n_cols)
+    dense_col = dense_row_threshold(n_rows)
     elem_cols = {}                       # element id -> sorted col list
     col_elems = [[] for _ in range(n_cols)]
     for r in range(n_rows):

@@ -32,10 +32,10 @@ def get_perm_c(options: Options, a: SparseCSR,
         return colamd_order(a.n_rows, a.n_cols, a.indptr, a.indices)
     if cp == ColPerm.MMD_ATA:
         # exact MD on the explicit AᵀA pattern (getata_dist analog)
-        from superlu_dist_tpu.ordering.colamd import ata_adjacency
-        dense = max(16, int(10.0 * np.sqrt(a.n_cols)))
+        from superlu_dist_tpu.ordering.colamd import (ata_adjacency,
+                                                      dense_row_threshold)
         ptr, idx = ata_adjacency(a.n_rows, a.n_cols, a.indptr, a.indices,
-                                 dense_row=dense)
+                                 dense_row=dense_row_threshold(a.n_cols))
         return minimum_degree(n, ptr, idx)
     if sym is None:
         sym = symmetrize_pattern(a)

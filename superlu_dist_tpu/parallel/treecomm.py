@@ -1,0 +1,91 @@
+"""Host-side async tree broadcast / reduction.
+
+Capability analog of the reference's C++11 tree-collective engine
+(TreeBcast_slu.hpp, TreeReduce_slu.hpp, TreeInterface.cpp) — the
+per-supernode broadcast and reduction trees that drive its distributed
+triangular solve (pdgstrs.c:1444-1670).  Same topology rule: flat tree up
+to 8 ranks, binary beyond (TreeBcast_slu.hpp:17-29).
+
+TPU-native split of responsibilities: *on-device* solve collectives ride
+XLA over the mesh (solve/device.py on sharded factors); this module is
+the *host-process* orchestration layer — multi-process single-node runs
+coordinate through a POSIX shared-memory segment (native slu_tree_*,
+slu_host.cpp) instead of MPI point-to-point, with per-rank atomic
+sequence/ack counters providing the async pipeline the reference gets
+from Isend/Irecv.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+import numpy as np
+
+from superlu_dist_tpu import native
+
+
+class TreeComm:
+    """One rank's attachment to a named tree-collective domain.
+
+    Every participating process constructs TreeComm with the same name,
+    n_ranks and max_len; rank 0 creates the segment.  All ranks must
+    reach the collectives in the same order (the usual collective
+    contract — the reference's trees are likewise matched per supernode).
+    """
+
+    def __init__(self, name: str, n_ranks: int, rank: int,
+                 max_len: int = 4096, create: bool | None = None):
+        lib = native._load()
+        if lib is None:
+            raise RuntimeError("native library unavailable for TreeComm")
+        self._lib = lib
+        self.name = name.encode() if isinstance(name, str) else name
+        self.n_ranks = int(n_ranks)
+        self.rank = int(rank)
+        self.max_len = int(max_len)
+        if create is None:
+            create = rank == 0
+        self._h = lib.slu_tree_attach(self.name, self.n_ranks,
+                                      self.max_len, self.rank,
+                                      1 if create else 0)
+        if not self._h:
+            raise OSError(f"slu_tree_attach failed for {name!r}")
+        self._created = bool(create)
+
+    def bcast(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
+        """Broadcast root's buf to every rank (in place, returned)."""
+        buf = np.ascontiguousarray(buf, dtype=np.float64)
+        assert buf.size <= self.max_len
+        self._lib.slu_tree_bcast(
+            self._h, int(root),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
+        return buf
+
+    def reduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
+        """Elementwise sum onto root (root's buf holds the total)."""
+        buf = np.ascontiguousarray(buf, dtype=np.float64)
+        assert buf.size <= self.max_len
+        self._lib.slu_tree_reduce_sum(
+            self._h, int(root),
+            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
+        return buf
+
+    def allreduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
+        """reduce_sum then bcast — the composite the reference builds from
+        its RdTree + BcTree pair per supernode."""
+        buf = self.reduce_sum(buf, root)
+        return self.bcast(buf, root)
+
+    def close(self, unlink: bool | None = None):
+        if self._h:
+            if unlink is None:
+                unlink = self._created
+            self._lib.slu_tree_detach(self._h, self.name,
+                                      1 if unlink else 0)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

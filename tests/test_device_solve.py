@@ -80,3 +80,19 @@ def test_device_solver_through_driver_path():
     lu.dev_solver = None
     x_dev = lu.solve_factored(b)
     np.testing.assert_allclose(x_dev, x, rtol=1e-7, atol=1e-9)
+
+
+def test_device_solver_complex():
+    """c128 factors through the device solve path (the pzgstrs z-twin
+    capability, SRC/pzgstrs.c) — CPU backend here, same kernels on TPU."""
+    from superlu_dist_tpu.models.gallery import random_sparse
+    a = random_sparse(48, density=0.08, seed=11)
+    vals = a.data + 1j * np.random.default_rng(4).standard_normal(a.nnz)
+    ac = type(a)(a.n_rows, a.n_cols, a.indptr, a.indices, vals)
+    lu = _factor(ac)
+    rng = np.random.default_rng(8)
+    d = rng.standard_normal((ac.n_rows, 2)) + 1j * rng.standard_normal(
+        (ac.n_rows, 2))
+    got = DeviceSolver(lu.numeric).solve(d)
+    want = lu_solve(lu.numeric, d)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)

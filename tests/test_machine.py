@@ -1,0 +1,61 @@
+"""Machine-parameter and timer sanity probes.
+
+Capability analog of the reference's INSTALL tests (INSTALL/dmachtst.c:
+machine epsilon / underflow / overflow probes; INSTALL/timertst.c: timer
+resolution), driven by install.csh.  Here they guard the assumptions the
+GESP threshold arithmetic makes: thresh = sqrt(eps)·‖A‖ must be
+representable and monotone in both working precisions, and the phase
+timers must actually resolve the phases they time.
+"""
+
+import time
+
+import numpy as np
+
+
+def _probe_eps(dtype):
+    """Smallest e with 1 + e != 1 — must match np.finfo."""
+    one = dtype(1.0)
+    e = dtype(1.0)
+    while one + e / dtype(2.0) != one:
+        e = e / dtype(2.0)
+    return e
+
+
+def test_machine_epsilon_f64():
+    assert _probe_eps(np.float64) == np.finfo(np.float64).eps
+
+
+def test_machine_epsilon_f32():
+    assert _probe_eps(np.float32) == np.finfo(np.float32).eps
+
+
+def test_underflow_overflow_bounds():
+    for dt in (np.float32, np.float64):
+        fi = np.finfo(dt)
+        assert fi.tiny > 0 and np.isfinite(fi.tiny)
+        assert np.isfinite(fi.max)
+        with np.errstate(over="ignore"):
+            assert np.isinf(dt(fi.max) * dt(2.0))
+        # GESP threshold must stay representable across the anorm range
+        for anorm in (fi.tiny, 1.0, fi.max ** 0.5):
+            t = np.sqrt(fi.eps) * dt(anorm)
+            assert np.isfinite(t) and t >= 0
+
+
+def test_timer_resolution():
+    """perf_counter must resolve well under one solver phase (~ms)."""
+    res = time.get_clock_info("perf_counter").resolution
+    assert res < 1e-4
+    t0 = time.perf_counter()
+    while time.perf_counter() == t0:
+        pass
+    assert time.perf_counter() - t0 < 1e-3
+
+
+def test_stats_timer_accumulates():
+    from superlu_dist_tpu.utils.stats import Stats
+    s = Stats()
+    with s.timer("FACT"):
+        time.sleep(0.01)
+    assert s.utime["FACT"] >= 0.009

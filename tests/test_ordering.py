@@ -171,3 +171,18 @@ def test_colamd_dense_column_goes_last():
     order = colamd_order(n, n, indptr, np.asarray(indices, dtype=np.int64))
     assert sorted(order) == list(range(n))
     assert order[-1] == 0
+
+
+def test_mlnd_threaded_deterministic():
+    """Parallel ND (ParMETIS-analog, get_perm_c_parmetis.c:255): subtree
+    threading must not change the ordering — RNG streams derive from the
+    separator-tree path, not thread timing."""
+    from superlu_dist_tpu import native
+    if not native.available():
+        pytest.skip("native unavailable")
+    a = poisson2d(30)
+    sym = symmetrize_pattern(a)
+    o1 = native.mlnd(a.n_rows, sym.indptr, sym.indices, nthreads=1)
+    o4 = native.mlnd(a.n_rows, sym.indptr, sym.indices, nthreads=4)
+    assert sorted(o1) == list(range(a.n_rows))
+    np.testing.assert_array_equal(o1, o4)

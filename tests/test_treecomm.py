@@ -1,0 +1,100 @@
+"""Tree broadcast/reduction engine (TreeBcast_slu / TreeReduce_slu analog).
+
+Multi-process tests: real processes coordinate through the shared-memory
+segment, mirroring how the reference tests multi-node behavior by
+oversubscribing ranks on one box (SURVEY.md §4, .travis_tests.sh).
+Covers both topologies: flat (n <= 8) and binary (n > 8,
+TreeBcast_slu.hpp:17-29).
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+def _worker(name, n_ranks, rank, root, q):
+    # import inside the child: must not inherit initialized JAX state
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    with TreeComm(name, n_ranks, rank, max_len=64,
+                  create=False) as tc:
+        # 1) bcast: root sends its rank-stamped payload
+        buf = np.full(8, float(rank))
+        tc.bcast(buf, root=root)
+        bcast_ok = bool((buf == float(root)).all())
+        # 2) reduce: everyone contributes rank+1
+        buf2 = np.full(8, float(rank + 1))
+        tc.reduce_sum(buf2, root=root)
+        # 3) a second round immediately (slot-reuse path)
+        buf3 = np.full(8, 1.0)
+        tc.allreduce_sum(buf3, root=root)
+        q.put((rank, bcast_ok, float(buf2[0]), float(buf3[0])))
+
+
+def _run(n_ranks, root):
+    name = f"/slu_tree_test_{os.getpid()}_{n_ranks}_{root}"
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    owner = TreeComm(name, n_ranks, 0, max_len=64, create=True)
+    try:
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_worker,
+                             args=(name, n_ranks, r, root, q))
+                 for r in range(1, n_ranks)]
+        for p in procs:
+            p.start()
+        # rank 0 participates from this process
+        buf = np.full(8, 0.0)
+        owner.bcast(buf, root=root)
+        buf2 = np.full(8, 1.0)
+        owner.reduce_sum(buf2, root=root)
+        buf3 = np.full(8, 1.0)
+        owner.allreduce_sum(buf3, root=root)
+        results = {0: (0, bool((buf == float(root)).all()),
+                       float(buf2[0]), float(buf3[0]))}
+        for _ in procs:
+            r = q.get(timeout=60)
+            results[r[0]] = r
+        for p in procs:
+            p.join(timeout=60)
+            assert p.exitcode == 0
+    finally:
+        owner.close(unlink=True)
+    total = n_ranks * (n_ranks + 1) / 2.0   # sum of rank+1
+    for rank, (rk, bcast_ok, red, allred) in results.items():
+        assert bcast_ok, f"rank {rank} bcast payload wrong"
+        if rank == root:
+            assert red == total, (rank, red, total)
+        assert allred == float(n_ranks), (rank, allred)
+
+
+def test_flat_tree_6_ranks():
+    _run(6, root=0)
+
+
+def test_flat_tree_nonzero_root():
+    _run(5, root=3)
+
+
+def test_binary_tree_12_ranks():
+    _run(12, root=0)
+
+
+def test_binary_tree_nonzero_root():
+    _run(10, root=7)
+
+
+def test_single_rank_noop():
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    name = f"/slu_tree_solo_{os.getpid()}"
+    with TreeComm(name, 1, 0, max_len=16, create=True) as tc:
+        b = np.arange(4.0)
+        tc.bcast(b)
+        tc.reduce_sum(b)
+        np.testing.assert_array_equal(b, np.arange(4.0))
