@@ -64,6 +64,8 @@ class LUFactorization:
     berrs: list = None        # backward errors of the last refinement
     a_sym_indptr: np.ndarray = None    # symmetrized pattern the symbolic
     a_sym_indices: np.ndarray = None   # factorization was built on
+    dev_spmv: object = None            # cached DeviceSpMV per (trans,
+                                       # dtype) — pdgsmv_init discipline
     dev_solver: object = None          # lazy DeviceSolver (SolveInitialized
                                        # analog, pdgssvx.c:1330-1337)
     solve_path: str = "auto"           # "auto" | "host" | "device"; "auto"
@@ -364,8 +366,30 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
         residual_dtype = (np.float32
                           if options.iter_refine == IterRefine.SLU_SINGLE
                           else np.dtype(options.ir_dtype))
+        # device-resident residual SpMV (pdgsmv analog, SRC/pdgsmv.c:234)
+        # when an accelerator is present and A is big enough for the
+        # upload to pay for itself; host numpy otherwise or on failure
+        ir_op = op
+        import jax
+        if (jax.default_backend() != "cpu"
+                and op.nnz >= 100_000 and not lu.numeric.on_host):
+            # cached per (trans, residual dtype) on the factorization —
+            # the pdgsmv_init / SOLVEstruct discipline (SRC/pdgsmv.c:31)
+            key = (trans, str(residual_dtype))
+            cache = lu.dev_spmv if lu.dev_spmv is not None else {}
+            ir_op = cache.get(key)
+            if ir_op is None:
+                try:
+                    from superlu_dist_tpu.parallel.dist import DeviceSpMV
+                    ir_op = DeviceSpMV(
+                        op,
+                        dtype=np.result_type(op.data.dtype, residual_dtype))
+                except Exception:          # x64 off / upload failure —
+                    ir_op = op             # host residual stays correct
+                cache[key] = ir_op
+                lu.dev_spmv = cache
         with stats.timer("REFINE"):
-            x, berrs = iterative_refinement(op, b, x, solve_fn,
+            x, berrs = iterative_refinement(ir_op, b, x, solve_fn,
                                             residual_dtype=residual_dtype)
         stats.refine_steps += len(berrs)
         lu.berrs = berrs

@@ -726,10 +726,19 @@ i64 slu_ata_pattern(i64 n_rows, i64 n_cols, const i64* indptr,
                     const i64* indices, i64 dense_row,
                     i64* out_indptr, i64** out_indices) {
   HeapScope heap_scope;
-  // append every row-clique contribution, then one sort+unique per column
-  // at emission — O(sum row_len^2) appends instead of the quadratic
-  // repeated set-union a popular column would otherwise pay
+  // append row-clique contributions, dedup each column amortized (when a
+  // list grows past 4x its last compacted size) — linear appends instead
+  // of the quadratic repeated set-union a popular column would pay, with
+  // peak memory bounded at ~4x the final pattern instead of the raw
+  // O(sum row_len^2) of append-everything
   std::vector<VSet> adj(n_cols);
+  std::vector<i64> compacted(n_cols, 16);   // size floor before dedup
+  auto compact = [&](i64 j) {
+    VSet& a = adj[j];
+    std::sort(a.begin(), a.end());
+    a.erase(std::unique(a.begin(), a.end()), a.end());
+    compacted[j] = std::max<i64>((i64)a.size(), 16);
+  };
   for (i64 r = 0; r < n_rows; ++r) {
     VSet cols(indices + indptr[r], indices + indptr[r + 1]);
     std::sort(cols.begin(), cols.end());
@@ -738,9 +747,11 @@ i64 slu_ata_pattern(i64 n_rows, i64 n_cols, const i64* indptr,
     if ((i64)cols.size() <= 1
         || (dense_row > 0 && (i64)cols.size() > dense_row))
       continue;
-    for (i64 j : cols)
+    for (i64 j : cols) {
       for (i64 u : cols)
         if (u != j) adj[j].push_back(u);
+      if ((i64)adj[j].size() > 4 * compacted[j]) compact(j);
+    }
   }
   i64 total = 0;
   out_indptr[0] = 0;
@@ -1292,7 +1303,10 @@ struct RankSlot {
 struct Header {
   i64 n_ranks;
   i64 max_len;
+  std::atomic<uint64_t> ready;   // == kReadyMagic once fully initialized
 };
+
+constexpr uint64_t kReadyMagic = 0x51b17ee5c0113c7ull;
 
 struct Handle {
   Header* hdr = nullptr;
@@ -1383,6 +1397,19 @@ void* slu_tree_attach(const char* name, i64 n_ranks, i64 max_len,
     for (i64 r = 0; r < n_ranks; ++r) {
       h->slots[r].seq.store(0, std::memory_order_relaxed);
       h->slots[r].ack.store(0, std::memory_order_relaxed);
+    }
+    h->hdr->ready.store(kReadyMagic, std::memory_order_release);
+  } else {
+    // size alone is not enough: the creator may be preempted between
+    // ftruncate and the header stores — wait for the ready flag
+    int tries = 0;
+    while (h->hdr->ready.load(std::memory_order_acquire) != kReadyMagic) {
+      if (++tries > 100000) {       // ~10 s
+        ::munmap(base, len);
+        delete h;
+        return nullptr;
+      }
+      ::usleep(100);
     }
   }
   return h;

@@ -52,19 +52,27 @@ class TreeComm:
             raise OSError(f"slu_tree_attach failed for {name!r}")
         self._created = bool(create)
 
+    def _prep(self, buf: np.ndarray) -> np.ndarray:
+        out = np.ascontiguousarray(buf, dtype=np.float64)
+        if out.size > self.max_len:     # a real check — the native side
+            raise ValueError(           # memcpys into a max_len slot
+                f"payload {out.size} > max_len {self.max_len}")
+        return out
+
     def bcast(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
-        """Broadcast root's buf to every rank (in place, returned)."""
-        buf = np.ascontiguousarray(buf, dtype=np.float64)
-        assert buf.size <= self.max_len
+        """Broadcast root's buf to every rank.  USE THE RETURN VALUE:
+        when the input is contiguous float64 the operation is in place,
+        otherwise the result lives in the returned copy."""
+        buf = self._prep(buf)
         self._lib.slu_tree_bcast(
             self._h, int(root),
             buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
         return buf
 
     def reduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
-        """Elementwise sum onto root (root's buf holds the total)."""
-        buf = np.ascontiguousarray(buf, dtype=np.float64)
-        assert buf.size <= self.max_len
+        """Elementwise sum onto root (the RETURNED array holds the total
+        on the root; see bcast for the in-place caveat)."""
+        buf = self._prep(buf)
         self._lib.slu_tree_reduce_sum(
             self._h, int(root),
             buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
