@@ -1,0 +1,70 @@
+"""Shared helpers for the example drivers.
+
+Analog of EXAMPLE/dcreate_matrix.c:66,239: load a matrix (Harwell-Boeing /
+Rutherford-Boeing / MatrixMarket / triples), fabricate a known solution
+xtrue, build b = A·xtrue, and report ‖x−xtrue‖∞ after the solve — the
+reference's examples are self-checking accuracy tests, and so are these.
+
+Every driver accepts an optional matrix-file argument; without one it
+falls back to the reference fixture (if present) or a generated 2-D
+Poisson problem, so the examples always run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+_REF_FIXTURE = "/root/reference/EXAMPLE/g20.rua"
+_REF_FIXTURE_Z = "/root/reference/EXAMPLE/cg20.cua"
+
+
+def pin_cpu_if_requested():
+    """`--backend cpu` anywhere on the CLI pins the CPU backend (must run
+    before any jax use; see superlu_dist_tpu/__main__.py)."""
+    if "--backend" in sys.argv:
+        i = sys.argv.index("--backend")
+        if i + 1 < len(sys.argv) and sys.argv[i + 1] == "cpu":
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            jax.config.update("jax_enable_x64", True)
+
+
+def load_matrix(complex_: bool = False):
+    """Matrix from argv[1] if given, else the reference fixture, else a
+    generated Poisson problem (dcreate_matrix_postfix analog)."""
+    from superlu_dist_tpu.io import read_matrix
+    from superlu_dist_tpu.models.gallery import poisson2d
+
+    args = [a for a in sys.argv[1:] if not a.startswith("--")
+            and a != "cpu"]
+    if args:
+        return read_matrix(args[0]).tocsr(), args[0]
+    fixture = _REF_FIXTURE_Z if complex_ else _REF_FIXTURE
+    if os.path.exists(fixture):
+        return read_matrix(fixture).tocsr(), fixture
+    a = poisson2d(20)
+    if complex_:
+        a = type(a)(a.n_rows, a.n_cols, a.indptr, a.indices,
+                    a.data.astype(np.complex128))
+    return a, "poisson2d(20)"
+
+
+def make_rhs(a, nrhs: int = 1, seed: int = 0):
+    """xtrue + b = A·xtrue (dGenXtrue_dist / dFillRHS_dist analogs)."""
+    from superlu_dist_tpu.utils.precision import gen_xtrue, fill_rhs
+    xtrue = gen_xtrue(a.n_rows, nrhs, dtype=a.data.dtype, seed=seed)
+    return xtrue, fill_rhs(a, xtrue)
+
+
+def report(name, a, b, x, xtrue, stats):
+    from superlu_dist_tpu.utils.precision import inf_norm_error
+    resid = float(np.linalg.norm(np.ravel(b - a.matvec(x)))
+                  / max(float(np.linalg.norm(np.ravel(b))), 1e-300))
+    err = inf_norm_error(x, xtrue)
+    print(f"[{name}] residual ||b-Ax||/||b|| = {resid:.3e}   "
+          f"||x-xtrue||inf/||x||inf = {err:.3e}")
+    stats.print()
+    return resid

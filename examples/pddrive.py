@@ -1,0 +1,34 @@
+#!/usr/bin/env python
+"""Basic expert-driver example — analog of EXAMPLE/pddrive.c:51.
+
+Solve A·x = b once with default options, then verify against the
+fabricated xtrue (the reference example's pdinf_norm_error check,
+pddrive.c:235).
+
+    python examples/pddrive.py [matrix.rua] [--backend cpu]
+"""
+
+import sys
+import os
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+from examples._common import (pin_cpu_if_requested, load_matrix, make_rhs,
+                              report)
+
+
+def main():
+    pin_cpu_if_requested()
+    import superlu_dist_tpu as slu
+
+    a, src = load_matrix()
+    print(f"matrix: {src}  n={a.n_rows} nnz={a.nnz}")
+    xtrue, b = make_rhs(a)
+    x, lu, stats, info = slu.gssvx(slu.Options(), a, b)
+    assert info == 0, f"info={info}"
+    resid = report("pddrive", a, b, x, xtrue, stats)
+    assert resid < 1e-10
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

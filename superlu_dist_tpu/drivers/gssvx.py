@@ -374,10 +374,16 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
         if (jax.default_backend() != "cpu"
                 and op.nnz >= 100_000 and not lu.numeric.on_host):
             # cached per (trans, residual dtype) on the factorization —
-            # the pdgsmv_init / SOLVEstruct discipline (SRC/pdgsmv.c:31)
+            # the pdgsmv_init / SOLVEstruct discipline (SRC/pdgsmv.c:31).
+            # The hit is guarded by data-array identity: FACTORED reuse
+            # with a same-pattern matrix carrying NEW values must not
+            # refine against the stale uploaded operator.  (In-place
+            # mutation of a.data defeats any caching scheme — also true
+            # of the reference's cached SOLVEstruct.)
             key = (trans, str(residual_dtype))
             cache = lu.dev_spmv if lu.dev_spmv is not None else {}
-            ir_op = cache.get(key)
+            hit = cache.get(key)
+            ir_op = hit[1] if hit is not None and hit[0] is op.data else None
             if ir_op is None:
                 try:
                     from superlu_dist_tpu.parallel.dist import DeviceSpMV
@@ -386,7 +392,7 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                         dtype=np.result_type(op.data.dtype, residual_dtype))
                 except Exception:          # x64 off / upload failure —
                     ir_op = op             # host residual stays correct
-                cache[key] = ir_op
+                cache[key] = (op.data, ir_op)
                 lu.dev_spmv = cache
         with stats.timer("REFINE"):
             x, berrs = iterative_refinement(ir_op, b, x, solve_fn,

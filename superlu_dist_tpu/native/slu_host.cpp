@@ -1357,8 +1357,16 @@ void* slu_tree_attach(const char* name, i64 n_ranks, i64 max_len,
                       i64 rank, i64 create) {
   using namespace slu_tree;
   size_t len = seg_size(n_ranks, max_len);
-  int fd = create ? ::shm_open(name, O_CREAT | O_RDWR, 0600)
-                  : ::shm_open(name, O_RDWR, 0600);
+  int fd;
+  if (create) {
+    // a stale segment from a crashed run still carries ready==magic and
+    // old seq/ack values — unlink first and create exclusively, so
+    // attachers genuinely wait for THIS creator's initialization
+    ::shm_unlink(name);
+    fd = ::shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+  } else {
+    fd = ::shm_open(name, O_RDWR, 0600);
+  }
   if (fd < 0) return nullptr;
   if (create) {
     if (::ftruncate(fd, (off_t)len) != 0) {

@@ -125,7 +125,8 @@ class DeviceSpMV:
         self.n_rows, self.n_cols = a.n_rows, a.n_cols
         self._nnz = a.nnz
         dtype = np.dtype(dtype or np.result_type(a.data.dtype, np.float64))
-        if dtype.itemsize >= 8 and not jax.config.read("jax_enable_x64"):
+        real_width = np.dtype(dtype).type(0).real.dtype.itemsize
+        if real_width >= 8 and not jax.config.read("jax_enable_x64"):
             # without x64, jnp silently downcasts f64 -> f32 and the
             # refinement residual loses exactly the digits it exists to
             # recover — refuse, so the caller falls back to the host SpMV
@@ -166,7 +167,8 @@ class DeviceSpMV:
         return self._apply(self._vals, x)
 
     def abs_matvec(self, x: np.ndarray) -> np.ndarray:
-        return self._apply(self._avals, np.abs(x))
+        # |A|·x, NOT |A|·|x| — same contract as SparseCSR.abs_matvec
+        return self._apply(self._avals, x)
 
 
 class ShardedSpMV:

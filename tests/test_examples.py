@@ -1,0 +1,27 @@
+"""The example drivers double as integration tests — the reference's own
+discipline (SURVEY.md §4: EXAMPLE drivers fabricate xtrue and check the
+solve, .travis_tests.sh runs them as CI).  Each must exit 0."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXAMPLES = ["pddrive.py", "pddrive1.py", "pddrive2.py", "pddrive3.py",
+            "pddrive4.py", "pzdrive.py", "pddrive_ABglobal.py"]
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.mark.parametrize("script", EXAMPLES)
+def test_example_runs_clean(script):
+    env = dict(os.environ)
+    # examples run in a fresh interpreter: pin the CPU backend the same
+    # way the conftest does (the session's accelerator plugin would
+    # otherwise grab a tunnel the CI environment may not have)
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", script),
+         "--backend", "cpu"],
+        capture_output=True, timeout=600, env=env, cwd=ROOT)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+    assert b"residual" in r.stdout
