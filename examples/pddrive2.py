@@ -36,8 +36,9 @@ def main():
     assert info2 == 0
     # SamePattern reuses the column ordering; symbolic reruns because the
     # row permutation may have changed (the reference tier's semantics —
-    # only SamePattern_SameRowPerm reuses the symbolic analysis)
-    assert stats2.utime["COLPERM"] < 0.01, "SamePattern must skip colperm"
+    # only SamePattern_SameRowPerm reuses the symbolic analysis).  Check
+    # the invariant itself, not a timing proxy:
+    assert np.array_equal(lu2.col_order, lu.col_order), "col order reused"
     resid = report("pddrive2 (SamePattern)", a2, b2, x2, xtrue2, stats2)
     assert resid < 1e-10
     return 0

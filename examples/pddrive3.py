@@ -33,8 +33,10 @@ def main():
     x2, lu2, stats2, info2 = slu.gssvx(
         slu.Options(fact=slu.Fact.SamePattern_SameRowPerm), a2, b2, lu=lu)
     assert info2 == 0
-    assert stats2.utime["ROWPERM"] < 0.01, "must reuse the row permutation"
-    assert stats2.utime["COLPERM"] < 0.01, "must reuse the column ordering"
+    # the reuse invariant itself, not a timing proxy: both permutations
+    # must be identical objects/values from the first factorization
+    assert np.array_equal(lu2.row_order, lu.row_order), "row perm reused"
+    assert np.array_equal(lu2.col_order, lu.col_order), "col order reused"
     resid = report("pddrive3 (SamePattern_SameRowPerm)", a2, b2, x2,
                    xtrue2, stats2)
     assert resid < 1e-10

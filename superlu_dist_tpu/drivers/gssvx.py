@@ -380,10 +380,12 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
             # refine against the stale uploaded operator.  (In-place
             # mutation of a.data defeats any caching scheme — also true
             # of the reference's cached SOLVEstruct.)
+            # identity-guard on the SOURCE a.data (op is derived from a
+            # deterministically per trans, so transpose solves still hit)
             key = (trans, str(residual_dtype))
             cache = lu.dev_spmv if lu.dev_spmv is not None else {}
             hit = cache.get(key)
-            ir_op = hit[1] if hit is not None and hit[0] is op.data else None
+            ir_op = hit[1] if hit is not None and hit[0] is a.data else None
             if ir_op is None:
                 try:
                     from superlu_dist_tpu.parallel.dist import DeviceSpMV
@@ -392,7 +394,7 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                         dtype=np.result_type(op.data.dtype, residual_dtype))
                 except Exception:          # x64 off / upload failure —
                     ir_op = op             # host residual stays correct
-                cache[key] = (op.data, ir_op)
+                cache[key] = (a.data, ir_op)
                 lu.dev_spmv = cache
         with stats.timer("REFINE"):
             x, berrs = iterative_refinement(ir_op, b, x, solve_fn,

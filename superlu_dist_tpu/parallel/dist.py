@@ -65,6 +65,12 @@ class DistributedCSR:
                                        minlength=self.m_loc))
         return np.bincount(rows, weights=contrib, minlength=self.m_loc)
 
+    def abs_matvec_local(self, x: np.ndarray) -> np.ndarray:
+        """Local rows of |A|·x (the berr denominator in refinement)."""
+        rows = np.repeat(np.arange(self.m_loc), np.diff(self.indptr))
+        contrib = np.abs(self.data) * np.asarray(x)[self.indices]
+        return np.bincount(rows, weights=contrib, minlength=self.m_loc)
+
 
 def distribute_rows(a: SparseCSR, nparts: int) -> list[DistributedCSR]:
     """Block-row partition of A (the dcreate_matrix scatter,

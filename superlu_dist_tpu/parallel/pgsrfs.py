@@ -1,0 +1,79 @@
+"""Multi-process iterative refinement over block-row distributed A.
+
+Capability analog of pdgsrfs + pdgsmv (SRC/pdgsrfs.c:120, pdgsmv.c:234):
+the reference computes the residual r = b − A·x with each rank holding a
+block of rows (NRformat_loc) and exchanging the needed x entries, then
+solves the correction on the distributed factors.  Here each process owns
+a `DistributedCSR` block row; the x exchange that the reference does with
+per-rank index lists becomes one tree all-reduce of the zero-padded
+block vectors (parallel/treecomm.py — the same collective engine the
+reference builds from its Bc/Rd trees), and the correction solve runs on
+the factor-owning root and is tree-broadcast back.
+
+This is the host multi-process tier of the refinement stack; on an
+accelerator the single-process DeviceSpMV path (drivers/gssvx.py) is
+used instead.  Every rank calls `pgsrfs` collectively and receives the
+full refined solution.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from superlu_dist_tpu.parallel.dist import DistributedCSR
+from superlu_dist_tpu.parallel.treecomm import TreeComm
+from superlu_dist_tpu.refine.ir import ITMAX
+
+
+def _pad_full(local: np.ndarray, fst_row: int, n: int) -> np.ndarray:
+    out = np.zeros(n)
+    out[fst_row:fst_row + len(local)] = local
+    return out
+
+
+def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
+           x0: np.ndarray | None, solve_fn, itmax: int = ITMAX,
+           root: int = 0) -> np.ndarray:
+    """Collectively refine A·x = b (single RHS).
+
+    tc       — this rank's TreeComm attachment.
+    a_loc    — this rank's block rows of A (global column indices).
+    b_loc    — this rank's block of b.
+    x0       — initial solution (significant on the root; may be None on
+               the others).
+    solve_fn — correction solver dx = A⁻¹ r; significant on the root only
+               (the factor owner — the reference's analog is that every
+               rank participates in pdgstrs, here the factors live with
+               the root process).
+
+    Returns the full refined x on every rank.
+    """
+    n = a_loc.n
+    eps = float(np.finfo(np.float64).eps)
+
+    # x lives replicated (root broadcasts), like pdgsrfs's x updates
+    x = np.zeros(n) if x0 is None else np.asarray(x0, dtype=np.float64)
+    x = tc.bcast(x, root=root)
+
+    lstres = np.inf
+    for _ in range(itmax):
+        # r = b − A·x, each rank its block rows; assemble by tree
+        # all-reduce of zero-padded blocks (the pdgsmv exchange analog)
+        r_loc = b_loc - a_loc.matvec_local(x)
+        r = tc.allreduce_sum(_pad_full(r_loc, a_loc.fst_row, n), root=root)
+        # componentwise backward error denominator |A|·|x| + |b|
+        den_loc = (a_loc.abs_matvec_local(np.abs(x)) + np.abs(b_loc))
+        den = tc.allreduce_sum(_pad_full(den_loc, a_loc.fst_row, n),
+                               root=root)
+        den = np.where(den > 0, den, 1.0)
+        berr = float(np.max(np.abs(r) / den))
+        if berr <= eps or berr >= lstres / 2.0:
+            break
+        lstres = berr
+        # correction on the factor owner, broadcast to all
+        dx = np.zeros(n)
+        if tc.rank == root:
+            dx = np.asarray(solve_fn(r), dtype=np.float64)
+        dx = tc.bcast(dx, root=root)
+        x = x + dx
+    return x

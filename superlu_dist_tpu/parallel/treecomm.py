@@ -31,6 +31,14 @@ class TreeComm:
     n_ranks and max_len; rank 0 creates the segment.  All ranks must
     reach the collectives in the same order (the usual collective
     contract — the reference's trees are likewise matched per supernode).
+
+    Rendezvous contract: the creator's constructor must COMPLETE before
+    any attacher's starts (spawn workers after constructing the creator,
+    as the tests do).  An attacher racing an in-flight create could bind
+    a stale same-named segment from a crashed earlier run — the creator
+    unlinks and re-creates exclusively, so such an attacher would wait
+    on an orphan.  This happens-before requirement is what MPI_Init
+    provides the reference for free; here it is the caller's.
     """
 
     def __init__(self, name: str, n_ranks: int, rank: int,
