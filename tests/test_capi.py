@@ -32,3 +32,35 @@ def test_c_client_roundtrip(tmp_path):
                          timeout=300)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "PASS" in res.stdout
+
+
+def test_fortran_driver_compiles_and_runs():
+    """f_pddrive.f90 (FORTRAN/f_pddrive + f_5x5 analog) — compiled and
+    executed when a Fortran compiler is available, else skipped (the
+    source-level interface is still exercised via the C API tests)."""
+    import shutil
+    import subprocess
+    import sys
+    import tempfile
+    gfortran = shutil.which("gfortran")
+    if gfortran is None:
+        pytest.skip("no gfortran in this image")
+    from superlu_dist_tpu.bindings.build import build_library
+    lib = build_library()
+    bdir = os.path.dirname(os.path.abspath(lib))
+    src = os.path.join(os.path.dirname(bdir), "bindings")
+    with tempfile.TemporaryDirectory() as td:
+        ldflags = subprocess.run(
+            [sys.executable + "-config", "--embed", "--ldflags"],
+            capture_output=True, text=True).stdout.split()
+        exe = os.path.join(td, "f_pddrive")
+        r = subprocess.run(
+            [gfortran, "-o", exe,
+             os.path.join(src, "superlu_mod.f90"),
+             os.path.join(src, "f_pddrive.f90"),
+             f"-L{bdir}", "-lslu_tpu", f"-Wl,-rpath,{bdir}"] + ldflags,
+            capture_output=True, cwd=td)
+        assert r.returncode == 0, r.stderr.decode()
+        out = subprocess.run([exe], capture_output=True, timeout=300)
+        assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
+        assert b"PASS" in out.stdout
