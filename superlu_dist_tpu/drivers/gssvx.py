@@ -280,7 +280,8 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         numeric = numeric_factorize(plan, bvals, anorm, dtype=dtype,
                                     replace_tiny=options.replace_tiny_pivot,
                                     mesh=grid.mesh if grid is not None
-                                    else None)
+                                    else None,
+                                    pool_partition=options.pool_partition)
         for lp, up in numeric.fronts:
             if hasattr(lp, "block_until_ready"):
                 lp.block_until_ready()

@@ -83,6 +83,18 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     return (lpanel, upanel), pool, tiny
 
 
+def pool_spec(mesh, pool_partition: bool):
+    """The Schur pool's sharding: replicated, or 1-D over ALL mesh devices
+    (pool_partition — per-chip pool memory divides by the device count).
+    Single definition shared by both executors; returns None without a
+    mesh."""
+    if mesh is None:
+        return None
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    return NamedSharding(
+        mesh, P(tuple(mesh.axis_names)) if pool_partition else P(None))
+
+
 def _group_arrays(grp):
     children = [(cs.ub, jnp.asarray(cs.child_off), jnp.asarray(cs.child_slot),
                  jnp.asarray(cs.rel)) for cs in grp.children]
@@ -127,7 +139,8 @@ class NumericFactorization:
         return self.host_fronts
 
 
-def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
+def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
+                   pool_partition: bool = False):
     """Build the whole numeric factorization as ONE jittable function.
 
     Returns fn(avals, thresh) -> (fronts_tuple, tiny_count).  The plan's
@@ -138,6 +151,14 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
     §2.4) — while every irregular scatter/gather is pinned replicated
     (XLA's SPMD partitioner miscompiles scatter/gather with sharded minor
     dims, jax 0.9.0; they are bandwidth-trivial next to the GEMMs).
+
+    pool_partition=True shards the Schur update pool itself across ALL
+    mesh devices (1-D, so the partitioner handles it — verified equal to
+    the replicated result on a virtual mesh).  This divides the pool's
+    HBM footprint by the device count — the path to the n≈1M problem
+    class, whose ~27 GB pool exceeds one chip (the reference's analog:
+    no rank holds the whole factor, SURVEY.md §5 scaling) — at the cost
+    of extra collectives per extend-add.
     """
     dtype = jnp.dtype(dtype)
     sharding = pivot_sharding = replicated = None
@@ -145,7 +166,7 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
         from jax.sharding import NamedSharding, PartitionSpec as P
         sharding = NamedSharding(mesh, P("snode", None, "panel"))
         pivot_sharding = NamedSharding(mesh, P("snode", None, None))
-        pool_sharding = NamedSharding(mesh, P(None))
+        pool_sharding = pool_spec(mesh, pool_partition)
         replicated = NamedSharding(mesh, P(None, None))
     arrays = [_group_arrays(grp) for grp in plan.groups]
 
@@ -173,28 +194,31 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None):
 
 
 def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
-                 mesh=None):
+                 mesh=None, pool_partition: bool = False):
     """Executor for a plan, cached on the plan (SamePattern reuse tier).
 
     executor: "fused" (one XLA program — fast dispatch, compile grows with
     plan size), "stream" (per-bucket kernels — compile count is bounded,
     right for real TPU where program compile is expensive), or "auto"
     (stream on accelerators, fused on CPU).  mesh shards either executor
-    over ("snode", "panel").
+    over ("snode", "panel"); pool_partition shards the Schur pool across
+    all mesh devices (see make_factor_fn).
     """
     if executor == "auto":
         executor = "fused" if jax.default_backend() == "cpu" else "stream"
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
-    key = (str(jnp.dtype(dtype)), executor, mesh)
+    key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition))
     fn = cache.get(key)
     if fn is None:
         if executor == "stream":
             from superlu_dist_tpu.numeric.stream import StreamExecutor
-            fn = StreamExecutor(plan, dtype, mesh=mesh)
+            fn = StreamExecutor(plan, dtype, mesh=mesh,
+                                pool_partition=pool_partition)
         else:
-            fn = make_factor_fn(plan, dtype, mesh=mesh)
+            fn = make_factor_fn(plan, dtype, mesh=mesh,
+                                pool_partition=pool_partition)
         cache[key] = fn
     return fn
 
@@ -203,7 +227,8 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       anorm: float, dtype="float64",
                       replace_tiny: bool = True,
                       executor: str = "auto",
-                      mesh=None) -> NumericFactorization:
+                      mesh=None,
+                      pool_partition: bool = False) -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
@@ -220,7 +245,8 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)
     avals = jnp.asarray(pattern_values, dtype=dtype)
-    fn = get_executor(plan, dtype, executor, mesh=mesh)
+    fn = get_executor(plan, dtype, executor, mesh=mesh,
+                      pool_partition=pool_partition)
     fronts_out, tiny_total = fn(avals, thresh)
     fronts_out = list(fronts_out)
     finite = True

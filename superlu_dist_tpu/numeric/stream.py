@@ -45,7 +45,8 @@ def _pad_to(arr: np.ndarray, length: int, fill) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh=None):
+def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh=None,
+            pool_partition=False):
     """Jitted group step for one shape key (optionally mesh-sharded).
 
     With a mesh, the dense factor math shards batch-over-"snode" and
@@ -53,24 +54,33 @@ def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh=None):
     the irregular gathers/scatters stay replicated (see factor.py notes on
     the SPMD partitioner).  This is the VERDICT-r1 gap #3: the real-TPU
     executor must be shardable where the fused whole-program jit won't
-    compile.
+    compile.  pool_partition shards the 1-D Schur pool across all mesh
+    devices (see make_factor_fn) — per-chip pool memory divides by the
+    device count.
     """
-    front_sharding = pivot_sharding = replicated = None
+    front_sharding = pivot_sharding = replicated = pool_sharding = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
+        from superlu_dist_tpu.numeric.factor import pool_spec
         front_sharding = NamedSharding(mesh, P("snode", None, "panel"))
         pivot_sharding = NamedSharding(mesh, P("snode", None, None))
         replicated = NamedSharding(mesh, P(None, None))
+        pool_sharding = pool_spec(mesh, pool_partition)
 
     def step(avals, pool, thresh, a_slot, a_flat, a_src, ws, off, *child_arr):
+        if pool_sharding is not None:
+            pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
         children = [(ub, child_arr[3 * i], child_arr[3 * i + 1],
                      child_arr[3 * i + 2])
                     for i, (ub, _) in enumerate(child_shapes)]
-        return group_step(dims, avals, pool, thresh,
-                          a_slot, a_flat, a_src, ws, off, children,
-                          front_sharding=front_sharding,
-                          pivot_sharding=pivot_sharding,
-                          replicated=replicated)
+        out, pool, tiny = group_step(dims, avals, pool, thresh,
+                                     a_slot, a_flat, a_src, ws, off, children,
+                                     front_sharding=front_sharding,
+                                     pivot_sharding=pivot_sharding,
+                                     replicated=replicated)
+        if pool_sharding is not None:
+            pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
+        return out, pool, tiny
 
     # pool is threaded linearly through the group stream — donating it lets
     # XLA scatter in place instead of copying pool_size entries per group
@@ -84,7 +94,7 @@ class StreamExecutor:
     """
 
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
-                 offload: str = "auto"):
+                 offload: str = "auto", pool_partition: bool = False):
         """offload: "none" keeps every factored panel on the device;
         "host" streams each group's (lpanel, upanel) to host memory as
         soon as it is produced (copy_to_host_async overlaps the next
@@ -101,6 +111,7 @@ class StreamExecutor:
         self.plan = plan
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
+        self.pool_partition = bool(pool_partition and mesh is not None)
         if offload == "auto":
             limit = float(os.environ.get("SLU_TPU_FRONT_BYTES_LIMIT", 6e9))
             itemsize = jnp.dtype(dtype).itemsize
@@ -147,8 +158,10 @@ class StreamExecutor:
         avals = jnp.asarray(avals, dtype=self.dtype)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
+            from superlu_dist_tpu.numeric.factor import pool_spec
             rep = NamedSharding(self.mesh, P(None))
-            pool = jax.device_put(pool, rep)
+            pool = jax.device_put(pool,
+                                  pool_spec(self.mesh, self.pool_partition))
             avals = jax.device_put(avals, rep)
         # kernel-shape trace (the reference's PROFlevel GEMM trace,
         # pdgstrf.c:380-387 -> dgemm_mnk.dat): per-group synchronous timing.
@@ -162,7 +175,7 @@ class StreamExecutor:
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
         for gi, (key, a, child_arrs, nreal) in enumerate(self._steps):
-            kern = _kernel(*key, self.mesh)
+            kern = _kernel(*key, self.mesh, self.pool_partition)
             if profile:
                 t0 = time.perf_counter()
             (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)

@@ -119,6 +119,10 @@ class Options:
                                       # size buckets (static-shape batching)
     min_bucket: int = dataclasses.field(   # smallest padded front dimension
         default_factory=lambda: _env_int("SLU_TPU_MIN_BUCKET", 8))
+    # shard the Schur update pool across ALL mesh devices (the n≈1M
+    # memory path; only meaningful with a grid) — SLU_TPU_POOL_PARTITION=1
+    pool_partition: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SLU_TPU_POOL_PARTITION", 0)))
     # user-supplied permutations for MY_PERMC / MY_PERMR (real dataclass
     # fields so Options(user_perm_c=...) works — the reference reads these
     # from ScalePermstruct->perm_c/perm_r when ColPerm/RowPerm say MY_*).

@@ -34,33 +34,32 @@ def test_c_client_roundtrip(tmp_path):
     assert "PASS" in res.stdout
 
 
-def test_fortran_driver_compiles_and_runs():
+def test_fortran_driver_compiles_and_runs(tmp_path):
     """f_pddrive.f90 (FORTRAN/f_pddrive + f_5x5 analog) — compiled and
     executed when a Fortran compiler is available, else skipped (the
-    source-level interface is still exercised via the C API tests)."""
+    source-level interface is still exercised via the C API tests).
+    Same link/run recipe as test_c_client_roundtrip above."""
     import shutil
-    import subprocess
-    import sys
-    import tempfile
     gfortran = shutil.which("gfortran")
     if gfortran is None:
         pytest.skip("no gfortran in this image")
-    from superlu_dist_tpu.bindings.build import build_library
-    lib = build_library()
-    bdir = os.path.dirname(os.path.abspath(lib))
-    src = os.path.join(os.path.dirname(bdir), "bindings")
-    with tempfile.TemporaryDirectory() as td:
-        ldflags = subprocess.run(
-            [sys.executable + "-config", "--embed", "--ldflags"],
-            capture_output=True, text=True).stdout.split()
-        exe = os.path.join(td, "f_pddrive")
-        r = subprocess.run(
-            [gfortran, "-o", exe,
-             os.path.join(src, "superlu_mod.f90"),
-             os.path.join(src, "f_pddrive.f90"),
-             f"-L{bdir}", "-lslu_tpu", f"-Wl,-rpath,{bdir}"] + ldflags,
-            capture_output=True, cwd=td)
-        assert r.returncode == 0, r.stderr.decode()
-        out = subprocess.run([exe], capture_output=True, timeout=300)
-        assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
-        assert b"PASS" in out.stdout
+    from superlu_dist_tpu.bindings.build import build
+    lib = build()
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    exe = str(tmp_path / "f_pddrive")
+    r = subprocess.run(
+        [gfortran, "-o", exe,
+         os.path.join(BINDINGS, "superlu_mod.f90"),
+         os.path.join(BINDINGS, "f_pddrive.f90"), lib,
+         f"-L{libdir}", f"-l{pyver}", "-lm", "-ldl",
+         f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{os.path.abspath(BINDINGS)}",
+         "-J", str(tmp_path)],
+        capture_output=True, cwd=str(tmp_path))
+    assert r.returncode == 0, r.stderr.decode()
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.abspath(os.path.join(HERE, ".."))
+                         + os.pathsep + env.get("PYTHONPATH", ""))
+    out = subprocess.run([exe], capture_output=True, timeout=300, env=env)
+    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
+    assert b"PASS" in out.stdout
