@@ -32,59 +32,67 @@ def _bucket_nrhs(k: int) -> int:
     return 1 if k == 1 else 1 << int(np.ceil(np.log2(k)))
 
 
-@functools.lru_cache(maxsize=None)
-def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
+def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv):
     """x[cols] <- L11⁻¹(x[cols] − lsum[cols]); lsum[rows] += L21·x[cols].
 
     With use_inv, L11⁻¹ arrives precomputed and the triangular solve
     becomes one batched GEMM (the reference's DiagInv fast path,
     pdgstrs.c:1252-1396: dense X(k) = Linv(k)·b via dgemm)."""
+    k = jnp.arange(w)
+    # padded pivot columns (k >= ws) would alias the NEXT supernode's
+    # entries — clamp them to the dump row n-1 (factor cols/rows there
+    # are exactly identity/zero, so the garbage never reaches real x)
+    cols = jnp.where(k[None, :] < ws[:, None],
+                     first[:, None] + k, n - 1)      # (B, w)
+    rhs = (x.at[cols].get(mode="fill", fill_value=0)
+           - lsum.at[cols].get(mode="fill", fill_value=0))
+    if use_inv:
+        y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
+    else:
+        l11 = lpanel[:, :w, :w]
+        y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
+            l, b, lower=True, unit_diagonal=True))(l11, rhs)
+    x = x.at[cols].set(y, mode="drop")
+    if u:
+        contrib = jnp.matmul(lpanel[:, w:, :], y,
+                             precision=jax.lax.Precision.HIGHEST)
+        lsum = lsum.at[rows].add(contrib, mode="drop")
+    return x, lsum
 
+
+def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv):
+    """x[cols] <- U11⁻¹(x[cols] − U12·x[rows])."""
+    k = jnp.arange(w)
+    cols = jnp.where(k[None, :] < ws[:, None],
+                     first[:, None] + k, n - 1)
+    rhs = x.at[cols].get(mode="fill", fill_value=0)
+    if u:
+        xr = x.at[rows].get(mode="fill", fill_value=0)   # (B, u, nrhs)
+        rhs = rhs - jnp.matmul(upanel, xr,
+                               precision=jax.lax.Precision.HIGHEST)
+    if use_inv:
+        y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
+    else:
+        u11 = lpanel[:, :w, :w]
+        y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
+            r, b, lower=False))(u11, rhs)
+    return x.at[cols].set(y, mode="drop")
+
+
+@functools.lru_cache(maxsize=None)
+def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
     def step(lpanel, x, lsum, first, rows, ws, linv=None):
-        k = jnp.arange(w)
-        # padded pivot columns (k >= ws) would alias the NEXT supernode's
-        # entries — clamp them to the dump row n-1 (factor cols/rows there
-        # are exactly identity/zero, so the garbage never reaches real x)
-        cols = jnp.where(k[None, :] < ws[:, None],
-                         first[:, None] + k, n - 1)      # (B, w)
-        rhs = (x.at[cols].get(mode="fill", fill_value=0)
-               - lsum.at[cols].get(mode="fill", fill_value=0))
-        if use_inv:
-            y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
-        else:
-            l11 = lpanel[:, :w, :w]
-            y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
-                l, b, lower=True, unit_diagonal=True))(l11, rhs)
-        x = x.at[cols].set(y, mode="drop")
-        if u:
-            contrib = jnp.matmul(lpanel[:, w:, :], y,
-                                 precision=jax.lax.Precision.HIGHEST)
-            lsum = lsum.at[rows].add(contrib, mode="drop")
-        return x, lsum
+        return _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n,
+                         use_inv, linv)
 
     return jax.jit(step, donate_argnums=(1, 2))
 
 
 @functools.lru_cache(maxsize=None)
 def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
-    """x[cols] <- U11⁻¹(x[cols] − U12·x[rows])."""
-
     def step(lpanel, upanel, x, first, rows, ws, uinv=None):
-        k = jnp.arange(w)
-        cols = jnp.where(k[None, :] < ws[:, None],
-                         first[:, None] + k, n - 1)
-        rhs = x.at[cols].get(mode="fill", fill_value=0)
-        if u:
-            xr = x.at[rows].get(mode="fill", fill_value=0)   # (B, u, nrhs)
-            rhs = rhs - jnp.matmul(upanel, xr,
-                                   precision=jax.lax.Precision.HIGHEST)
-        if use_inv:
-            y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
-        else:
-            u11 = lpanel[:, :w, :w]
-            y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
-                r, b, lower=False))(u11, rhs)
-        return x.at[cols].set(y, mode="drop")
+        return _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n,
+                         use_inv, uinv)
 
     return jax.jit(step, donate_argnums=(2,))
 
@@ -112,11 +120,25 @@ class DeviceSolver:
     The dSOLVEstruct_t analog (superlu_ddefs.h:216-228): per-group index
     maps are built once and reused across repeated solves (the reference
     caches them behind SolveInitialized, pdgssvx.c:1330-1337).
+
+    fused=True traces each whole sweep (all levels) into ONE jitted XLA
+    program per nrhs bucket — one dispatch for the forward solve and one
+    for the backward instead of one per (level, bucket) group.  The solve
+    is latency-bound (tiny per-level GEMVs — SURVEY.md §7 hard-part 5:
+    "tree-based trisolve is tiny-message dominated"), so collapsing the
+    dispatch chain is the device analog of the reference's fully
+    pipelined event loop.  Compile cost grows with the plan, so "auto"
+    fuses only moderate plans.
     """
 
-    def __init__(self, fact: NumericFactorization, diag_inv: bool = False):
+    def __init__(self, fact: NumericFactorization, diag_inv: bool = False,
+                 fused: str | bool = "auto"):
         self.fact = fact
         self.diag_inv = diag_inv
+        if fused == "auto":
+            fused = len(fact.plan.groups) <= 256
+        self.fused = bool(fused)
+        self._fused_cache = {}
         plan = fact.plan
         sf = plan.sf
         self.n = plan.n
@@ -137,6 +159,37 @@ class DeviceSolver:
             else:
                 self._invs.append((None, None))
 
+    def _fused_fns(self, kb):
+        """One jitted program per sweep (all levels) for this nrhs bucket.
+        (jit re-traces on shape/dtype changes anyway; the kb key just
+        avoids rebuilding the Python closures.)"""
+        fns = self._fused_cache.get(kb)
+        if fns is not None:
+            return fns
+        n1 = self.n + 1
+        use_inv = self.diag_inv
+        meta = [(grp.w, grp.u) for grp, _, _, _ in self._groups]
+
+        def fwd(x, lsum, fronts, idx, invs):
+            for (w, u), (lp, _), (firsts, rows, ws), (linv, _) in zip(
+                    meta, fronts, idx, invs):
+                x, lsum = _fwd_body(lp, x, lsum, firsts, rows, ws, w, u,
+                                    n1, use_inv, linv)
+            return x, lsum
+
+        def bwd(x, fronts, idx, invs):
+            for (w, u), (lp, up), (firsts, rows, ws), (_, uinv) in zip(
+                    reversed(meta), reversed(fronts), reversed(idx),
+                    reversed(invs)):
+                x = _bwd_body(lp, up, x, firsts, rows, ws, w, u, n1,
+                              use_inv, uinv)
+            return x
+
+        fns = (jax.jit(fwd, donate_argnums=(0, 1)),
+               jax.jit(bwd, donate_argnums=(0,)))
+        self._fused_cache[kb] = fns
+        return fns
+
     def solve(self, rhs: np.ndarray) -> np.ndarray:
         """rhs (n,) or (n, k) in permuted labeling -> solution, same shape."""
         fact = self.fact
@@ -151,21 +204,28 @@ class DeviceSolver:
         lsum = jnp.zeros_like(x)
         n1 = self.n + 1
         use_inv = self.diag_inv
-        # forward, levels ascending (groups are in level order)
-        for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
-                self._groups, fact.fronts, self._invs):
-            kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                               str(dt), use_inv)
-            x, lsum = (kern(lp, x, lsum, firsts, rows, ws, linv)
-                       if use_inv else
-                       kern(lp, x, lsum, firsts, rows, ws))
-        # backward, levels descending
-        for (grp, firsts, rows, ws), (lp, up), (_, uinv) in zip(
-                reversed(self._groups), reversed(fact.fronts),
-                reversed(self._invs)):
-            kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                               str(dt), use_inv)
-            x = (kern(lp, up, x, firsts, rows, ws, uinv) if use_inv
-                 else kern(lp, up, x, firsts, rows, ws))
+        if self.fused:
+            fwd, bwd = self._fused_fns(kb)
+            idx = [(firsts, rows, ws)
+                   for _, firsts, rows, ws in self._groups]
+            x, lsum = fwd(x, lsum, fact.fronts, idx, self._invs)
+            x = bwd(x, fact.fronts, idx, self._invs)
+        else:
+            # forward, levels ascending (groups are in level order)
+            for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
+                    self._groups, fact.fronts, self._invs):
+                kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
+                                   str(dt), use_inv)
+                x, lsum = (kern(lp, x, lsum, firsts, rows, ws, linv)
+                           if use_inv else
+                           kern(lp, x, lsum, firsts, rows, ws))
+            # backward, levels descending
+            for (grp, firsts, rows, ws), (lp, up), (_, uinv) in zip(
+                    reversed(self._groups), reversed(fact.fronts),
+                    reversed(self._invs)):
+                kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
+                                   str(dt), use_inv)
+                x = (kern(lp, up, x, firsts, rows, ws, uinv) if use_inv
+                     else kern(lp, up, x, firsts, rows, ws))
         out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
         return out[:, 0] if squeeze else out

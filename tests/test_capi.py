@@ -12,19 +12,19 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 BINDINGS = os.path.join(HERE, "..", "superlu_dist_tpu", "bindings")
 
 
-@pytest.mark.skipif(not os.path.exists("/usr/bin/gcc"), reason="no gcc")
-def test_c_client_roundtrip(tmp_path):
-    from superlu_dist_tpu.bindings.build import build
-    lib = build()
-    exe = str(tmp_path / "test_capi")
+def _embed_link_flags(lib):
+    """Shared link recipe for clients embedding the runtime: the built
+    libslu_tpu.so plus the python-embed libraries and rpaths."""
     libdir = sysconfig.get_config_var("LIBDIR")
     pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
-    subprocess.run(
-        ["gcc", "-O2", os.path.join(BINDINGS, "test_capi.c"),
-         "-I", BINDINGS, "-o", exe, lib,
-         f"-L{libdir}", f"-l{pyver}", "-lm", "-ldl",
-         f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{os.path.abspath(BINDINGS)}"],
-        check=True, capture_output=True)
+    return [lib, f"-L{libdir}", f"-l{pyver}", "-lm", "-ldl",
+            f"-Wl,-rpath,{libdir}",
+            f"-Wl,-rpath,{os.path.abspath(BINDINGS)}"]
+
+
+def _run_client(exe):
+    """Run a compiled binding client with the repo importable by the
+    embedded interpreter; assert it PASSes."""
     env = dict(os.environ)
     env["PYTHONPATH"] = (os.path.abspath(os.path.join(HERE, ".."))
                          + os.pathsep + env.get("PYTHONPATH", ""))
@@ -32,6 +32,18 @@ def test_c_client_roundtrip(tmp_path):
                          timeout=300)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "PASS" in res.stdout
+
+
+@pytest.mark.skipif(not os.path.exists("/usr/bin/gcc"), reason="no gcc")
+def test_c_client_roundtrip(tmp_path):
+    from superlu_dist_tpu.bindings.build import build
+    lib = build()
+    exe = str(tmp_path / "test_capi")
+    subprocess.run(
+        ["gcc", "-O2", os.path.join(BINDINGS, "test_capi.c"),
+         "-I", BINDINGS, "-o", exe] + _embed_link_flags(lib),
+        check=True, capture_output=True)
+    _run_client(exe)
 
 
 def test_fortran_driver_compiles_and_runs(tmp_path):
@@ -45,21 +57,12 @@ def test_fortran_driver_compiles_and_runs(tmp_path):
         pytest.skip("no gfortran in this image")
     from superlu_dist_tpu.bindings.build import build
     lib = build()
-    libdir = sysconfig.get_config_var("LIBDIR")
-    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
     exe = str(tmp_path / "f_pddrive")
     r = subprocess.run(
         [gfortran, "-o", exe,
          os.path.join(BINDINGS, "superlu_mod.f90"),
-         os.path.join(BINDINGS, "f_pddrive.f90"), lib,
-         f"-L{libdir}", f"-l{pyver}", "-lm", "-ldl",
-         f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{os.path.abspath(BINDINGS)}",
-         "-J", str(tmp_path)],
+         os.path.join(BINDINGS, "f_pddrive.f90"),
+         "-J", str(tmp_path)] + _embed_link_flags(lib),
         capture_output=True, cwd=str(tmp_path))
     assert r.returncode == 0, r.stderr.decode()
-    env = dict(os.environ)
-    env["PYTHONPATH"] = (os.path.abspath(os.path.join(HERE, ".."))
-                         + os.pathsep + env.get("PYTHONPATH", ""))
-    out = subprocess.run([exe], capture_output=True, timeout=300, env=env)
-    assert out.returncode == 0, out.stdout.decode() + out.stderr.decode()
-    assert b"PASS" in out.stdout
+    _run_client(exe)
