@@ -120,12 +120,22 @@ class LUFactorization:
         C = self.C[:, None] if b.ndim > 1 else self.C
         R = self.R[:, None] if b.ndim > 1 else self.R
         d = (b * C)[self.sf.perm]
-        w_hat = lu_solve_trans(self.numeric, d, conj=conj)
+        w_hat = self._solve_permuted_trans(d, conj)
         w = np.empty_like(w_hat)
         w[self.sigma] = w_hat
         return w * R
 
-    def _solve_permuted(self, d: np.ndarray) -> np.ndarray:
+    def _solve_permuted_trans(self, d: np.ndarray, conj: bool) -> np.ndarray:
+        return self._dispatch_solve(
+            lambda s: s.solve_trans(d, conj=conj),
+            lambda: lu_solve_trans(self.numeric, d, conj=conj))
+
+    def _dispatch_solve(self, device_call, host_call):
+        """Shared device-vs-host solve dispatch with the auto-fallback
+        discipline (one copy — the plain and transpose paths must never
+        drift)."""
+        import warnings
+
         import jax
         use_device = (self.solve_path == "device"
                       or (self.solve_path == "auto"
@@ -140,20 +150,22 @@ class LUFactorization:
                     from superlu_dist_tpu.solve.device import DeviceSolver
                     self.dev_solver = DeviceSolver(
                         self.numeric, diag_inv=self.options.diag_inv)
-                return self.dev_solver.solve(d)
+                return device_call(self.dev_solver)
             except Exception as e:
                 if self.solve_path != "auto":
                     raise
                 # device path failed — permanently fall back to the host
-                # solve for this factorization rather than crash the run,
-                # but leave a diagnosable trace (reason + warning)
-                import warnings
+                # solve for this factorization rather than crash the run
                 self.solve_path = "host"
                 self.solve_fallback_reason = f"{type(e).__name__}: {e}"
-                warnings.warn("device triangular solve failed; falling back "
-                              f"to host solve ({self.solve_fallback_reason})",
-                              RuntimeWarning, stacklevel=2)
-        return lu_solve(self.numeric, d)
+                warnings.warn("device solve failed; falling back to host "
+                              f"solve ({self.solve_fallback_reason})",
+                              RuntimeWarning, stacklevel=3)
+        return host_call()
+
+    def _solve_permuted(self, d: np.ndarray) -> np.ndarray:
+        return self._dispatch_solve(lambda s: s.solve(d),
+                                    lambda: lu_solve(self.numeric, d))
 
 
 def gssvx(options: Options, a: SparseCSR, b: np.ndarray,

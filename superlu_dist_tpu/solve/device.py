@@ -79,6 +79,51 @@ def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv):
     return x.at[cols].set(y, mode="drop")
 
 
+def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
+                    conj):
+    """Transpose forward sweep: x[cols] <- U11⁻ᵀ(x[cols] − lsum[cols]);
+    lsum[rows] += U12ᵀ·x[cols].  Mᵀ = UᵀLᵀ, so Uᵀ (lower) leads — the
+    trans_t path through the same factors (superlu_defs.h:628-657)."""
+    k = jnp.arange(w)
+    cols = jnp.where(k[None, :] < ws[:, None],
+                     first[:, None] + k, n - 1)
+    rhs = (x.at[cols].get(mode="fill", fill_value=0)
+           - lsum.at[cols].get(mode="fill", fill_value=0))
+    u11 = lpanel[:, :w, :w]
+    if conj:
+        u11 = u11.conj()
+    y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
+        r, b, trans=1, lower=False))(u11, rhs)
+    x = x.at[cols].set(y, mode="drop")
+    if u:
+        u12 = upanel.conj() if conj else upanel       # (B, w, u)
+        contrib = jnp.matmul(jnp.swapaxes(u12, 1, 2), y,
+                             precision=jax.lax.Precision.HIGHEST)
+        lsum = lsum.at[rows].add(contrib, mode="drop")
+    return x, lsum
+
+
+def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj):
+    """Transpose backward sweep: x[cols] <- L11⁻ᵀ(x[cols] − L21ᵀ·x[rows])."""
+    k = jnp.arange(w)
+    cols = jnp.where(k[None, :] < ws[:, None],
+                     first[:, None] + k, n - 1)
+    rhs = x.at[cols].get(mode="fill", fill_value=0)
+    if u:
+        xr = x.at[rows].get(mode="fill", fill_value=0)
+        l21 = lpanel[:, w:, :]                         # (B, u_pad, w)
+        if conj:
+            l21 = l21.conj()
+        rhs = rhs - jnp.matmul(jnp.swapaxes(l21, 1, 2), xr,
+                               precision=jax.lax.Precision.HIGHEST)
+    l11 = lpanel[:, :w, :w]
+    if conj:
+        l11 = l11.conj()
+    y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
+        l, b, trans=1, lower=True, unit_diagonal=True))(l11, rhs)
+    return x.at[cols].set(y, mode="drop")
+
+
 @functools.lru_cache(maxsize=None)
 def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
     def step(lpanel, x, lsum, first, rows, ws, linv=None):
@@ -95,6 +140,23 @@ def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
                          use_inv, uinv)
 
     return jax.jit(step, donate_argnums=(2,))
+
+
+@functools.lru_cache(maxsize=None)
+def _fwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False):
+    def step(lpanel, upanel, x, lsum, first, rows, ws):
+        return _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws,
+                               w, u, n, conj)
+
+    return jax.jit(step, donate_argnums=(2, 3))
+
+
+@functools.lru_cache(maxsize=None)
+def _bwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False):
+    def step(lpanel, x, first, rows, ws):
+        return _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj)
+
+    return jax.jit(step, donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
@@ -144,7 +206,7 @@ class DeviceSolver:
         self.n = plan.n
         first = sf.sn_start[:-1]
         self._groups = []
-        self._invs = []
+        self._invs_cached = None
         for grp, (lp, up) in zip(plan.groups, fact.fronts):
             firsts = jnp.asarray(first[grp.sns])
             rows = np.full((grp.batch, grp.u), self.n, dtype=np.int64)
@@ -153,11 +215,23 @@ class DeviceSolver:
                 rows[slot, :len(r)] = r
             self._groups.append((grp, firsts, jnp.asarray(rows),
                                  jnp.asarray(grp.ws)))
-            if diag_inv:
-                kern = _diag_inv_kernel(grp.w, str(jnp.dtype(fact.dtype)))
-                self._invs.append(kern(jnp.asarray(lp)))
+
+    @property
+    def _invs(self):
+        """Batched diagonal-block inverses (DiagInv), computed lazily on
+        the first NON-transpose solve — transpose sweeps never read them,
+        so a trans-only solver must not pay the inversion compiles or
+        pin the inverse buffers in HBM."""
+        if self._invs_cached is None:
+            if self.diag_inv:
+                self._invs_cached = [
+                    _diag_inv_kernel(grp.w, str(jnp.dtype(self.fact.dtype)))(
+                        jnp.asarray(lp))
+                    for (grp, _, _, _), (lp, _) in zip(self._groups,
+                                                       self.fact.fronts)]
             else:
-                self._invs.append((None, None))
+                self._invs_cached = [(None, None)] * len(self._groups)
+        return self._invs_cached
 
     def _fused_fns(self, kb):
         """One jitted program per sweep (all levels) for this nrhs bucket.
@@ -189,6 +263,71 @@ class DeviceSolver:
                jax.jit(bwd, donate_argnums=(0,)))
         self._fused_cache[kb] = fns
         return fns
+
+    def _fused_trans_fns(self, kb, conj):
+        fns = self._fused_cache.get(("T", kb, conj))
+        if fns is not None:
+            return fns
+        n1 = self.n + 1
+        meta = [(grp.w, grp.u) for grp, _, _, _ in self._groups]
+
+        def fwd(x, lsum, fronts, idx):
+            for (w, u), (lp, up), (firsts, rows, ws) in zip(
+                    meta, fronts, idx):
+                x, lsum = _fwd_body_trans(lp, up, x, lsum, firsts, rows,
+                                          ws, w, u, n1, conj)
+            return x, lsum
+
+        def bwd(x, fronts, idx):
+            for (w, u), (lp, _), (firsts, rows, ws) in zip(
+                    reversed(meta), reversed(fronts), reversed(idx)):
+                x = _bwd_body_trans(lp, x, firsts, rows, ws, w, u, n1,
+                                    conj)
+            return x
+
+        fns = (jax.jit(fwd, donate_argnums=(0, 1)),
+               jax.jit(bwd, donate_argnums=(0,)))
+        self._fused_cache[("T", kb, conj)] = fns
+        return fns
+
+    def solve_trans(self, rhs: np.ndarray, conj: bool = False) -> np.ndarray:
+        """Solve (L·U)ᵀ x = rhs (or (L·U)ᴴ with conj) on the device —
+        Mᵀ = Uᵀ·Lᵀ through the same factors (the reference's trans_t,
+        superlu_defs.h:628-657; host twin: trisolve.lu_solve_trans).
+        Respects the same fused/streamed guard as solve()."""
+        fact = self.fact
+        squeeze = rhs.ndim == 1
+        r2 = rhs[:, None] if squeeze else rhs
+        k = r2.shape[1]
+        kb = _bucket_nrhs(k)
+        dt = jnp.dtype(fact.dtype)
+        pad = np.zeros((self.n + 1, kb), dtype=dt)
+        pad[:self.n, :k] = r2
+        x = jnp.asarray(pad)
+        lsum = jnp.zeros_like(x)
+        n1 = self.n + 1
+        conj = bool(conj)
+        if self.fused:
+            fwd, bwd = self._fused_trans_fns(kb, conj)
+            idx = [(firsts, rows, ws)
+                   for _, firsts, rows, ws in self._groups]
+            x, lsum = fwd(x, lsum, fact.fronts, idx)
+            x = bwd(x, fact.fronts, idx)
+        else:
+            # Uᵀ forward, levels ascending
+            for (grp, firsts, rows, ws), (lp, up) in zip(
+                    self._groups, fact.fronts):
+                kern = _fwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
+                                         kb, n1, str(dt), conj)
+                x, lsum = kern(lp, up, x, lsum, firsts, rows, ws)
+            # Lᵀ backward, levels descending
+            for (grp, firsts, rows, ws), (lp, up) in zip(
+                    reversed(self._groups), reversed(fact.fronts)):
+                kern = _bwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
+                                         kb, n1, str(dt), conj)
+                x = kern(lp, x, firsts, rows, ws)
+        out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
+        return out[:, 0] if squeeze else out
 
     def solve(self, rhs: np.ndarray) -> np.ndarray:
         """rhs (n,) or (n, k) in permuted labeling -> solution, same shape."""
