@@ -137,7 +137,7 @@ def run_once(a, args) -> int:
 def run_sweep(a, args) -> int:
     """pdtest analog: cross Fact tiers x nrhs x equil; count failures."""
     import superlu_dist_tpu as slu
-    from superlu_dist_tpu.utils.options import Fact
+    from superlu_dist_tpu.utils.options import ColPerm, Fact, Trans
 
     n_pass = n_fail = 0
     rows = []
@@ -146,7 +146,11 @@ def run_sweep(a, args) -> int:
             lu = None
             for fact in (Fact.DOFACT, Fact.SamePattern,
                          Fact.SamePattern_SameRowPerm, Fact.FACTORED):
-                opts = _options(args, equil=equil, fact=fact)
+                # the sweep fabricates b = A·xtrue and checks the
+                # untransposed residual — pin trans off regardless of the
+                # top-level flag (run_once handles --trans)
+                opts = _options(args, equil=equil, fact=fact,
+                                trans=Trans.NOTRANS)
                 xtrue, b = _fabricate(a, nrhs, args.seed + nrhs)
                 try:
                     x, lu, stats, info = slu.gssvx(opts, a, b, lu=lu)
@@ -155,12 +159,30 @@ def run_sweep(a, args) -> int:
                 except Exception as e:          # robustness: keep sweeping
                     res, ok = float("nan"), False
                     print(f"  exception in {fact.name}: {e}")
-                rows.append((fact.name, equil, nrhs, res, ok))
+                rows.append((fact.name, "", equil, nrhs, res, ok))
                 n_pass += ok
                 n_fail += not ok
-    print(f"{'Fact':<24}{'Equil':<7}{'nrhs':<6}{'residual':<12}ok")
-    for name, equil, nrhs, res, ok in rows:
-        print(f"{name:<24}{str(equil):<7}{nrhs:<6}{res:<12.3e}"
+    # ordering axis (the pdtest -s/-b/-x parameter family crossed the
+    # blocking knobs; the modern capability axis is the colperm choice)
+    for cp in (ColPerm.NATURAL, ColPerm.MMD_AT_PLUS_A, ColPerm.MMD_ATA,
+               ColPerm.COLAMD, ColPerm.ND_AT_PLUS_A):
+        opts = _options(args, equil=True, fact=Fact.DOFACT,
+                        trans=Trans.NOTRANS, col_perm=cp)
+        xtrue, b = _fabricate(a, 1, args.seed)
+        try:
+            x, _, stats, info = slu.gssvx(opts, a, b)
+            res = _resid(a, x, b) if info == 0 else np.inf
+            ok = info == 0 and res < 1e-8
+        except Exception as e:
+            res, ok = float("nan"), False
+            print(f"  exception in colperm {cp.name}: {e}")
+        rows.append(("DOFACT", cp.name, True, 1, res, ok))
+        n_pass += ok
+        n_fail += not ok
+    print(f"{'Fact':<24}{'ColPerm':<16}{'Equil':<7}{'nrhs':<6}"
+          f"{'residual':<12}ok")
+    for name, cp, equil, nrhs, res, ok in rows:
+        print(f"{name:<24}{cp:<16}{str(equil):<7}{nrhs:<6}{res:<12.3e}"
               f"{'PASS' if ok else 'FAIL'}")
     print(f"summary: {n_pass} passed, {n_fail} failed "
           f"(PrintSumm analog, TEST/pdtest.c:84)")

@@ -1,0 +1,126 @@
+"""Multi-process expert driver over block-row distributed input.
+
+Capability analog of pdgssvx with NR_loc input (SRC/pdgssvx.c:505): every
+process holds a block of rows of A and of b (`DistributedCSR` — the
+NRformat_loc analog), and all of them receive the solution.
+
+TPU-native split: the analysis + factorization are single-address-space
+(they run where the accelerator is — rank 0), so the distributed input is
+first assembled there, exactly like the reference's
+pdCompRow_loc_to_CompCol_global gather before serial preprocessing
+(pdgssvx.c:775).  The gather/broadcast ride the shared-memory tree
+collectives (parallel/treecomm.py); refinement then runs distributed
+(parallel/pgsrfs.py) so the residual work stays with the row owners —
+the reference's pdgsrfs/pdgsmv shape.
+
+Payloads larger than the tree domain's max_len stream through in chunks;
+integer index arrays travel as f64 (exact below 2^53 — matrix dimensions
+and nnz counts are far below).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from superlu_dist_tpu.parallel.dist import DistributedCSR
+from superlu_dist_tpu.parallel.treecomm import TreeComm
+from superlu_dist_tpu.sparse.formats import SparseCSR
+
+
+def _chunked_reduce(tc: TreeComm, full: np.ndarray, root: int):
+    """Sum-reduce a long vector in max_len chunks (every rank calls with
+    its zero-padded contribution; disjoint supports => concatenation)."""
+    out = np.empty_like(full)
+    step = tc.max_len
+    for lo in range(0, len(full), step):
+        hi = min(lo + step, len(full))
+        out[lo:hi] = tc.reduce_sum(full[lo:hi].astype(np.float64),
+                                   root=root)[:hi - lo]
+    return out
+
+
+def _chunked_bcast(tc: TreeComm, full: np.ndarray, root: int):
+    out = np.empty(len(full))
+    step = tc.max_len
+    for lo in range(0, len(full), step):
+        hi = min(lo + step, len(full))
+        out[lo:hi] = tc.bcast(full[lo:hi].astype(np.float64),
+                              root=root)[:hi - lo]
+    return out
+
+
+def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
+                       root: int = 0) -> SparseCSR | None:
+    """Assemble the global CSR on `root` from every rank's block rows —
+    the pdCompRow_loc_to_CompCol_global analog over tree collectives.
+    Returns the matrix on root, None elsewhere."""
+    n = a_loc.n
+    # global nnz offsets: every rank's count, allreduced
+    counts = np.zeros(tc.n_ranks)
+    counts[tc.rank] = a_loc.nnz_loc
+    counts = tc.allreduce_sum(counts, root=root)
+    offs = np.zeros(tc.n_ranks + 1, dtype=np.int64)
+    offs[1:] = np.cumsum(counts).astype(np.int64)
+    total = int(offs[-1])
+    lo = int(offs[tc.rank])
+
+    # row counts (for indptr) and flat index/value arrays, disjoint slots
+    rowcnt = np.zeros(n)
+    rowcnt[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = \
+        np.diff(a_loc.indptr)
+    rowcnt = _chunked_reduce(tc, rowcnt, root)
+    idx = np.zeros(total)
+    idx[lo:lo + a_loc.nnz_loc] = a_loc.indices
+    idx = _chunked_reduce(tc, idx, root)
+    vals = np.zeros(total)
+    vals[lo:lo + a_loc.nnz_loc] = a_loc.data
+    vals = _chunked_reduce(tc, vals, root)
+
+    if tc.rank != root:
+        return None
+    indptr = np.zeros(n + 1, dtype=np.int64)
+    indptr[1:] = np.cumsum(rowcnt).astype(np.int64)
+    # ranks hold contiguous ascending row blocks, so the flat order by
+    # rank offset IS row order
+    return SparseCSR(n, n, indptr, idx.astype(np.int64), vals)
+
+
+def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
+           b_loc: np.ndarray, root: int = 0):
+    """Collectively solve A·x = b from block-row distributed input.
+
+    Returns (x_full, info) on every rank.  Single RHS.  The root runs the
+    full gssvx pipeline (with its accelerator, if any); refinement is
+    distributed across the row owners (pgsrfs).
+    """
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
+    from superlu_dist_tpu.utils.options import IterRefine
+    import dataclasses
+
+    n = a_loc.n
+    a_root = gather_distributed(tc, a_loc, root=root)
+    b_full = np.zeros(n)
+    b_full[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = b_loc
+    b_full = _chunked_reduce(tc, b_full, root)
+
+    x0 = np.zeros(n)
+    info = np.zeros(1)
+    solve_fn = None
+    if tc.rank == root:
+        # refinement happens distributed below — root factors only
+        opts0 = dataclasses.replace(options,
+                                    iter_refine=IterRefine.NOREFINE)
+        x_r, lu, stats, info_r = gssvx(opts0, a_root, b_full)
+        info[0] = float(info_r)
+        if info_r == 0:
+            x0 = np.asarray(x_r, dtype=np.float64)
+            solve_fn = lu.solve_factored
+    info = tc.bcast(info, root=root)
+    if int(info[0]) != 0:
+        return None, int(info[0])
+    x0 = _chunked_bcast(tc, x0, root)
+    if options.iter_refine == IterRefine.NOREFINE:
+        return x0, 0
+    x = pgsrfs(tc, a_loc, b_loc, x0, solve_fn, root=root)
+    return x, 0

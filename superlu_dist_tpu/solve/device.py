@@ -290,30 +290,39 @@ class DeviceSolver:
         self._fused_cache[("T", kb, conj)] = fns
         return fns
 
+    def _run_sweeps(self, rhs, sweeps):
+        """Shared solve scaffolding: pad rhs into the (n+1, kb) buffer
+        (slot n is the OOB dump row), run sweeps(x, lsum, kb) -> x, then
+        unpad — one copy for the plain and transpose paths."""
+        squeeze = rhs.ndim == 1
+        r2 = rhs[:, None] if squeeze else rhs
+        k = r2.shape[1]
+        kb = _bucket_nrhs(k)
+        pad = np.zeros((self.n + 1, kb), dtype=jnp.dtype(self.fact.dtype))
+        pad[:self.n, :k] = r2
+        x = jnp.asarray(pad)
+        lsum = jnp.zeros_like(x)
+        x = sweeps(x, lsum, kb)
+        out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
+        return out[:, 0] if squeeze else out
+
     def solve_trans(self, rhs: np.ndarray, conj: bool = False) -> np.ndarray:
         """Solve (L·U)ᵀ x = rhs (or (L·U)ᴴ with conj) on the device —
         Mᵀ = Uᵀ·Lᵀ through the same factors (the reference's trans_t,
         superlu_defs.h:628-657; host twin: trisolve.lu_solve_trans).
         Respects the same fused/streamed guard as solve()."""
         fact = self.fact
-        squeeze = rhs.ndim == 1
-        r2 = rhs[:, None] if squeeze else rhs
-        k = r2.shape[1]
-        kb = _bucket_nrhs(k)
-        dt = jnp.dtype(fact.dtype)
-        pad = np.zeros((self.n + 1, kb), dtype=dt)
-        pad[:self.n, :k] = r2
-        x = jnp.asarray(pad)
-        lsum = jnp.zeros_like(x)
         n1 = self.n + 1
+        dt = jnp.dtype(fact.dtype)
         conj = bool(conj)
-        if self.fused:
-            fwd, bwd = self._fused_trans_fns(kb, conj)
-            idx = [(firsts, rows, ws)
-                   for _, firsts, rows, ws in self._groups]
-            x, lsum = fwd(x, lsum, fact.fronts, idx)
-            x = bwd(x, fact.fronts, idx)
-        else:
+
+        def sweeps(x, lsum, kb):
+            if self.fused:
+                fwd, bwd = self._fused_trans_fns(kb, conj)
+                idx = [(firsts, rows, ws)
+                       for _, firsts, rows, ws in self._groups]
+                x, lsum = fwd(x, lsum, fact.fronts, idx)
+                return bwd(x, fact.fronts, idx)
             # Uᵀ forward, levels ascending
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     self._groups, fact.fronts):
@@ -326,30 +335,24 @@ class DeviceSolver:
                 kern = _bwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
                                          kb, n1, str(dt), conj)
                 x = kern(lp, x, firsts, rows, ws)
-        out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
-        return out[:, 0] if squeeze else out
+            return x
+
+        return self._run_sweeps(rhs, sweeps)
 
     def solve(self, rhs: np.ndarray) -> np.ndarray:
         """rhs (n,) or (n, k) in permuted labeling -> solution, same shape."""
         fact = self.fact
-        squeeze = rhs.ndim == 1
-        r2 = rhs[:, None] if squeeze else rhs
-        k = r2.shape[1]
-        kb = _bucket_nrhs(k)
-        dt = jnp.dtype(fact.dtype)
-        pad = np.zeros((self.n + 1, kb), dtype=dt)
-        pad[:self.n, :k] = r2
-        x = jnp.asarray(pad)        # slot n is the OOB dump row
-        lsum = jnp.zeros_like(x)
         n1 = self.n + 1
+        dt = jnp.dtype(fact.dtype)
         use_inv = self.diag_inv
-        if self.fused:
-            fwd, bwd = self._fused_fns(kb)
-            idx = [(firsts, rows, ws)
-                   for _, firsts, rows, ws in self._groups]
-            x, lsum = fwd(x, lsum, fact.fronts, idx, self._invs)
-            x = bwd(x, fact.fronts, idx, self._invs)
-        else:
+
+        def sweeps(x, lsum, kb):
+            if self.fused:
+                fwd, bwd = self._fused_fns(kb)
+                idx = [(firsts, rows, ws)
+                       for _, firsts, rows, ws in self._groups]
+                x, lsum = fwd(x, lsum, fact.fronts, idx, self._invs)
+                return bwd(x, fact.fronts, idx, self._invs)
             # forward, levels ascending (groups are in level order)
             for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
                     self._groups, fact.fronts, self._invs):
@@ -366,5 +369,6 @@ class DeviceSolver:
                                    str(dt), use_inv)
                 x = (kern(lp, up, x, firsts, rows, ws, uinv) if use_inv
                      else kern(lp, up, x, firsts, rows, ws))
-        out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
-        return out[:, 0] if squeeze else out
+            return x
+
+        return self._run_sweeps(rhs, sweeps)
