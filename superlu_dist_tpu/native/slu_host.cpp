@@ -1427,9 +1427,29 @@ void slu_tree_detach(void* vh, const char* name, i64 unlink_seg) {
   using namespace slu_tree;
   auto* h = (Handle*)vh;
   if (!h) return;
-  ::munmap(h->base, h->map_len);
+  if (h->base) ::munmap(h->base, h->map_len);
   if (unlink_seg) ::shm_unlink(name);
   delete h;
+}
+
+// In-process rank handle SHARING the creator's mapping (same virtual
+// addresses).  Two uses: threads standing in for ranks, and sanitizer
+// runs — TSAN's shadow memory is keyed by virtual address, so races in
+// the collective protocol are only visible when all "ranks" touch the
+// segment through one mapping.  The returned handle must be detached
+// with a null name and unlink_seg=0; it does not own the mapping.
+void* slu_tree_attach_shared(void* creator_handle, i64 rank) {
+  using namespace slu_tree;
+  auto* c = (Handle*)creator_handle;
+  if (!c) return nullptr;
+  auto* h = new Handle;
+  h->hdr = c->hdr;
+  h->slots = c->slots;
+  h->bufs = c->bufs;
+  h->rank = rank;
+  h->map_len = 0;
+  h->base = nullptr;   // not owned: detach skips munmap
+  return h;
 }
 
 // Broadcast buf (len doubles) from root to all ranks.  Every rank calls
