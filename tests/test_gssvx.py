@@ -211,3 +211,18 @@ def test_big_rua():
     x, xtrue, lu, stats = run_and_check(a)
     err = np.linalg.norm(x - xtrue, np.inf) / np.linalg.norm(x, np.inf)
     assert err < 1e-6
+
+
+def test_bfloat16_factors_recover_f64_residual():
+    """bf16 factorization (the MXU's native-rate mode) + f64 IR must still
+    reach reference accuracy on a well-conditioned system — the GESP+IR
+    design stretched to 8 mantissa bits (SURVEY.md §7 hard-part 1)."""
+    from superlu_dist_tpu.models.gallery import poisson2d
+    a = poisson2d(14)
+    xt = np.random.default_rng(5).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    x, lu, stats, info = gssvx(Options(factor_dtype="bfloat16"), a, b)
+    assert info == 0
+    r = np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b)
+    assert r < 1e-12, r
+    assert stats.refine_steps > 2   # bf16 genuinely needs the IR
