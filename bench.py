@@ -206,8 +206,13 @@ def main():
          f"flops={plan.flops / 1e9:.0f} GF")
 
     RESULT["phase"] = "factor-compile"
-    ex = StreamExecutor(plan, DTYPE)
+    # BENCH_GRANULARITY=level fuses each elimination level into one
+    # dispatch (fewer, bigger XLA programs) — for dispatch-bound runs
+    ex = StreamExecutor(plan, DTYPE,
+                        granularity=os.environ.get("BENCH_GRANULARITY",
+                                                   "group"))
     RESULT["offload"] = ex.offload
+    RESULT["granularity"] = ex.granularity
     RESULT["n_kernels"] = ex.n_kernels
     avals = jnp.asarray(avals_np)
     thresh = jnp.asarray(thresh_np)

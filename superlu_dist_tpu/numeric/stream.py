@@ -94,7 +94,8 @@ class StreamExecutor:
     """
 
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
-                 offload: str = "auto", pool_partition: bool = False):
+                 offload: str = "auto", pool_partition: bool = False,
+                 granularity: str = "group"):
         """offload: "none" keeps every factored panel on the device;
         "host" streams each group's (lpanel, upanel) to host memory as
         soon as it is produced (copy_to_host_async overlaps the next
@@ -112,6 +113,17 @@ class StreamExecutor:
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
         self.pool_partition = bool(pool_partition and mesh is not None)
+        # granularity="level" traces all of one elimination level's
+        # bucket groups into ONE jitted program (they are independent —
+        # the etree task parallelism of the reference's static schedule):
+        # dispatch count drops from #groups to #levels, at the cost of
+        # per-level (mostly unique) compiles.  "group" keeps the bounded
+        # compile count of one kernel per distinct shape key.
+        if granularity not in ("group", "level"):
+            raise ValueError(f"granularity must be 'group' or 'level', "
+                             f"got {granularity!r}")
+        self.granularity = granularity
+        self._level_fns = {}
         if offload == "auto":
             limit = float(os.environ.get("SLU_TPU_FRONT_BYTES_LIMIT", 6e9))
             itemsize = jnp.dtype(dtype).itemsize
@@ -150,7 +162,52 @@ class StreamExecutor:
 
     @property
     def n_kernels(self) -> int:
+        if self.granularity == "level":
+            return len({g.level for g in self.plan.groups})
         return len({key for key, _, _, _ in self._steps})
+
+    def _level_fn(self, level, entries):
+        """One jitted program running every group of `level` (index maps
+        are closed over — jit hoists them to constants)."""
+        fn = self._level_fns.get(level)
+        if fn is not None:
+            return fn
+        from superlu_dist_tpu.numeric.factor import pool_spec
+        psh = (pool_spec(self.mesh, self.pool_partition)
+               if self.mesh is not None else None)
+
+        front_sharding = pivot_sharding = replicated = None
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            front_sharding = NamedSharding(self.mesh,
+                                           P("snode", None, "panel"))
+            pivot_sharding = NamedSharding(self.mesh,
+                                           P("snode", None, None))
+            replicated = NamedSharding(self.mesh, P(None, None))
+
+        def run(avals, pool, thresh):
+            outs = []
+            tiny = jnp.zeros((), jnp.int32)
+            for key, a, child_arrs, nreal in entries:
+                (dims, l_a, child_shapes, _, _) = key
+                if psh is not None:
+                    pool = jax.lax.with_sharding_constraint(pool, psh)
+                children = [(ub, child_arrs[3 * i], child_arrs[3 * i + 1],
+                             child_arrs[3 * i + 2])
+                            for i, (ub, _) in enumerate(child_shapes)]
+                out, pool, t = group_step(
+                    dims, avals, pool, thresh, *a, children,
+                    front_sharding=front_sharding,
+                    pivot_sharding=pivot_sharding, replicated=replicated)
+                outs.append(out)
+                tiny = tiny + t
+            if psh is not None:
+                pool = jax.lax.with_sharding_constraint(pool, psh)
+            return outs, pool, tiny
+
+        fn = jax.jit(run, donate_argnums=(1,))
+        self._level_fns[level] = fn
+        return fn
 
     def __call__(self, avals, thresh):
         plan = self.plan
@@ -172,6 +229,8 @@ class StreamExecutor:
         if profile:
             import time
             self.last_profile = []
+        if self.granularity == "level":
+            return self._call_levels(avals, pool, thresh, profile)
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
         for gi, (key, a, child_arrs, nreal) in enumerate(self._steps):
@@ -188,26 +247,68 @@ class StreamExecutor:
                 self.last_profile.append({
                     "level": grp.level, "batch": b, "m": m, "w": w, "u": u,
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
-            if lp.shape[0] != nreal:
-                lp, up = lp[:nreal], up[:nreal]
-            if self.offload == "host":
-                # start the D2H transfer now; it overlaps the following
-                # groups' kernels (the copy-back stream of the reference's
-                # GPU path, dSchCompUdt-cuda.c:238-241).  Materialize with
-                # a lag of a few groups so the device never holds more
-                # than the in-flight window of factored panels.
-                lp.copy_to_host_async()
-                up.copy_to_host_async()
-                fronts.append((lp, up))
-                if len(fronts) > _OFFLOAD_LAG:
-                    i = len(fronts) - 1 - _OFFLOAD_LAG
-                    dlp, dup = fronts[i]
-                    fronts[i] = (np.asarray(dlp), np.asarray(dup))
-            else:
-                fronts.append((lp, up))
+            self._emit_front(fronts, lp, up, nreal)
             tiny = tiny + t
+        return self._finalize_fronts(fronts), tiny
+
+    def _emit_front(self, fronts, lp, up, nreal):
+        """Append one group's factored panels; in offload mode start the
+        D2H transfer now (it overlaps the following kernels — the
+        copy-back stream of the reference's GPU path,
+        dSchCompUdt-cuda.c:238-241) and materialize with a lag window so
+        the device never holds more than a few groups of panels."""
+        if lp.shape[0] != nreal:
+            lp, up = lp[:nreal], up[:nreal]
+        if self.offload == "host":
+            lp.copy_to_host_async()
+            up.copy_to_host_async()
+            fronts.append((lp, up))
+            if len(fronts) > _OFFLOAD_LAG:
+                i = len(fronts) - 1 - _OFFLOAD_LAG
+                dlp, dup = fronts[i]
+                fronts[i] = (np.asarray(dlp), np.asarray(dup))
+        else:
+            fronts.append((lp, up))
+
+    def _finalize_fronts(self, fronts):
         if self.offload == "host":
             fronts = [(lp if isinstance(lp, np.ndarray) else np.asarray(lp),
                        up if isinstance(up, np.ndarray) else np.asarray(up))
                       for lp, up in fronts]
-        return tuple(fronts), tiny
+        return tuple(fronts)
+
+    def _call_levels(self, avals, pool, thresh, profile):
+        """Level-granularity execution: one dispatch per elimination
+        level (see __init__)."""
+        import itertools
+        import time
+        plan = self.plan
+        fronts = []
+        tiny = jnp.zeros((), jnp.int32)
+        pairs = list(zip(plan.groups, self._steps))
+        for level, chunk in itertools.groupby(pairs,
+                                              key=lambda p: p[0].level):
+            chunk = list(chunk)
+            entries = tuple(step for _, step in chunk)
+            fn = self._level_fn(level, entries)
+            if profile:
+                t0 = time.perf_counter()
+            outs, pool, t = fn(avals, pool, thresh)
+            tiny = tiny + t
+            if profile:
+                jax.block_until_ready(outs)
+                gflop = sum((2 / 3 * g.w**3 + 2 * g.w * g.w * g.u
+                             + 2 * g.w * g.u * g.u) * g.batch
+                            for g, _ in chunk) / 1e9
+                # a LEVEL aggregate, not one kernel's shape: m/w/u are
+                # maxima over the level's heterogeneous groups
+                self.last_profile.append({
+                    "level": level, "aggregate": True,
+                    "batch": sum(g.batch for g, _ in chunk),
+                    "m": max(g.m for g, _ in chunk),
+                    "w": max(g.w for g, _ in chunk),
+                    "u": max(g.u for g, _ in chunk),
+                    "seconds": time.perf_counter() - t0, "gflop": gflop})
+            for (grp, (_, _, _, nreal)), (lp, up) in zip(chunk, outs):
+                self._emit_front(fronts, lp, up, nreal)
+        return self._finalize_fronts(fronts), tiny

@@ -183,3 +183,28 @@ def test_gssvx_pool_partition_option():
                                 grid=grid)
     assert info == 0
     np.testing.assert_allclose(x1, x0, rtol=1e-12, atol=1e-12)
+
+
+def test_level_granularity_matches_group():
+    """granularity="level" (one dispatch per elimination level) must be
+    bit-equal to the per-group stream, plain and mesh-sharded."""
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    plan, avals, thresh = _plan()
+    ref = StreamExecutor(plan, "float64")(jnp.asarray(avals),
+                                          jnp.asarray(thresh))
+    lev = StreamExecutor(plan, "float64", granularity="level")(
+        jnp.asarray(avals), jnp.asarray(thresh))
+    assert int(lev[1]) == int(ref[1])
+    for (lp, up), (rlp, rup) in zip(lev[0], ref[0]):
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(rlp))
+        np.testing.assert_array_equal(np.asarray(up), np.asarray(rup))
+    grid = gridinit(4, 2)
+    lev_m = StreamExecutor(plan, "float64", mesh=grid.mesh,
+                           granularity="level")(
+        jnp.asarray(avals), jnp.asarray(thresh))
+    assert int(lev_m[1]) == int(ref[1])
+    for (lp, up), (rlp, rup) in zip(lev_m[0], ref[0]):
+        np.testing.assert_allclose(np.asarray(lp), np.asarray(rlp),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(np.asarray(up), np.asarray(rup),
+                                   rtol=1e-12, atol=1e-12)
