@@ -118,3 +118,54 @@ def random_sparse(n: int, density: float = 0.01, seed: int = 0,
         dval = dval + np.sign(dval.real + (dval.real == 0)) * (4.0 * n * density + 4.0)
     vals = np.concatenate([vals, dval])
     return coo_to_csr(n, n, rows, cols, vals)
+
+
+def helmholtz_2d(nx: int, k: float = 5.0, dtype=np.complex128) -> SparseCSR:
+    """2-D Helmholtz operator −Δ − k² with a complex absorbing shift —
+    an indefinite complex test class (the z-path stressor; the
+    reference's complex fixtures cg20.cua/cmat are this family's role).
+    dtype must be complex (the absorbing shift is imaginary)."""
+    dtype = np.dtype(dtype)
+    if not np.issubdtype(dtype, np.complexfloating):
+        raise ValueError("helmholtz_2d needs a complex dtype "
+                         f"(absorbing shift), got {dtype}")
+    a = poisson2d(nx, dtype=np.float64)
+    vals = a.data.astype(dtype)
+    diag = a.indices == np.repeat(np.arange(a.n_rows), np.diff(a.indptr))
+    h2 = 1.0 / (nx + 1) ** 2
+    vals[diag] -= (k * k - 0.5j * k) * h2
+    out = SparseCSR(a.n_rows, a.n_cols, a.indptr, a.indices, vals)
+    out.grid_shape = a.grid_shape     # keep geometric-ND eligibility
+    return out
+
+
+def anisotropic_poisson_2d(nx: int, eps: float = 1e-3,
+                           dtype=np.float64) -> SparseCSR:
+    """Anisotropic diffusion −u_xx − eps·u_yy: strong directional
+    coupling makes the ordering/fill behavior very different from the
+    isotropic Laplacian (a standard stress class for fill-reducing
+    orderings)."""
+    n = nx * nx
+    rows, cols, vals = [], [], []
+
+    def add(r, c, v):
+        rows.append(r)
+        cols.append(c)
+        vals.append(v)
+
+    for i in range(nx):
+        for j in range(nx):
+            v = i * nx + j
+            add(v, v, 2.0 + 2.0 * eps)
+            if j > 0:
+                add(v, v - 1, -1.0)
+            if j + 1 < nx:
+                add(v, v + 1, -1.0)
+            if i > 0:
+                add(v, v - nx, -eps)
+            if i + 1 < nx:
+                add(v, v + nx, -eps)
+    a = coo_to_csr(n, n, np.asarray(rows), np.asarray(cols),
+                   np.asarray(vals, dtype=dtype))
+    a.grid_shape = (nx, nx)
+    return a
