@@ -145,27 +145,20 @@ def anisotropic_poisson_2d(nx: int, eps: float = 1e-3,
     coupling makes the ordering/fill behavior very different from the
     isotropic Laplacian (a standard stress class for fill-reducing
     orderings)."""
-    n = nx * nx
+    idx = np.arange(nx * nx).reshape(nx, nx)
     rows, cols, vals = [], [], []
 
     def add(r, c, v):
-        rows.append(r)
-        cols.append(c)
-        vals.append(v)
+        rows.append(r.ravel())
+        cols.append(c.ravel())
+        vals.append(np.full(r.size, v, dtype=dtype))
 
-    for i in range(nx):
-        for j in range(nx):
-            v = i * nx + j
-            add(v, v, 2.0 + 2.0 * eps)
-            if j > 0:
-                add(v, v - 1, -1.0)
-            if j + 1 < nx:
-                add(v, v + 1, -1.0)
-            if i > 0:
-                add(v, v - nx, -eps)
-            if i + 1 < nx:
-                add(v, v + nx, -eps)
-    a = coo_to_csr(n, n, np.asarray(rows), np.asarray(cols),
-                   np.asarray(vals, dtype=dtype))
+    add(idx, idx, 2.0 + 2.0 * eps)
+    add(idx[:, 1:], idx[:, :-1], -1.0)     # u_xx along rows
+    add(idx[:, :-1], idx[:, 1:], -1.0)
+    add(idx[1:, :], idx[:-1, :], -eps)     # eps * u_yy across rows
+    add(idx[:-1, :], idx[1:, :], -eps)
+    a = coo_to_csr(nx * nx, nx * nx, np.concatenate(rows),
+                   np.concatenate(cols), np.concatenate(vals))
     a.grid_shape = (nx, nx)
     return a

@@ -1,0 +1,30 @@
+"""Standalone CMake build flow (reference CMakeLists.txt analog).
+
+Kept in its own module: the ctypes fast-path availability mark that
+gates test_native.py must NOT gate this — a broken direct build is
+exactly when the CMake flow matters.
+"""
+
+import pytest
+def test_cmake_build_and_ctest(tmp_path):
+    """The standalone CMake flow (reference CMakeLists.txt analog) must
+    configure, build the native targets, and pass ctest."""
+    import os
+    import shutil
+    import subprocess
+    cmake = shutil.which("cmake")
+    if cmake is None:
+        pytest.skip("no cmake in this image")
+    root = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    gen = ["-G", "Ninja"] if shutil.which("ninja") else []
+    r = subprocess.run([cmake, "-B", str(tmp_path), "-S", root] + gen,
+                       capture_output=True, timeout=300)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+    r = subprocess.run([cmake, "--build", str(tmp_path), "-j", "2"],
+                       capture_output=True, timeout=900)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+    r = subprocess.run(["ctest", "--test-dir", str(tmp_path),
+                        "--output-on-failure"],
+                       capture_output=True, timeout=900)
+    assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
+

@@ -188,3 +188,4 @@ def test_mlnd_fill_quality_vs_scipy_colamd():
     lu = spl.splu(A, permc_spec="COLAMD",
                   options=dict(SymmetricMode=False))
     assert sf.nnz_L <= 2.0 * lu.L.nnz, (sf.nnz_L, lu.L.nnz)
+
