@@ -13,26 +13,41 @@ import numpy as np
 from superlu_dist_tpu.sparse.formats import SparseCSR, coo_to_csr
 
 
+
+class _Stencil:
+    """Shared COO assembly for the grid generators: collect stamped
+    slices, then build the CSR once (one definition of the add/concat/
+    coo_to_csr pattern for every generator)."""
+
+    def __init__(self, dtype):
+        self.dtype = dtype
+        self.rows, self.cols, self.vals = [], [], []
+
+    def add(self, r, c, v):
+        self.rows.append(r.ravel())
+        self.cols.append(c.ravel())
+        self.vals.append(np.full(r.size, v, dtype=self.dtype))
+
+    def build(self, n, grid_shape):
+        a = coo_to_csr(n, n, np.concatenate(self.rows),
+                       np.concatenate(self.cols),
+                       np.concatenate(self.vals))
+        a.grid_shape = grid_shape
+        return a
+
+
 def poisson2d(nx: int, ny: int | None = None, dtype=np.float64) -> SparseCSR:
     """5-point 2D Laplacian on an nx×ny grid (n = nx*ny), Dirichlet."""
     ny = nx if ny is None else ny
     idx = np.arange(nx * ny).reshape(nx, ny)
-    rows, cols, vals = [], [], []
-
-    def add(r, c, v):
-        rows.append(r.ravel())
-        cols.append(c.ravel())
-        vals.append(np.full(r.size, v, dtype=dtype))
-
-    add(idx, idx, 4.0)
-    add(idx[1:, :], idx[:-1, :], -1.0)
-    add(idx[:-1, :], idx[1:, :], -1.0)
-    add(idx[:, 1:], idx[:, :-1], -1.0)
-    add(idx[:, :-1], idx[:, 1:], -1.0)
-    a = coo_to_csr(nx * ny, nx * ny, np.concatenate(rows), np.concatenate(cols),
-                   np.concatenate(vals))
-    a.grid_shape = (nx, ny)   # consumed by geometric nested dissection
-    return a
+    st = _Stencil(dtype)
+    st.add(idx, idx, 4.0)
+    st.add(idx[1:, :], idx[:-1, :], -1.0)
+    st.add(idx[:-1, :], idx[1:, :], -1.0)
+    st.add(idx[:, 1:], idx[:, :-1], -1.0)
+    st.add(idx[:, :-1], idx[:, 1:], -1.0)
+    # grid_shape is consumed by geometric nested dissection
+    return st.build(nx * ny, (nx, ny))
 
 
 def poisson3d(nx: int, ny: int | None = None, nz: int | None = None,
@@ -41,26 +56,16 @@ def poisson3d(nx: int, ny: int | None = None, nz: int | None = None,
     ny = nx if ny is None else ny
     nz = nx if nz is None else nz
     idx = np.arange(nx * ny * nz).reshape(nx, ny, nz)
-    rows, cols, vals = [], [], []
-
-    def add(r, c, v):
-        rows.append(r.ravel())
-        cols.append(c.ravel())
-        vals.append(np.full(r.size, v, dtype=dtype))
-
-    add(idx, idx, 6.0)
+    st = _Stencil(dtype)
+    st.add(idx, idx, 6.0)
     for axis in range(3):
         lo = [slice(None)] * 3
         hi = [slice(None)] * 3
         lo[axis] = slice(1, None)
         hi[axis] = slice(None, -1)
-        add(idx[tuple(lo)], idx[tuple(hi)], -1.0)
-        add(idx[tuple(hi)], idx[tuple(lo)], -1.0)
-    n = nx * ny * nz
-    a = coo_to_csr(n, n, np.concatenate(rows), np.concatenate(cols),
-                   np.concatenate(vals))
-    a.grid_shape = (nx, ny, nz)
-    return a
+        st.add(idx[tuple(lo)], idx[tuple(hi)], -1.0)
+        st.add(idx[tuple(hi)], idx[tuple(lo)], -1.0)
+    return st.build(nx * ny * nz, (nx, ny, nz))
 
 
 def convection_diffusion_2d(nx: int, ny: int | None = None, beta: float = 10.0,
@@ -70,22 +75,13 @@ def convection_diffusion_2d(nx: int, ny: int | None = None, beta: float = 10.0,
     ny = nx if ny is None else ny
     h = 1.0 / (nx + 1)
     idx = np.arange(nx * ny).reshape(nx, ny)
-    rows, cols, vals = [], [], []
-
-    def add(r, c, v):
-        rows.append(r.ravel())
-        cols.append(c.ravel())
-        vals.append(np.full(r.size, v, dtype=dtype))
-
-    add(idx, idx, 4.0 + beta * h)
-    add(idx[1:, :], idx[:-1, :], -1.0 - beta * h)   # upwind in x
-    add(idx[:-1, :], idx[1:, :], -1.0)
-    add(idx[:, 1:], idx[:, :-1], -1.0)
-    add(idx[:, :-1], idx[:, 1:], -1.0)
-    a = coo_to_csr(nx * ny, nx * ny, np.concatenate(rows), np.concatenate(cols),
-                   np.concatenate(vals))
-    a.grid_shape = (nx, ny)
-    return a
+    st = _Stencil(dtype)
+    st.add(idx, idx, 4.0 + beta * h)
+    st.add(idx[1:, :], idx[:-1, :], -1.0 - beta * h)   # upwind in x
+    st.add(idx[:-1, :], idx[1:, :], -1.0)
+    st.add(idx[:, 1:], idx[:, :-1], -1.0)
+    st.add(idx[:, :-1], idx[:, 1:], -1.0)
+    return st.build(nx * ny, (nx, ny))
 
 
 def random_sparse(n: int, density: float = 0.01, seed: int = 0,
@@ -146,19 +142,10 @@ def anisotropic_poisson_2d(nx: int, eps: float = 1e-3,
     isotropic Laplacian (a standard stress class for fill-reducing
     orderings)."""
     idx = np.arange(nx * nx).reshape(nx, nx)
-    rows, cols, vals = [], [], []
-
-    def add(r, c, v):
-        rows.append(r.ravel())
-        cols.append(c.ravel())
-        vals.append(np.full(r.size, v, dtype=dtype))
-
-    add(idx, idx, 2.0 + 2.0 * eps)
-    add(idx[:, 1:], idx[:, :-1], -1.0)     # u_xx along rows
-    add(idx[:, :-1], idx[:, 1:], -1.0)
-    add(idx[1:, :], idx[:-1, :], -eps)     # eps * u_yy across rows
-    add(idx[:-1, :], idx[1:, :], -eps)
-    a = coo_to_csr(nx * nx, nx * nx, np.concatenate(rows),
-                   np.concatenate(cols), np.concatenate(vals))
-    a.grid_shape = (nx, nx)
-    return a
+    st = _Stencil(dtype)
+    st.add(idx, idx, 2.0 + 2.0 * eps)
+    st.add(idx[:, 1:], idx[:, :-1], -1.0)     # u_xx along rows
+    st.add(idx[:, :-1], idx[:, 1:], -1.0)
+    st.add(idx[1:, :], idx[:-1, :], -eps)     # eps * u_yy across rows
+    st.add(idx[:-1, :], idx[1:, :], -eps)
+    return st.build(nx * nx, (nx, nx))
