@@ -208,3 +208,24 @@ def test_level_granularity_matches_group():
                                    rtol=1e-12, atol=1e-12)
         np.testing.assert_allclose(np.asarray(up), np.asarray(rup),
                                    rtol=1e-12, atol=1e-12)
+
+
+def test_offload_with_pool_partition():
+    """The round-3 config-4 recipe: host-offloaded factor panels + the
+    Schur pool sharded across the mesh, together, must match the plain
+    stream bit-for-bit."""
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    plan, avals, thresh = _plan()
+    ref = StreamExecutor(plan, "float64")(jnp.asarray(avals),
+                                          jnp.asarray(thresh))
+    grid = gridinit(4, 2)
+    ex = StreamExecutor(plan, "float64", mesh=grid.mesh,
+                        pool_partition=True, offload="host")
+    got = ex(jnp.asarray(avals), jnp.asarray(thresh))
+    assert int(got[1]) == int(ref[1])
+    for (lp, up), (rlp, rup) in zip(got[0], ref[0]):
+        assert isinstance(lp, np.ndarray)     # genuinely offloaded
+        np.testing.assert_allclose(lp, np.asarray(rlp),
+                                   rtol=1e-12, atol=1e-12)
+        np.testing.assert_allclose(up, np.asarray(rup),
+                                   rtol=1e-12, atol=1e-12)
