@@ -1,0 +1,76 @@
+"""Randomized option-surface sweep — the pdtest robustness discipline
+(TEST/pdtest.c: cross every option axis, count failures) applied with
+random matrices and random option combinations.  Every run must either
+solve to the residual threshold or fail with a clean diagnostic
+(info > 0 / SuperLUError) — never crash, never return garbage silently.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import (random_sparse, poisson2d,
+                                             convection_diffusion_2d)
+from superlu_dist_tpu.utils.options import (Options, ColPerm, RowPerm,
+                                            IterRefine, Trans)
+from superlu_dist_tpu.utils.errors import SuperLUError
+
+
+def _mat(rng):
+    kind = rng.integers(0, 4)
+    if kind == 0:
+        return poisson2d(int(rng.integers(5, 12)))
+    if kind == 1:
+        return convection_diffusion_2d(int(rng.integers(5, 11)))
+    if kind == 2:
+        return random_sparse(int(rng.integers(20, 70)),
+                             density=float(rng.uniform(0.03, 0.12)),
+                             seed=int(rng.integers(1 << 30)))
+    vals_seed = int(rng.integers(1 << 30))
+    a = random_sparse(int(rng.integers(20, 50)), density=0.08,
+                      seed=vals_seed, dtype=np.complex128)
+    return a
+
+
+def _opts(rng):
+    return Options(
+        equil=bool(rng.integers(0, 2)),
+        col_perm=rng.choice([ColPerm.NATURAL, ColPerm.MMD_AT_PLUS_A,
+                             ColPerm.MMD_ATA, ColPerm.COLAMD,
+                             ColPerm.ND_AT_PLUS_A]),
+        row_perm=rng.choice([RowPerm.NOROWPERM, RowPerm.LargeDiag_MC64,
+                             RowPerm.LargeDiag_AWPM]),
+        iter_refine=rng.choice([IterRefine.NOREFINE,
+                                IterRefine.SLU_DOUBLE]),
+        trans=rng.choice([Trans.NOTRANS, Trans.TRANS]),
+        diag_inv=bool(rng.integers(0, 2)),
+        relax=int(rng.integers(2, 24)),
+        max_supernode=int(rng.integers(8, 96)),
+        min_bucket=int(rng.integers(2, 16)),
+    )
+
+
+@pytest.mark.parametrize("seed", range(12))
+def test_random_options_random_matrix(seed):
+    rng = np.random.default_rng(1000 + seed)
+    a = _mat(rng)
+    opts = _opts(rng)
+    n = a.n_rows
+    xt = rng.standard_normal(n)
+    if np.iscomplexobj(a.data):
+        xt = xt + 1j * rng.standard_normal(n)
+    xt = xt.astype(a.data.dtype)
+    op = a.transpose() if opts.trans == Trans.TRANS else a
+    b = op.matvec(xt)
+    try:
+        x, lu, stats, info = slu.gssvx(opts, a, b)
+    except SuperLUError:
+        return                              # clean refusal is acceptable
+    if info != 0:
+        assert info > 0                     # localized singularity only
+        return
+    r = np.linalg.norm(b - op.matvec(x)) / max(np.linalg.norm(b), 1e-300)
+    tol = 1e-8 if opts.iter_refine != IterRefine.NOREFINE else 1e-6
+    assert np.isfinite(r) and r < tol, (r, opts)

@@ -221,10 +221,14 @@ def test_offload_with_pool_partition():
     grid = gridinit(4, 2)
     ex = StreamExecutor(plan, "float64", mesh=grid.mesh,
                         pool_partition=True, offload="host")
+    assert ex.offload == "host"           # the mode actually engaged
     got = ex(jnp.asarray(avals), jnp.asarray(thresh))
     assert int(got[1]) == int(ref[1])
     for (lp, up), (rlp, rup) in zip(got[0], ref[0]):
-        assert isinstance(lp, np.ndarray)     # genuinely offloaded
+        # offload guarantees host-resident results; correctness is the
+        # numeric equality below (device-residency internals are covered
+        # by the executor's own offload path)
+        assert isinstance(lp, np.ndarray)
         np.testing.assert_allclose(lp, np.asarray(rlp),
                                    rtol=1e-12, atol=1e-12)
         np.testing.assert_allclose(up, np.asarray(rup),
