@@ -6,12 +6,14 @@ elimination with static pivoting (GESP), followed by iterative refinement.
 
 Architecture (TPU-first, not a port):
 
-* **Host analysis layer** (numpy; C++ accelerators planned): equilibration,
-  MC64-style maximum-product row matching, fill-reducing column orderings,
-  elimination tree, supernodal symbolic factorization.  This mirrors the
-  reference's L4 preprocessing layer (SURVEY.md §1) but is organised around
-  building *static-shape batched compute plans* for XLA instead of MPI
-  message schedules.
+* **Host analysis layer** (native C++ behind a ctypes seam, Python twins
+  as the specification oracle): equilibration, MC64-style maximum-product
+  row matching (+ AWPM), fill-reducing column orderings (multilevel ND
+  with threaded subtrees, MMD, MMD_ATA, COLAMD), elimination tree,
+  threaded supernodal symbolic factorization.  This mirrors the
+  reference's L4 preprocessing layer (SURVEY.md §1) but is organised
+  around building *static-shape batched compute plans* for XLA instead
+  of MPI message schedules.
 * **TPU numeric core**: a level-batched supernodal *multifrontal*
   factorization.  All frontal matrices at one elimination-tree level are
   independent; they are bucketed into padded static shapes and factored as a
@@ -39,10 +41,14 @@ from superlu_dist_tpu.sparse.formats import SparseCSR, SparseCSC
 
 def __getattr__(name):
     # lazy: the driver pulls in jax; keep light imports (io, formats) fast
-    if name in ("gssvx", "LUFactorization"):
+    if name in ("gssvx", "gssvx_ABglobal", "gssvx_dist", "LUFactorization"):
         import importlib
         mod = importlib.import_module("superlu_dist_tpu.drivers.gssvx")
         return getattr(mod, name)
+    if name == "read_matrix":
+        import importlib
+        mod = importlib.import_module("superlu_dist_tpu.io.readers")
+        return mod.read_matrix
     raise AttributeError(name)
 
 __version__ = "0.1.0"
