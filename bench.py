@@ -177,8 +177,13 @@ def main():
     PEAK_F32 = float(os.environ.get("BENCH_PEAK_F32_TFLOPS", "49")) * 1e12
     # TPU-tuned blocking: wide supernodes feed the MXU (SURVEY.md §7 step
     # 10 — the reference's NSUP=128 is CPU-cache-sized) and keep the
-    # streamed executor's kernel count small.
-    RELAX, MAX_SUPER, MIN_BUCKET, GROWTH = 256, 1024, 64, 2.0
+    # streamed executor's kernel count small.  Env-overridable for
+    # on-hardware tuning sweeps.
+    RELAX = int(os.environ.get("BENCH_RELAX", "256"))
+    MAX_SUPER = int(os.environ.get("BENCH_MAXSUPER", "1024"))
+    MIN_BUCKET = int(os.environ.get("BENCH_MINBUCKET", "64"))
+    GROWTH = float(os.environ.get("BENCH_GROWTH", "2.0"))
+    RESULT["blocking"] = [RELAX, MAX_SUPER, MIN_BUCKET, GROWTH]
 
     backend = jax.default_backend()
     RESULT["backend"] = backend
