@@ -1,0 +1,46 @@
+#!/bin/bash
+# On-hardware tuning sweep: runs bench.py over problem size x executor
+# granularity x blocking x dtype and appends one JSON line per config to
+# tune_results.jsonl.  Run when a real chip is reachable:
+#
+#   bash scripts/tune_tpu.sh [results_file]
+#
+# Each run reuses the persistent compile cache (.cache/jax), so later
+# configs that share kernel shapes start fast.  The bench's watchdog
+# guarantees a line per config even if a run degrades.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-tune_results.jsonl}"
+run() {
+  echo "== $* ==" >&2
+  env "$@" BENCH_REPS=3 timeout 1800 python bench.py >> "$OUT" 2>> "${OUT%.jsonl}.err"
+  echo >> "$OUT"
+}
+
+# problem-size ladder at default blocking
+run BENCH_NX=32
+run BENCH_NX=40
+run BENCH_NX=48
+
+# dispatch granularity at the big size
+run BENCH_NX=48 BENCH_GRANULARITY=level
+
+# blocking variants (panel width vs batch count)
+run BENCH_NX=48 BENCH_RELAX=128 BENCH_MAXSUPER=512
+run BENCH_NX=48 BENCH_RELAX=512 BENCH_MAXSUPER=2048
+
+# native-MXU-rate factors (IR recovers f64 residuals; more steps)
+run BENCH_NX=48 BENCH_DTYPE=bfloat16
+
+# past single-chip factor memory: host offload engages automatically
+run BENCH_NX=56
+
+grep -h '"value"' "$OUT" | python -c '
+import json, sys
+rows = [json.loads(l) for l in sys.stdin if l.strip()]
+rows.sort(key=lambda r: -(r.get("value") or 0))
+for r in rows:
+    print(f"{r.get('"'"'value'"'"'):>10} GF/s  {r.get('"'"'metric'"'"','"'"''"'"')}  "
+          f"blocking={r.get('"'"'blocking'"'"')} gran={r.get('"'"'granularity'"'"')} "
+          f"resid={r.get('"'"'residual'"'"')}")
+'
