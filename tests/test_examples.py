@@ -9,7 +9,8 @@ import sys
 import pytest
 
 EXAMPLES = ["pddrive.py", "pddrive1.py", "pddrive2.py", "pddrive3.py",
-            "pddrive4.py", "pzdrive.py", "pddrive_ABglobal.py"]
+            "pddrive4.py", "pzdrive.py", "pddrive_ABglobal.py",
+            "pddrive_dist.py"]
 ROOT = os.path.join(os.path.dirname(__file__), "..")
 
 
