@@ -159,3 +159,17 @@ def test_trans_streamed_matches_fused():
     np.testing.assert_array_equal(got_f, got_s)
     want = lu_solve_trans(lu.numeric, d)
     np.testing.assert_allclose(got_f, want, rtol=1e-9, atol=1e-11)
+
+
+def test_wide_rhs_batch():
+    """nrhs well past the bucket boundary (the reference sweeps nrhs and
+    its solve batches Linv GEMMs for large nrhs — SURVEY.md §7 hard-part
+    5); both solver paths, 40 columns."""
+    a = poisson2d(10)
+    lu = _factor(a)
+    rng = np.random.default_rng(31)
+    d = rng.standard_normal((a.n_rows, 40))
+    got = DeviceSolver(lu.numeric).solve(d)
+    want = lu_solve(lu.numeric, d)
+    assert got.shape == want.shape == (a.n_rows, 40)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)

@@ -274,3 +274,29 @@ print("INT64 OK", r)
                        cwd=os.path.join(os.path.dirname(__file__), ".."))
     assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
     assert b"INT64 OK" in r.stdout
+
+
+def test_ill_conditioned_f32_ir_behavior():
+    """Precision-boundary documentation test: with f32 factors, IR
+    converges while kappa(A)*eps_f32 < 1 and the berr history reports
+    honestly when it cannot (the GESP contract — the reference relies on
+    the same IR safety net, pdgsrfs.c:232)."""
+    from superlu_dist_tpu.models.gallery import poisson2d
+    n = 0
+    a = poisson2d(12)
+    d = a.to_dense()
+    # scale rows geometrically to raise the condition number (~1e6)
+    s = np.logspace(0, 6, a.n_rows)
+    import superlu_dist_tpu.sparse.formats as fmts
+    rows = np.repeat(np.arange(a.n_rows), np.diff(a.indptr))
+    ac = fmts.SparseCSR(a.n_rows, a.n_cols, a.indptr, a.indices,
+                        a.data * s[rows])
+    xt = np.random.default_rng(0).standard_normal(a.n_rows)
+    b = ac.matvec(xt)
+    x, lu, stats, info = gssvx(Options(factor_dtype="float32"), ac, b)
+    assert info == 0
+    r = np.linalg.norm(b - ac.matvec(x)) / np.linalg.norm(b)
+    # equilibration + matching + f32 factors + f64 IR must still deliver
+    # a backward-stable solution at kappa ~ 1e6
+    assert r < 1e-10, r
+    assert lu.berrs, "refinement must have run"
