@@ -289,11 +289,29 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
     if np.issubdtype(a.data.dtype, np.complexfloating):
         dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
     with stats.timer("FACT"):
-        numeric = numeric_factorize(plan, bvals, anorm, dtype=dtype,
-                                    replace_tiny=options.replace_tiny_pivot,
-                                    mesh=grid.mesh if grid is not None
-                                    else None,
-                                    pool_partition=options.pool_partition)
+        if str(dtype) == "df64":
+            # emulated-double factorization for f32-only hardware (true
+            # ~2^-48 factors; SURVEY.md §7 hard-part 1); host f64 factors
+            # come back, so the standard solve path applies
+            if np.issubdtype(a.data.dtype, np.complexfloating):
+                raise SuperLUError("factor_dtype='df64' supports real "
+                                   "matrices only (use complex128 on CPU)")
+            if grid is not None or options.pool_partition:
+                raise SuperLUError(
+                    "factor_dtype='df64' is single-device for now — "
+                    "drop the grid / pool_partition or use the default "
+                    "mixed-precision path")
+            from superlu_dist_tpu.numeric.df64_factor import (
+                df64_numeric_factorize)
+            numeric = df64_numeric_factorize(
+                plan, bvals, anorm,
+                replace_tiny=options.replace_tiny_pivot)
+        else:
+            numeric = numeric_factorize(
+                plan, bvals, anorm, dtype=dtype,
+                replace_tiny=options.replace_tiny_pivot,
+                mesh=grid.mesh if grid is not None else None,
+                pool_partition=options.pool_partition)
         for lp, up in numeric.fronts:
             if hasattr(lp, "block_until_ready"):
                 lp.block_until_ready()

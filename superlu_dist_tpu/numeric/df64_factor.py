@@ -1,0 +1,238 @@
+"""Double-float (df64) numeric factorization — true ~2^-48 factors on
+hardware without an f64 MXU.
+
+This closes SURVEY.md §7 hard-part 1 for the systems the default
+mixed-precision path cannot handle: with f32 factors, iterative
+refinement converges only while κ(A)·2⁻²⁴ ≲ 1; beyond that the
+correction solves stop contracting.  Factoring in df64 (hi, lo f32
+pairs, ~48-bit significands — ops/df64.py) pushes the boundary to
+κ(A)·2⁻⁴⁸, the same class as native f64, at ~20-30 f32 flops per MAC on
+the VPU.
+
+Design: the same level-batched multifrontal plan as the fast path (the
+index maps are dtype-blind), with a df64 twin of the group step.  The
+pivot-block elimination runs the scatter-free masked loop over the
+pivot columns of the WHOLE front — each step is a full-front exact
+rank-1 update, so after w steps the trailing block IS the Schur
+complement (no separate triangular solves needed; this trades ~3x
+flops for having exactly one df64 kernel).  Factored panels are pulled
+to host and recombined into exact float64 arrays (hi + lo), so every
+downstream consumer — host triangular solves, transpose solves,
+refinement, GetDiagU — runs the standard f64 path unchanged.
+
+Accuracy caveat (see ops/df64.py header): XLA:CPU's instruction fusion
+breaks the error-free transforms; on the CPU backend run with
+XLA_FLAGS=--xla_disable_hlo_passes=fusion,cpu-instruction-fusion (the
+tests do, in a subprocess).  TPU/GPU pipelines honor the barriers.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from superlu_dist_tpu.numeric.factor import NumericFactorization
+from superlu_dist_tpu.numeric.plan import FactorPlan
+from superlu_dist_tpu.ops.df64 import (df64_add, df64_div, df64_from_f64,
+                                       df64_mul, df64_neg, df64_sub)
+
+
+def _fix_pivot_df64(piv, thresh):
+    """GESP tiny-pivot replacement on the df64 pivot (magnitude test and
+    replacement value act on the hi word — the reference's thresh
+    semantics, pdgstrf2.c:218-232)."""
+    ph, pl = piv
+    ap = jnp.abs(ph)
+    safe = jnp.where(ap == 0, jnp.ones_like(ph), ap)
+    unit = jnp.where(ap == 0, jnp.ones_like(ph), ph / safe)
+    tiny = ap < thresh
+    return ((jnp.where(tiny, unit * thresh, ph),
+             jnp.where(tiny, jnp.zeros_like(pl), pl)),
+            tiny.astype(jnp.int32))
+
+
+def df64_partial_front_factor(fh, fl, thresh, w):
+    """Masked partial LU of one (m, m) df64 front over its first w pivot
+    columns.  Full-front rank-1 updates: after the loop the leading w
+    rows/cols hold packed L\\U, L21, U12 and the trailing block holds
+    the Schur complement.  Returns ((fh, fl), tiny_flags (w,))."""
+    m = fh.shape[0]
+    idx = jnp.arange(m)
+
+    def step(i, carry):
+        (ah, al), flags = carry
+        sel = idx == i
+        e = sel.astype(ah.dtype)
+        # single-element masks: the sums select exactly one entry, so
+        # they are exact in f32 (every other term is a true zero)
+        row = (jnp.sum(ah * e[:, None], axis=0),
+               jnp.sum(al * e[:, None], axis=0))
+        col = (jnp.sum(ah * e[None, :], axis=1),
+               jnp.sum(al * e[None, :], axis=1))
+        piv = (jnp.sum(row[0] * e), jnp.sum(row[1] * e))
+        piv, tiny = _fix_pivot_df64(piv, thresh)
+        below = idx > i
+        l = df64_div(col, (piv[0][None], piv[1][None]))
+        l = (jnp.where(below, l[0], 0.0), jnp.where(below, l[1], 0.0))
+        u = (jnp.where(below, row[0], 0.0), jnp.where(below, row[1], 0.0))
+        upd = df64_mul((l[0][:, None], l[1][:, None]),
+                       (u[0][None, :], u[1][None, :]))
+        ah, al = df64_sub((ah, al), upd)
+        # write multipliers + fixed pivot into column i by EXACT masked
+        # select (0/1 products and disjoint-support sums round nothing;
+        # the f32 path's delta-add trick would round the df64 low word
+        # at the f32 ulp and collapse the factorization to f32 accuracy)
+        above = idx < i
+        new_col = (jnp.where(below, l[0], 0.0)
+                   + jnp.where(above, col[0], 0.0) + piv[0] * e,
+                   jnp.where(below, l[1], 0.0)
+                   + jnp.where(above, col[1], 0.0) + piv[1] * e)
+        keep = (1.0 - e)[None, :]
+        ah = ah * keep + new_col[0][:, None] * e[None, :]
+        al = al * keep + new_col[1][:, None] * e[None, :]
+        return (ah, al), flags + tiny * sel.astype(jnp.int32)
+
+    (fh, fl), flags = jax.lax.fori_loop(
+        0, w, step, ((fh, fl), jnp.zeros(m, jnp.int32)))
+    return (fh, fl), flags[:w]
+
+
+@functools.lru_cache(maxsize=None)
+def _df64_group_kernel(dims, child_shapes, pool_size):
+    """One (level, bucket) group in df64: assemble (hi, lo), factor,
+    scatter the Schur block into the (hi, lo) pools."""
+    batch, m, w, u = dims
+
+    def step(avals_h, avals_l, pool_h, pool_l, thresh,
+             a_slot, a_flat, a_src, ws, off, *child_arr):
+        k = jnp.arange(m)
+        diag = ((k[None, :] >= ws[:, None]) & (k[None, :] < w)).astype(
+            jnp.float32)
+        fh = jnp.zeros((batch, m * m), jnp.float32)
+        fh = fh.at[:, k * m + k].add(diag)         # identity padding (hi)
+        fl = jnp.zeros((batch, m * m), jnp.float32)
+        if a_src.shape[0]:
+            vh = avals_h.at[a_src].get(mode="fill", fill_value=0)
+            vl = avals_l.at[a_src].get(mode="fill", fill_value=0)
+            fh = fh.at[(a_slot, a_flat)].add(vh, mode="drop")
+            fl = fl.at[(a_slot, a_flat)].add(vl, mode="drop")
+        children = [(ub, child_arr[3 * i], child_arr[3 * i + 1],
+                     child_arr[3 * i + 2])
+                    for i, (ub, _) in enumerate(child_shapes)]
+        # extend-add must stay exact: a plain f32 scatter-ADD would round
+        # colliding sibling contributions at 2^-24 and cap the whole
+        # factorization at f32 accuracy.  The caller pre-partitions the
+        # children into passes with at most ONE child per batch slot
+        # (child_shapes carries one entry per collision-free pass), so
+        # each pass scatters into a fresh zero pair and is folded into
+        # the front with an exact df64_add.
+        for (ub, child_off, child_slot, rel) in children:
+            src = child_off[:, None] + jnp.arange(ub * ub)
+            vh = pool_h.at[src].get(mode="fill", fill_value=0)
+            vl = pool_l.at[src].get(mode="fill", fill_value=0)
+            ri, rj = rel[:, :, None], rel[:, None, :]
+            dst = jnp.where((ri >= m) | (rj >= m), m * m,
+                            ri * m + rj).reshape(-1, ub * ub)
+            ph = jnp.zeros((batch, m * m), jnp.float32)
+            pl = jnp.zeros((batch, m * m), jnp.float32)
+            ph = ph.at[(child_slot[:, None], dst)].add(vh, mode="drop")
+            pl = pl.at[(child_slot[:, None], dst)].add(vl, mode="drop")
+            fh, fl = df64_add((fh, fl), (ph, pl))
+        fh = fh.reshape(batch, m, m)
+        fl = fl.reshape(batch, m, m)
+        (fh, fl), counts = jax.vmap(
+            lambda h, lo: df64_partial_front_factor(h, lo, thresh, w))(fh, fl)
+        tiny = jnp.sum(jnp.where(jnp.arange(w)[None, :] < ws[:, None],
+                                 counts, 0))
+        if u > 0:
+            sh = fh[:, w:, w:].reshape(batch, u * u)
+            sl = fl[:, w:, w:].reshape(batch, u * u)
+            dst = off[:, None] + jnp.arange(u * u)
+            pool_h = pool_h.at[dst].set(sh, mode="drop")
+            pool_l = pool_l.at[dst].set(sl, mode="drop")
+        lp = (fh[:, :, :w], fl[:, :, :w])
+        up = (fh[:, :w, w:], fl[:, :w, w:])
+        return lp, up, pool_h, pool_l, tiny
+
+    return jax.jit(step, donate_argnums=(2, 3))
+
+
+def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
+                           anorm: float,
+                           replace_tiny: bool = True) -> NumericFactorization:
+    """Factor with ~f64 accuracy on f32-only hardware.
+
+    values must be float64 (split exactly into df64 pairs host-side).
+    The GESP threshold uses the f64 epsilon — these factors genuinely
+    carry ~48-bit significands.  Output fronts are host float64 arrays
+    (hi + lo recombined), so the standard host solve/refine path runs
+    unchanged; `on_host` is True by construction.
+    """
+    from superlu_dist_tpu.numeric.stream import _bucket_len, _pad_to
+
+    avals_h, avals_l = df64_from_f64(np.asarray(pattern_values, np.float64))
+    eps64 = float(np.finfo(np.float64).eps)
+    thresh = jnp.asarray(np.sqrt(eps64) * max(float(anorm), 1e-300)
+                         if replace_tiny else 0.0, jnp.float32)
+    n_avals = len(plan.pattern_indices)
+    pool_h = jnp.zeros(plan.pool_size, jnp.float32)
+    pool_l = jnp.zeros(plan.pool_size, jnp.float32)
+    fronts = []
+    tiny = 0
+    for grp in plan.groups:
+        b = _bucket_len(grp.batch, 1)
+        la = _bucket_len(len(grp.a_src))
+        a = (jnp.asarray(_pad_to(grp.a_slot, la, b)),
+             jnp.asarray(_pad_to(grp.a_flat, la, 0)),
+             jnp.asarray(_pad_to(grp.a_src, la, n_avals)),
+             jnp.asarray(_pad_to(grp.ws, b, 0)),
+             jnp.asarray(_pad_to(grp.off, b, plan.pool_size)))
+        child_arrs = []
+        child_shapes = []
+        for cs in grp.children:
+            # partition this child group into passes with at most one
+            # child per batch slot, so each pass's scatter is
+            # collision-free and the pass results combine by exact
+            # df64_add (see _df64_group_kernel)
+            passes = []          # list of lists of child indices
+            for j, slot in enumerate(np.asarray(cs.child_slot)):
+                for p in passes:
+                    if slot not in p[1]:
+                        p[0].append(j)
+                        p[1].add(int(slot))
+                        break
+                else:
+                    passes.append(([j], {int(slot)}))
+            for p_idx, _slots in passes:
+                sel = np.asarray(p_idx, dtype=np.int64)
+                c = _bucket_len(len(sel), 1)
+                rel = np.full((c, cs.ub), grp.m, dtype=np.int64)
+                rel[:len(sel)] = np.asarray(cs.rel)[sel]
+                child_arrs.extend([
+                    jnp.asarray(_pad_to(np.asarray(cs.child_off)[sel],
+                                        c, plan.pool_size)),
+                    jnp.asarray(_pad_to(np.asarray(cs.child_slot)[sel],
+                                        c, b)),
+                    jnp.asarray(rel)])
+                child_shapes.append((cs.ub, c))
+        kern = _df64_group_kernel((b, grp.m, grp.w, grp.u),
+                                  tuple(child_shapes), plan.pool_size)
+        lp, up, pool_h, pool_l, t = kern(avals_h, avals_l, pool_h, pool_l,
+                                         thresh, *a, *child_arrs)
+        tiny += int(t)
+        # recombine on host to exact f64; trim batch padding
+        lp64 = (np.asarray(lp[0], np.float64)
+                + np.asarray(lp[1], np.float64))[:grp.batch]
+        up64 = (np.asarray(up[0], np.float64)
+                + np.asarray(up[1], np.float64))[:grp.batch]
+        fronts.append((lp64, up64))
+    finite, info_col = (True, -1)
+    if not replace_tiny:
+        from superlu_dist_tpu.numeric.factor import localize_singularity
+        finite, info_col = localize_singularity(plan, fronts)
+    return NumericFactorization(plan=plan, fronts=fronts, tiny_pivots=tiny,
+                                dtype=np.dtype(np.float64),
+                                finite=finite, info_col=info_col)

@@ -252,38 +252,44 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     finite = True
     info_col = -1
     if not replace_tiny:
-        # singularity check + localization: a zero or non-finite U diagonal
-        # in a real (non-padding) column.  The earliest such global column
-        # is the reference's info>0 first-zero-pivot index
-        # (pdgstrf.c:1920-1924); a zero pivot in the last column of a front
-        # divides nothing during factorization, so isfinite alone misses it.
-        bad_cols = []
-        sn_start = plan.sf.sn_start
-        for grp, (lp, up) in zip(plan.groups, fronts_out):
-            lph = np.asarray(lp)
-            diag = np.diagonal(lph[:, :grp.w, :grp.w], axis1=1, axis2=2)
-            bad = (diag == 0) | ~np.isfinite(diag)
-            bad &= np.arange(grp.w)[None, :] < np.asarray(grp.ws)[:, None]
-            if bad.any():
-                slots, cols = np.nonzero(bad)
-                bad_cols.append(int((sn_start[grp.sns[slots]] + cols).min()))
-            else:
-                # off-diagonal-only contamination: attribute per SLOT, not
-                # per group — an unrelated subtree batched in the same
-                # group must not shift min(bad_cols) below the true pivot
-                # (contamination only flows to ancestors, whose columns
-                # are larger than the zero pivot's)
-                nf = ~np.isfinite(lph.reshape(lph.shape[0], -1)).all(axis=1)
-                nf |= ~np.isfinite(np.asarray(up).reshape(
-                    lph.shape[0], -1)).all(axis=1)
-                if nf.any():
-                    bad_cols.append(int(sn_start[grp.sns[nf]].min()))
-        if bad_cols:
-            finite = False
-            info_col = min(bad_cols)
+        finite, info_col = localize_singularity(plan, fronts_out)
     return NumericFactorization(plan=plan, fronts=fronts_out,
                                 tiny_pivots=int(tiny_total), dtype=dtype,
                                 finite=finite, info_col=info_col)
+
+
+def localize_singularity(plan: FactorPlan, fronts):
+    """Zero-pivot detection + localization over factored fronts.
+
+    A zero or non-finite U diagonal in a real (non-padding) column; the
+    earliest such global column is the reference's info>0
+    first-zero-pivot index (pdgstrf.c:1920-1924).  A zero pivot in the
+    LAST column of a front divides nothing during factorization, so an
+    isfinite scan alone would miss it.  Returns (finite, info_col)."""
+    bad_cols = []
+    sn_start = plan.sf.sn_start
+    for grp, (lp, up) in zip(plan.groups, fronts):
+        lph = np.asarray(lp)
+        diag = np.diagonal(lph[:, :grp.w, :grp.w], axis1=1, axis2=2)
+        bad = (diag == 0) | ~np.isfinite(diag)
+        bad &= np.arange(grp.w)[None, :] < np.asarray(grp.ws)[:, None]
+        if bad.any():
+            slots, cols = np.nonzero(bad)
+            bad_cols.append(int((sn_start[grp.sns[slots]] + cols).min()))
+        else:
+            # off-diagonal-only contamination: attribute per SLOT, not
+            # per group — an unrelated subtree batched in the same
+            # group must not shift min(bad_cols) below the true pivot
+            # (contamination only flows to ancestors, whose columns
+            # are larger than the zero pivot's)
+            nf = ~np.isfinite(lph.reshape(lph.shape[0], -1)).all(axis=1)
+            nf |= ~np.isfinite(np.asarray(up).reshape(
+                lph.shape[0], -1)).all(axis=1)
+            if nf.any():
+                bad_cols.append(int(sn_start[grp.sns[nf]].min()))
+    if bad_cols:
+        return False, min(bad_cols)
+    return True, -1
 
 
 def factor_flops(plan: FactorPlan) -> float:

@@ -103,6 +103,26 @@ def df64_mul(x, y):
     return quick_two_sum(p, e)
 
 
+def df64_neg(x):
+    return -x[0], -x[1]
+
+
+def df64_sub(x, y):
+    return df64_add(x, df64_neg(y))
+
+
+def df64_div(x, y):
+    """df64 division (long division with one correction): ~2^-47."""
+    xh, xl, yh, yl = _bcast(x, y)
+    q1 = _bar(xh / yh)
+    r = df64_sub((xh, xl), df64_mul((q1, jnp.zeros_like(q1)), (yh, yl)))
+    q2 = _bar(r[0] / yh)
+    r2 = df64_sub(r, df64_mul((q2, jnp.zeros_like(q2)), (yh, yl)))
+    q3 = _bar(r2[0] / yh)
+    s, e = two_sum(q1, q2)
+    return quick_two_sum(s, e + q3)
+
+
 def df64_from_f64(a):
     """Split a float64 array into a df64 pair of f32 device arrays.
 
