@@ -43,8 +43,9 @@ def _build_parser():
                    help="disable iterative refinement")
     p.add_argument("--trans", action="store_true", help="solve A^T X = B")
     p.add_argument("--dtype", default=None,
-                   choices=["float32", "float64"],
-                   help="factorization dtype (default: f32 on TPU, f64 CPU)")
+                   choices=["float32", "float64", "bfloat16", "df64"],
+                   help="factorization dtype (default: f32 on TPU, f64 "
+                        "CPU; df64 = emulated double on f32 hardware)")
     p.add_argument("-x", "--relax", type=int, default=None,
                    help="supernode relaxation (sp_ienv(2) / pdtest -x)")
     p.add_argument("-m", "--maxsuper", type=int, default=None,
