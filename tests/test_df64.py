@@ -174,3 +174,48 @@ print(f"DF64 FACTOR OK f32={r32:.2e} df64={rdf:.2e} generic={rg:.2e}")
                          capture_output=True, text=True)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "DF64 FACTOR OK" in res.stdout
+
+
+def test_df64_front_factor_vs_exact_lu():
+    """Front-level pin: df64 partial factorization vs exact f64 LU of the
+    same front — the ~2^-48 contract measured directly, including a
+    1e7-dynamic-range front (subprocess, fusion passes disabled)."""
+    import os
+    import subprocess
+    import sys
+    code = """
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_disable_hlo_passes=fusion,cpu-instruction-fusion"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np, jax.numpy as jnp
+from superlu_dist_tpu.ops.df64 import df64_from_f64
+from superlu_dist_tpu.numeric.df64_factor import df64_partial_front_factor
+
+rng = np.random.default_rng(7)
+for scale_pow, gate in ((0, 1e-13), (7, 1e-9)):
+    m, w = 12, 8
+    f = rng.standard_normal((m, m)) + 4.0 * np.eye(m)
+    f *= np.logspace(0, scale_pow, m)[:, None]
+    fh, fl = df64_from_f64(f)
+    fn = jax.jit(lambda h, l: df64_partial_front_factor(
+        h, l, jnp.float32(0.0), w))
+    (gh, gl), flags = fn(fh, fl)
+    got = np.asarray(gh, np.float64) + np.asarray(gl, np.float64)
+    # exact f64 unpivoted partial LU reference
+    ref = f.copy()
+    for i in range(w):
+        ref[i+1:, i] /= ref[i, i]
+        ref[i+1:, i+1:] -= np.outer(ref[i+1:, i], ref[i, i+1:])
+    rel = np.abs(got - ref).max() / np.abs(ref).max()
+    assert rel < gate, (scale_pow, rel)
+print("DF64 FRONT OK")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env, timeout=600,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "DF64 FRONT OK" in res.stdout
