@@ -101,10 +101,22 @@ def df64_partial_front_factor(fh, fl, thresh, w):
 
 
 @functools.lru_cache(maxsize=None)
-def _df64_group_kernel(dims, child_shapes, pool_size):
+def _df64_group_kernel(dims, child_shapes, pool_size, mesh=None):
     """One (level, bucket) group in df64: assemble (hi, lo), factor,
-    scatter the Schur block into the (hi, lo) pools."""
+    scatter the Schur block into the (hi, lo) pools.
+
+    With a mesh, the batch dimension shards over "snode" (the vmapped
+    elimination is per-front independent, so sharding cannot perturb the
+    error-free transforms); the pools stay replicated.  The "panel" axis
+    is idle here — splitting the masked elimination's minor dims would
+    turn every per-step row/column reduction into a collective."""
     batch, m, w, u = dims
+    front_sharding = pool_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from superlu_dist_tpu.numeric.factor import pool_spec
+        front_sharding = NamedSharding(mesh, P("snode", None, None))
+        pool_sharding = pool_spec(mesh, False)   # hi/lo pools replicated
 
     def step(avals_h, avals_l, pool_h, pool_l, thresh,
              a_slot, a_flat, a_src, ws, off, *child_arr):
@@ -143,6 +155,11 @@ def _df64_group_kernel(dims, child_shapes, pool_size):
             fh, fl = df64_add((fh, fl), (ph, pl))
         fh = fh.reshape(batch, m, m)
         fl = fl.reshape(batch, m, m)
+        if front_sharding is not None:
+            fh = jax.lax.with_sharding_constraint(fh, front_sharding)
+            fl = jax.lax.with_sharding_constraint(fl, front_sharding)
+            pool_h = jax.lax.with_sharding_constraint(pool_h, pool_sharding)
+            pool_l = jax.lax.with_sharding_constraint(pool_l, pool_sharding)
         (fh, fl), counts = jax.vmap(
             lambda h, lo: df64_partial_front_factor(h, lo, thresh, w))(fh, fl)
         tiny = jnp.sum(jnp.where(jnp.arange(w)[None, :] < ws[:, None],
@@ -155,6 +172,13 @@ def _df64_group_kernel(dims, child_shapes, pool_size):
             pool_l = pool_l.at[dst].set(sl, mode="drop")
         lp = (fh[:, :, :w], fl[:, :, :w])
         up = (fh[:, :w, w:], fl[:, :w, w:])
+        if pool_sharding is not None:
+            # pin the linearly-threaded pools replicated on OUTPUT too, so
+            # sharding propagation from the snode-sharded fronts cannot
+            # hand the next group a resharded pool (per-group transfers /
+            # jit cache misses)
+            pool_h = jax.lax.with_sharding_constraint(pool_h, pool_sharding)
+            pool_l = jax.lax.with_sharding_constraint(pool_l, pool_sharding)
         return lp, up, pool_h, pool_l, tiny
 
     return jax.jit(step, donate_argnums=(2, 3))
@@ -162,7 +186,8 @@ def _df64_group_kernel(dims, child_shapes, pool_size):
 
 def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                            anorm: float,
-                           replace_tiny: bool = True) -> NumericFactorization:
+                           replace_tiny: bool = True,
+                           mesh=None) -> NumericFactorization:
     """Factor with ~f64 accuracy on f32-only hardware.
 
     values must be float64 (split exactly into df64 pairs host-side).
@@ -219,7 +244,8 @@ def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                     jnp.asarray(rel)])
                 child_shapes.append((cs.ub, c))
         kern = _df64_group_kernel((b, grp.m, grp.w, grp.u),
-                                  tuple(child_shapes), plan.pool_size)
+                                  tuple(child_shapes), plan.pool_size,
+                                  mesh)
         lp, up, pool_h, pool_l, t = kern(avals_h, avals_l, pool_h, pool_l,
                                          thresh, *a, *child_arrs)
         tiny += int(t)

@@ -219,3 +219,49 @@ print("DF64 FRONT OK")
                          capture_output=True, text=True)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "DF64 FRONT OK" in res.stdout
+
+
+def test_df64_sharded_matches_single_device():
+    """df64 over a mesh (batch sharded on "snode") must equal the
+    single-device result bitwise — sharding a vmapped elimination cannot
+    perturb the error-free transforms.  Subprocess: virtual 8-device CPU
+    mesh + the fusion passes disabled."""
+    import os
+    import subprocess
+    import sys
+    code = """
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_disable_hlo_passes=fusion,cpu-instruction-fusion"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.parallel.grid import gridinit
+from superlu_dist_tpu.utils.options import Options, IterRefine
+
+a = poisson2d(11)
+xt = np.random.default_rng(2).standard_normal(a.n_rows)
+b = a.matvec(xt)
+opt = dict(factor_dtype="df64", iter_refine=IterRefine.NOREFINE)
+x0, lu0, _, i0 = slu.gssvx(Options(**opt), a, b)
+grid = gridinit(4, 2)
+x1, lu1, _, i1 = slu.gssvx(Options(**opt), a, b, grid=grid)
+assert i0 == 0 and i1 == 0
+for (lp0, up0), (lp1, up1) in zip(lu0.numeric.fronts, lu1.numeric.fronts):
+    np.testing.assert_array_equal(lp0, lp1)
+    np.testing.assert_array_equal(up0, up1)
+np.testing.assert_array_equal(x0, x1)
+r = np.linalg.norm(b - a.matvec(x1)) / np.linalg.norm(b)
+assert r < 1e-12, r
+print("DF64 SHARDED OK", r)
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env, timeout=900,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "DF64 SHARDED OK" in res.stdout
