@@ -221,6 +221,62 @@ print("DF64 FRONT OK")
     assert "DF64 FRONT OK" in res.stdout
 
 
+def test_df64_executor_cached_same_pattern():
+    """SamePattern_SameRowPerm reuse hits ONE cached Df64Executor:
+    refactoring new
+    values on the same pattern+rowperm (the tier that reuses the plan)
+    must not redo the host-side index prep
+    (the reference keeps its schedules in LUstruct across SamePattern
+    calls, SRC/pdgssvx.c:1132-1166).  Subprocess with the XLA:CPU fusion
+    passes disabled (ops/df64.py caveat)."""
+    import os
+    import subprocess
+    import sys
+    code = """
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_disable_hlo_passes=fusion,cpu-instruction-fusion"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import superlu_dist_tpu as slu
+import superlu_dist_tpu.sparse.formats as fmts
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.numeric.df64_factor import get_df64_executor
+from superlu_dist_tpu.utils.options import Options, Fact, IterRefine
+
+a = poisson2d(9)
+xt = np.random.default_rng(4).standard_normal(a.n_rows)
+b = a.matvec(xt)
+opt = dict(factor_dtype="df64", iter_refine=IterRefine.NOREFINE)
+x0, lu, _, i0 = slu.gssvx(Options(**opt), a, b)
+# the PRODUCTION path must have populated the cache already — a
+# get_df64_executor call here would itself create-and-cache one and
+# make the identity check below vacuous
+assert ("df64", "df64", None, False) in lu.plan._factor_fns
+ex0 = get_df64_executor(lu.plan)
+# same pattern, new values
+a2 = fmts.SparseCSR(a.n_rows, a.n_cols, a.indptr, a.indices,
+                    a.data * 3.0 + 0.01)
+b2 = a2.matvec(xt)
+x2, lu2, _, i2 = slu.gssvx(
+    Options(fact=Fact.SamePattern_SameRowPerm, **opt), a2, b2, lu=lu)
+assert i0 == 0 and i2 == 0, (i0, i2)
+assert lu2.plan is lu.plan            # plan reused across the tier
+assert get_df64_executor(lu2.plan) is ex0   # executor cache hit
+r = np.linalg.norm(b2 - a2.matvec(x2)) / np.linalg.norm(b2)
+assert r < 1e-12, r
+print("DF64 CACHE OK", r)
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env, timeout=900,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "DF64 CACHE OK" in res.stdout
+
+
 def test_df64_sharded_matches_single_device():
     """df64 over a mesh (batch sharded on "snode") must equal the
     single-device result bitwise — sharding a vmapped elimination cannot
