@@ -181,9 +181,14 @@ def main():
     # on-hardware tuning sweeps.
     RELAX = int(os.environ.get("BENCH_RELAX", "256"))
     MAX_SUPER = int(os.environ.get("BENCH_MAXSUPER", "1024"))
-    MIN_BUCKET = int(os.environ.get("BENCH_MINBUCKET", "64"))
-    GROWTH = float(os.environ.get("BENCH_GROWTH", "2.0"))
-    RESULT["blocking"] = [RELAX, MAX_SUPER, MIN_BUCKET, GROWTH]
+    MIN_BUCKET = int(os.environ.get("BENCH_MINBUCKET", "32"))
+    GROWTH = float(os.environ.get("BENCH_GROWTH", "1.3"))
+    # fill-tolerant amalgamation (symbfact.amalgamate_supernodes) is the
+    # round-3 MFU lever: at NX=48 it cuts 10707 supernodes/325 levels/119
+    # kernels to 587/13/~45 and the executed-over-structural flop ratio
+    # from 15.7x to ~1.7x
+    AMALG = float(os.environ.get("BENCH_AMALG", "1.2"))
+    RESULT["blocking"] = [RELAX, MAX_SUPER, MIN_BUCKET, GROWTH, AMALG]
 
     backend = jax.default_backend()
     RESULT["backend"] = backend
@@ -194,7 +199,7 @@ def main():
     sym = symmetrize_pattern(a)
     col_order = get_perm_c(opts, a, sym)
     sf = symbolic_factorize(sym, col_order, relax=RELAX,
-                            max_supernode=MAX_SUPER)
+                            max_supernode=MAX_SUPER, amalg_tol=AMALG)
     plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH)
     # numpy has no bf16, so that case stages through f32; every other
     # dtype keeps full precision.  The executor casts to DTYPE on upload;
@@ -219,6 +224,8 @@ def main():
     RESULT["offload"] = ex.offload
     RESULT["granularity"] = ex.granularity
     RESULT["n_kernels"] = ex.n_kernels
+    RESULT["executed_flops"] = ex.executed_flops
+    RESULT["padding_factor"] = round(ex.executed_flops / plan.flops, 2)
     avals = jnp.asarray(avals_np)
     thresh = jnp.asarray(thresh_np)
     out = ex(avals, thresh)
@@ -240,6 +247,8 @@ def main():
         RESULT["value"] = round(plan.flops / t_dev / 1e9, 2)
         RESULT["factor_seconds"] = t_dev
         RESULT["mfu_pct"] = round(100.0 * plan.flops / t_dev / PEAK_F32, 2)
+        if ex.last_dispatch_seconds is not None:
+            RESULT["dispatch_seconds"] = round(ex.last_dispatch_seconds, 4)
         _log(f"rep {rep}: {dt:.3f}s -> "
              f"{plan.flops / dt / 1e9:.1f} GFLOP/s")
     fronts, tiny = out

@@ -24,7 +24,6 @@ factorization timing used for the CPU-backend table in BASELINE.md.
 import json
 import os
 import sys
-import time
 
 import numpy as np
 
@@ -53,7 +52,6 @@ def main():
     backend = jax.default_backend()
     out_path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "docs", "baseline_fixtures_tpu.jsonl")
-    results = []
     for config, path, dtype in FIXTURES:
         a = read_matrix(path).tocsr()
         n = a.n_rows
@@ -66,12 +64,10 @@ def main():
         x, lu, stats, info = slu.gssvx(opts, a, b)
         # warm repetition: same pattern + row perm, cached executor
         stats2 = slu.Stats()
-        t0 = time.perf_counter()
         x, lu, stats2, info = slu.gssvx(
             slu.Options(factor_dtype=dtype,
                         fact=Fact.SamePattern_SameRowPerm),
             a, b, lu=lu, stats=stats2)
-        del t0
         resid = float(np.linalg.norm(b - a.matvec(x))
                       / np.linalg.norm(b))
         fsec = stats2.utime["FACT"]
@@ -81,11 +77,11 @@ def main():
                "residual": resid, "info": info,
                "refine_steps": stats2.refine_steps, "backend": backend}
         print(json.dumps(rec), flush=True)
-        results.append(rec)
-        assert info == 0 and resid < 1e-10, rec
-    with open(out_path, "a") as f:
-        for rec in results:
+        # persist each record as it is produced so a failing later config
+        # cannot discard an earlier measurement
+        with open(out_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
+        assert info == 0 and resid < 1e-10, rec
 
 
 if __name__ == "__main__":

@@ -1,39 +1,62 @@
 #!/bin/bash
 # On-hardware tuning sweep: runs bench.py over problem size x executor
-# granularity x blocking x dtype and appends one JSON line per config to
-# tune_results.jsonl.  Run when a real chip is reachable:
+# granularity x blocking x dtype/precision and appends one JSON line per
+# config to tune_results.jsonl.  Run when a real chip is reachable:
 #
 #   bash scripts/tune_tpu.sh [results_file]
 #
-# Each run reuses the persistent compile cache (.cache/jax), so later
-# configs that share kernel shapes start fast.  The bench's watchdog
-# guarantees a line per config even if a run degrades.
+# Ordered SMALLEST-FIRST so every row yields data even if the session dies
+# mid-sweep (round-2 lesson: a sweep that opens with the largest size can
+# time out in compile and produce zero rows).  Each run reuses the
+# persistent compile cache (.cache/jax), so later configs sharing kernel
+# shapes start fast; per-config watchdogs (BENCH_DEADLINE_S) are sized to
+# the problem, inside an outer timeout.
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-tune_results.jsonl}"
 run() {
+  local deadline="$1"; shift
   echo "== $* ==" >&2
-  env "$@" BENCH_REPS=3 timeout 1800 python bench.py >> "$OUT" 2>> "${OUT%.jsonl}.err"
-  echo >> "$OUT"
+  env "$@" BENCH_REPS=3 BENCH_DEADLINE_S="$deadline" \
+    timeout $((deadline + 120)) python bench.py \
+    >> "$OUT" 2>> "${OUT%.jsonl}.err"
 }
 
-# problem-size ladder at default blocking
-run BENCH_NX=32
-run BENCH_NX=40
-run BENCH_NX=48
+# problem-size ladder at default blocking — small sizes compile in minutes
+# and validate the chip before anything expensive starts
+run 600  BENCH_NX=16
+run 600  BENCH_NX=24
+run 900  BENCH_NX=32
+run 1200 BENCH_NX=40
+run 1500 BENCH_NX=48
 
-# dispatch granularity at the big size
-run BENCH_NX=48 BENCH_GRANULARITY=level
+# dispatch granularity (one program per elimination level; ~13 levels
+# after amalgamation)
+run 900  BENCH_NX=32 BENCH_GRANULARITY=level
+run 1500 BENCH_NX=48 BENCH_GRANULARITY=level
 
-# blocking variants (panel width vs batch count)
-run BENCH_NX=48 BENCH_RELAX=128 BENCH_MAXSUPER=512
-run BENCH_NX=48 BENCH_RELAX=512 BENCH_MAXSUPER=2048
+# amalgamation tolerance (the round-3 MFU lever) and padding ladder
+run 900  BENCH_NX=32 BENCH_AMALG=0
+run 900  BENCH_NX=32 BENCH_AMALG=1.5
+run 900  BENCH_NX=32 BENCH_GROWTH=1.2
+run 1500 BENCH_NX=48 BENCH_GROWTH=1.2
+
+# blocking variants (panel width cap)
+run 900  BENCH_NX=32 BENCH_MAXSUPER=512
+run 900  BENCH_NX=32 BENCH_MAXSUPER=2048
+
+# MXU pass count for the f32 Schur GEMMs (HIGH halves the passes; IR
+# absorbs the precision loss on well-conditioned systems)
+run 900  BENCH_NX=32 SLU_TPU_PRECISION=high
+run 1500 BENCH_NX=48 SLU_TPU_PRECISION=high
 
 # native-MXU-rate factors (IR recovers f64 residuals; more steps)
-run BENCH_NX=48 BENCH_DTYPE=bfloat16
+run 900  BENCH_NX=32 BENCH_DTYPE=bfloat16
 
-# past single-chip factor memory: host offload engages automatically
-run BENCH_NX=56
+# largest single-chip sizes (compact fronts; offload auto-engages if the
+# factor bytes outgrow HBM)
+run 1800 BENCH_NX=56
+run 2400 BENCH_NX=64
 
 grep -h '"value"' "$OUT" | python -c '
 import json, sys

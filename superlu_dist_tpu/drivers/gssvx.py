@@ -259,7 +259,7 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         else:
             sf = symbolic_factorize(sym, col_order, relax=options.relax,
                                     max_supernode=options.max_supernode,
-                                    stats=stats)
+                                    stats=stats, amalg_tol=options.amalg_tol)
     # phases are disjoint like the reference's PhaseType: the etree part
     # timed inside symbolic_factorize is carved out of SYMBFACT
     stats.utime["SYMBFACT"] -= stats.utime["ETREE"] - et0

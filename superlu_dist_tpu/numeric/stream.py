@@ -21,6 +21,7 @@ keys), not O(#groups).
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 import jax
@@ -33,9 +34,11 @@ from superlu_dist_tpu.numeric.factor import group_step
 _OFFLOAD_LAG = 8   # groups of factored panels allowed in flight device-side
 
 
-def _bucket_len(n: int, lo: int = 8) -> int:
-    """Next power of two (min lo) — pads arrays so shapes repeat."""
-    return max(lo, 1 << int(np.ceil(np.log2(max(n, 1)))))
+def _bucket_len(n: int, lo: int = 8, base: float = 2.0) -> int:
+    """Next power of `base` (min lo) — pads arrays so shapes repeat.
+    base=4 for index arrays whose padding costs only a cheap gather:
+    coarser rungs collapse more compile keys."""
+    return max(lo, int(base ** int(np.ceil(np.log(max(n, 1)) / np.log(base)))))
 
 
 def _pad_to(arr: np.ndarray, length: int, fill) -> np.ndarray:
@@ -134,11 +137,12 @@ class StreamExecutor:
                        and jax.default_backend() != "cpu" else "none")
         self.offload = offload
         self.last_profile = None   # filled when SLU_TPU_PROFILE is set
+        self.last_dispatch_seconds = None   # async-issue time of last call
         n_avals = len(plan.pattern_indices)
         self._steps = []
         for grp in plan.groups:
             b = _bucket_len(grp.batch, 1)
-            la = _bucket_len(len(grp.a_src))
+            la = _bucket_len(len(grp.a_src), lo=64, base=4.0)
             # batch padding: slot b-? -> identity fronts via ws=0; scatter
             # slots == b are dropped; gather sources past end fill 0
             a = (_pad_to(grp.a_slot, la, b), _pad_to(grp.a_flat, la, 0),
@@ -147,7 +151,7 @@ class StreamExecutor:
             child_arrs = []
             child_shapes = []
             for cs in grp.children:
-                c = _bucket_len(len(cs.child_off), 1)
+                c = _bucket_len(len(cs.child_off), 1, base=4.0)
                 rel = np.full((c, cs.ub), grp.m, dtype=np.int64)
                 rel[:len(cs.rel)] = cs.rel
                 child_arrs.extend([
@@ -165,6 +169,20 @@ class StreamExecutor:
         if self.granularity == "level":
             return len({g.level for g in self.plan.groups})
         return len({key for key, _, _, _ in self._steps})
+
+    @property
+    def executed_flops(self) -> float:
+        """Flops the device actually runs, bucket+batch padding included
+        (plan.flops is the structural count — the reference's ops[FACT]).
+        The ratio executed/structural is the padding overhead the MFU
+        tuning fights (the reference's analog is its GEMM padding trick,
+        dSchCompUdt-2Ddynamic.c:212-237)."""
+        tot = 0.0
+        for grp in self.plan.groups:
+            b = _bucket_len(grp.batch, 1)
+            w, u = grp.w, grp.u
+            tot += b * (2 / 3 * w ** 3 + 2 * w * w * u + 2 * w * u * u)
+        return tot
 
     def _level_fn(self, level, entries):
         """One jitted program running every group of `level` (index maps
@@ -227,12 +245,12 @@ class StreamExecutor:
         import os
         profile = bool(os.environ.get("SLU_TPU_PROFILE"))
         if profile:
-            import time
             self.last_profile = []
         if self.granularity == "level":
             return self._call_levels(avals, pool, thresh, profile)
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
+        t_issue0 = time.perf_counter()
         for gi, (key, a, child_arrs, nreal) in enumerate(self._steps):
             kern = _kernel(*key, self.mesh, self.pool_partition)
             if profile:
@@ -249,6 +267,11 @@ class StreamExecutor:
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
             self._emit_front(fronts, lp, up, nreal)
             tiny = tiny + t
+        # dispatch-gap instrumentation (the PROFlevel comm-split analog,
+        # pdgstrf.c:1930-1951): time spent ISSUING the async stream.  If
+        # this approaches the end-to-end factor time, the run is
+        # dispatch-bound (Python + transfer overhead), not compute-bound.
+        self.last_dispatch_seconds = time.perf_counter() - t_issue0
         return self._finalize_fronts(fronts), tiny
 
     def _emit_front(self, fronts, lp, up, nreal):
@@ -281,7 +304,6 @@ class StreamExecutor:
         """Level-granularity execution: one dispatch per elimination
         level (see __init__)."""
         import itertools
-        import time
         plan = self.plan
         fronts = []
         tiny = jnp.zeros((), jnp.int32)

@@ -25,6 +25,7 @@ u ≤ M−W):
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -32,6 +33,21 @@ from jax import lax
 from jax.scipy.linalg import solve_triangular
 
 _UNROLL = 16   # panel width factored by the unrolled column loop
+
+# MXU pass count for the f32 Schur GEMMs: HIGHEST = 6-pass bf16 (full f32
+# products, ~1/6 of bf16 peak), HIGH = 3-pass (~f32-mantissa-19), DEFAULT =
+# single-pass bf16.  f32 factors feed f64 iterative refinement, which
+# tolerates reduced factor precision at the cost of extra IR sweeps — the
+# HIGH tier doubles the MXU flop ceiling and is worth sweeping on hardware
+# (SLU_TPU_PRECISION=high bench run).
+_PRECISION_TIERS = {"default": lax.Precision.DEFAULT,
+                    "high": lax.Precision.HIGH,
+                    "highest": lax.Precision.HIGHEST}
+_prec_env = os.environ.get("SLU_TPU_PRECISION", "highest").strip().lower()
+if _prec_env not in _PRECISION_TIERS:
+    raise ValueError(f"SLU_TPU_PRECISION={_prec_env!r} — expected one of "
+                     f"{sorted(_PRECISION_TIERS)}")
+_PRECISION = _PRECISION_TIERS[_prec_env]
 
 
 def _fix_pivot(piv, thresh):
@@ -105,7 +121,7 @@ def lu_nopivot(a, thresh):
     f11, c1 = lu_nopivot(a11, thresh)
     u12 = solve_triangular(f11, a12, lower=True, unit_diagonal=True)
     l21 = solve_triangular(f11, a21.T, trans=1, lower=False).T
-    s = a22 - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    s = a22 - jnp.matmul(l21, u12, precision=_PRECISION)
     f22, c2 = lu_nopivot(s, thresh)
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, f22], axis=1)
@@ -120,7 +136,7 @@ def partial_front_factor(f, thresh, w):
         return f11, count
     u12 = solve_triangular(f11, f[:w, w:], lower=True, unit_diagonal=True)
     l21 = solve_triangular(f11, f[w:, :w].T, trans=1, lower=False).T
-    s = f[w:, w:] - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    s = f[w:, w:] - jnp.matmul(l21, u12, precision=_PRECISION)
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, s], axis=1)
     return jnp.concatenate([top, bot], axis=0), count
@@ -169,7 +185,7 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
                                                   unit_diagonal=True))(f11, a12)
     l21 = jax.vmap(lambda u_, b_: solve_triangular(u_, b_.T, trans=1,
                                                    lower=False).T)(f11, a21)
-    s = a22 - jnp.matmul(l21, u12, precision=lax.Precision.HIGHEST)
+    s = a22 - jnp.matmul(l21, u12, precision=_PRECISION)
     if front_sharding is not None:
         s = wsc(s, front_sharding)
     lpanel = jnp.concatenate([f11, l21], axis=1)

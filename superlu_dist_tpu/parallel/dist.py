@@ -71,6 +71,27 @@ class DistributedCSR:
         contrib = np.abs(self.data) * np.asarray(x)[self.indices]
         return np.bincount(rows, weights=contrib, minlength=self.m_loc)
 
+    def matvec_trans_local(self, x_global: np.ndarray,
+                           conj: bool = False) -> np.ndarray:
+        """This rank's full-length contribution to op(A)·x, op = Aᵀ/Aᴴ:
+        out[j] += v̄·x[i] over local entries (i, j, v).  Sum the ranks'
+        returns (tree all-reduce) to get op(A)·x — block rows of A are
+        block *columns* of op(A), so every rank touches all of out."""
+        rows = np.repeat(np.arange(self.m_loc), np.diff(self.indptr))
+        vals = np.conj(self.data) if conj else self.data
+        contrib = vals * np.asarray(x_global)[self.fst_row + rows]
+        out = np.zeros(self.n, dtype=np.result_type(contrib, np.float64))
+        np.add.at(out, self.indices, contrib)
+        return out
+
+    def abs_matvec_trans_local(self, x: np.ndarray) -> np.ndarray:
+        """Full-length contribution to |op(A)|·x (|Aᵀ| = |A|ᵀ = |Aᴴ|)."""
+        rows = np.repeat(np.arange(self.m_loc), np.diff(self.indptr))
+        contrib = np.abs(self.data) * np.asarray(x)[self.fst_row + rows]
+        out = np.zeros(self.n)
+        np.add.at(out, self.indices, contrib)
+        return out
+
 
 def distribute_rows(a: SparseCSR, nparts: int) -> list[DistributedCSR]:
     """Block-row partition of A (the dcreate_matrix scatter,

@@ -26,54 +26,72 @@ from superlu_dist_tpu.refine.ir import ITMAX
 
 
 def _pad_full(local: np.ndarray, fst_row: int, n: int) -> np.ndarray:
-    out = np.zeros(n)
+    out = np.zeros(n, dtype=np.result_type(local, np.float64))
     out[fst_row:fst_row + len(local)] = local
     return out
 
 
 def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
            x0: np.ndarray | None, solve_fn, itmax: int = ITMAX,
-           root: int = 0) -> np.ndarray:
-    """Collectively refine A·x = b (single RHS).
+           root: int = 0, trans=None) -> np.ndarray:
+    """Collectively refine op(A)·x = b (single RHS; op per `trans` —
+    NOTRANS/TRANS/CONJ like pdgssvx's trans dispatch; complex payloads
+    ride the f64 tree as re/im passes via TreeComm.*_any).
 
     tc       — this rank's TreeComm attachment.
     a_loc    — this rank's block rows of A (global column indices).
     b_loc    — this rank's block of b.
     x0       — initial solution (significant on the root; may be None on
                the others).
-    solve_fn — correction solver dx = A⁻¹ r; significant on the root only
-               (the factor owner — the reference's analog is that every
-               rank participates in pdgstrs, here the factors live with
-               the root process).
+    solve_fn — correction solver dx = op(A)⁻¹ r; significant on the root
+               only (the factor owner — the reference's analog is that
+               every rank participates in pdgstrs, here the factors live
+               with the root process).
 
     Returns the full refined x on every rank.
     """
+    from superlu_dist_tpu.utils.options import Trans
+    if trans is None:
+        trans = Trans.NOTRANS
     n = a_loc.n
     eps = float(np.finfo(np.float64).eps)
+    cplx = np.iscomplexobj(a_loc.data) or np.iscomplexobj(b_loc)
+    wdtype = np.complex128 if cplx else np.float64
 
     # x lives replicated (root broadcasts), like pdgsrfs's x updates
-    x = np.zeros(n) if x0 is None else np.asarray(x0, dtype=np.float64)
-    x = tc.bcast(x, root=root)
+    x = (np.zeros(n, dtype=wdtype) if x0 is None
+         else np.asarray(x0, dtype=wdtype))
+    x = tc.bcast_any(x, root=root)
 
     lstres = np.inf
     for _ in range(itmax):
-        # r = b − A·x, each rank its block rows; assemble by tree
-        # all-reduce of zero-padded blocks (the pdgsmv exchange analog)
-        r_loc = b_loc - a_loc.matvec_local(x)
-        r = tc.allreduce_sum(_pad_full(r_loc, a_loc.fst_row, n), root=root)
-        # componentwise backward error denominator |A|·|x| + |b|
-        den_loc = (a_loc.abs_matvec_local(np.abs(x)) + np.abs(b_loc))
-        den = tc.allreduce_sum(_pad_full(den_loc, a_loc.fst_row, n),
-                               root=root)
+        # r = b − op(A)·x as one all-reduce of per-rank contributions
+        # (the pdgsmv exchange analog).  NOTRANS: block rows are disjoint
+        # slots; TRANS/CONJ: block rows of A are block columns of op(A),
+        # so every rank contributes a full-length partial sum.
+        if trans == Trans.NOTRANS:
+            r_c = _pad_full(b_loc - a_loc.matvec_local(x),
+                            a_loc.fst_row, n)
+            den_c = _pad_full(a_loc.abs_matvec_local(np.abs(x))
+                              + np.abs(b_loc), a_loc.fst_row, n)
+        else:
+            conj = trans == Trans.CONJ
+            r_c = (_pad_full(b_loc, a_loc.fst_row, n)
+                   - a_loc.matvec_trans_local(x, conj=conj))
+            den_c = (a_loc.abs_matvec_trans_local(np.abs(x))
+                     + _pad_full(np.abs(b_loc), a_loc.fst_row, n))
+        r = tc.allreduce_sum_any(r_c, root=root)
+        # componentwise backward error denominator |op(A)|·|x| + |b|
+        den = tc.allreduce_sum_any(den_c, root=root)
         den = np.where(den > 0, den, 1.0)
         berr = float(np.max(np.abs(r) / den))
         if berr <= eps or berr >= lstres / 2.0:
             break
         lstres = berr
         # correction on the factor owner, broadcast to all
-        dx = np.zeros(n)
+        dx = np.zeros(n, dtype=wdtype)
         if tc.rank == root:
-            dx = np.asarray(solve_fn(r), dtype=np.float64)
-        dx = tc.bcast(dx, root=root)
+            dx = np.asarray(solve_fn(r), dtype=wdtype)
+        dx = tc.bcast_any(dx, root=root)
         x = x + dx
     return x

@@ -1,21 +1,30 @@
 """Multi-process expert driver over block-row distributed input.
 
 Capability analog of pdgssvx with NR_loc input (SRC/pdgssvx.c:505): every
-process holds a block of rows of A and of b (`DistributedCSR` — the
-NRformat_loc analog), and all of them receive the solution.
+process holds a block of rows of A and of B (`DistributedCSR` — the
+NRformat_loc analog), and all of them receive the solution.  Covers the
+reference driver surface: multiple right-hand sides (nrhs ≥ 1, X returned
+in B's shape), transpose solves (options.trans, pdgssvx.c's Trans
+dispatch), and complex matrices (the pzgssvx twin — complex payloads ride
+the f64 tree as re/im passes).
 
 TPU-native split: the analysis + factorization are single-address-space
 (they run where the accelerator is — rank 0), so the distributed input is
 first assembled there, exactly like the reference's
 pdCompRow_loc_to_CompCol_global gather before serial preprocessing
-(pdgssvx.c:775).  The gather/broadcast ride the shared-memory tree
-collectives (parallel/treecomm.py); refinement then runs distributed
+(pdgssvx.c:775).  This root-gather is the single-host fallback; when the
+participating processes share one jax.distributed world, the root's
+factorization itself runs sharded over the mesh spanning their devices
+(parallel/grid.gridinit_multihost + gssvx(grid=...)).  The
+gather/broadcast ride the shared-memory tree collectives
+(parallel/treecomm.py); refinement then runs distributed
 (parallel/pgsrfs.py) so the residual work stays with the row owners —
 the reference's pdgsrfs/pdgsmv shape.
 
-Payloads larger than the tree domain's max_len stream through in chunks;
-integer index arrays travel as f64 (exact below 2^53 — matrix dimensions
-and nnz counts are far below).
+Payloads larger than the tree domain's max_len stream through in chunks
+(TreeComm.bcast_any/reduce_sum_any); integer index arrays travel on the
+f64 mantissa (exact below 2^53 — dimensions and nnz counts are far
+below).
 """
 
 from __future__ import annotations
@@ -27,28 +36,6 @@ from superlu_dist_tpu.parallel.treecomm import TreeComm
 from superlu_dist_tpu.sparse.formats import SparseCSR
 
 
-def _chunked_reduce(tc: TreeComm, full: np.ndarray, root: int):
-    """Sum-reduce a long vector in max_len chunks (every rank calls with
-    its zero-padded contribution; disjoint supports => concatenation)."""
-    out = np.empty_like(full)
-    step = tc.max_len
-    for lo in range(0, len(full), step):
-        hi = min(lo + step, len(full))
-        out[lo:hi] = tc.reduce_sum(full[lo:hi].astype(np.float64),
-                                   root=root)[:hi - lo]
-    return out
-
-
-def _chunked_bcast(tc: TreeComm, full: np.ndarray, root: int):
-    out = np.empty(len(full))
-    step = tc.max_len
-    for lo in range(0, len(full), step):
-        hi = min(lo + step, len(full))
-        out[lo:hi] = tc.bcast(full[lo:hi].astype(np.float64),
-                              root=root)[:hi - lo]
-    return out
-
-
 def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
                        root: int = 0) -> SparseCSR | None:
     """Assemble the global CSR on `root` from every rank's block rows —
@@ -58,7 +45,7 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
     # global nnz offsets: every rank's count, allreduced
     counts = np.zeros(tc.n_ranks)
     counts[tc.rank] = a_loc.nnz_loc
-    counts = tc.allreduce_sum(counts, root=root)
+    counts = tc.allreduce_sum_any(counts, root=root)
     offs = np.zeros(tc.n_ranks + 1, dtype=np.int64)
     offs[1:] = np.cumsum(counts).astype(np.int64)
     total = int(offs[-1])
@@ -68,13 +55,16 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
     rowcnt = np.zeros(n)
     rowcnt[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = \
         np.diff(a_loc.indptr)
-    rowcnt = _chunked_reduce(tc, rowcnt, root)
+    rowcnt = tc.reduce_sum_any(rowcnt, root=root)
     idx = np.zeros(total)
     idx[lo:lo + a_loc.nnz_loc] = a_loc.indices
-    idx = _chunked_reduce(tc, idx, root)
-    vals = np.zeros(total)
+    idx = tc.reduce_sum_any(idx, root=root)
+    vdtype = (np.complex128 if np.issubdtype(np.asarray(a_loc.data).dtype,
+                                             np.complexfloating)
+              else np.float64)
+    vals = np.zeros(total, dtype=vdtype)
     vals[lo:lo + a_loc.nnz_loc] = a_loc.data
-    vals = _chunked_reduce(tc, vals, root)
+    vals = tc.reduce_sum_any(vals, root=root)
 
     if tc.rank != root:
         return None
@@ -87,40 +77,66 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
 
 def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
            b_loc: np.ndarray, root: int = 0):
-    """Collectively solve A·x = b from block-row distributed input.
+    """Collectively solve op(A)·X = B from block-row distributed input.
 
-    Returns (x_full, info) on every rank.  Single RHS.  The root runs the
-    full gssvx pipeline (with its accelerator, if any); refinement is
-    distributed across the row owners (pgsrfs).
+    b_loc: (m_loc,) or (m_loc, nrhs) — this rank's block rows of B.
+    Returns (x, info) on every rank, x of shape (n,) or (n, nrhs)
+    matching b_loc.  options.trans selects op(A) (NOTRANS/TRANS/CONJ,
+    the reference's pdgssvx trans dispatch); complex A/b take the
+    pzgssvx path.
     """
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
-    from superlu_dist_tpu.utils.options import IterRefine
+    from superlu_dist_tpu.utils.options import IterRefine, Trans
     import dataclasses
 
     n = a_loc.n
-    a_root = gather_distributed(tc, a_loc, root=root)
-    b_full = np.zeros(n)
-    b_full[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = b_loc
-    b_full = _chunked_reduce(tc, b_full, root)
+    b_loc = np.asarray(b_loc)
+    one_d = b_loc.ndim == 1
+    b2 = b_loc.reshape(b_loc.shape[0], -1)
+    nrhs = b2.shape[1]
+    complex_in = (np.issubdtype(np.asarray(a_loc.data).dtype,
+                                np.complexfloating)
+                  or np.issubdtype(b2.dtype, np.complexfloating))
+    wdtype = np.complex128 if complex_in else np.float64
 
-    x0 = np.zeros(n)
+    a_root = gather_distributed(tc, a_loc, root=root)
+    b_full = np.zeros((n, nrhs), dtype=wdtype)
+    b_full[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = b2
+    b_full = tc.reduce_sum_any(b_full, root=root)
+
+    x0 = np.zeros((n, nrhs), dtype=wdtype)
     info = np.zeros(1)
     solve_fn = None
     if tc.rank == root:
         # refinement happens distributed below — root factors only
         opts0 = dataclasses.replace(options,
                                     iter_refine=IterRefine.NOREFINE)
-        x_r, lu, stats, info_r = gssvx(opts0, a_root, b_full)
+        x_r, lu, stats, info_r = gssvx(
+            opts0, a_root, b_full if nrhs > 1 else b_full[:, 0])
         info[0] = float(info_r)
         if info_r == 0:
-            x0 = np.asarray(x_r, dtype=np.float64)
-            solve_fn = lu.solve_factored
-    info = tc.bcast(info, root=root)
+            x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
+            trans = getattr(options, "trans", Trans.NOTRANS)
+            if trans == Trans.NOTRANS:
+                solve_fn = lu.solve_factored
+            else:
+                conj = trans == Trans.CONJ
+                solve_fn = (lambda r:
+                            lu.solve_factored_trans(r, conj=conj))
+    info = tc.bcast_any(info, root=root)
     if int(info[0]) != 0:
         return None, int(info[0])
-    x0 = _chunked_bcast(tc, x0, root)
+    x0 = tc.bcast_any(x0, root=root)
     if options.iter_refine == IterRefine.NOREFINE:
-        return x0, 0
-    x = pgsrfs(tc, a_loc, b_loc, x0, solve_fn, root=root)
-    return x, 0
+        x = x0
+    else:
+        # per-RHS distributed refinement (the reference's pdgsrfs loops
+        # RHS columns with per-RHS berr, pdgsrfs.c:205-235)
+        trans = getattr(options, "trans", Trans.NOTRANS)
+        cols = []
+        for j in range(nrhs):
+            cols.append(pgsrfs(tc, a_loc, b2[:, j], x0[:, j], solve_fn,
+                               root=root, trans=trans))
+        x = np.stack(cols, axis=1)
+    return (x[:, 0] if one_d else x), 0

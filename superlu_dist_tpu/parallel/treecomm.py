@@ -92,6 +92,45 @@ class TreeComm:
         buf = self.reduce_sum(buf, root)
         return self.bcast(buf, root)
 
+    # ---- typed payload layer -------------------------------------------
+    # The native segment is f64 (the reference's trees are likewise typed,
+    # TreeBcast_slu.hpp:34).  These wrappers carry any shape/dtype payload:
+    # complex splits into re/im passes, integers ride the f64 mantissa
+    # (exact below 2^53 — dimensions/indices are far below), and payloads
+    # longer than max_len stream through in chunks.
+
+    def _f64_op(self, flat: np.ndarray, root: int, op) -> np.ndarray:
+        out = np.empty(flat.size, dtype=np.float64)
+        step = self.max_len
+        for lo in range(0, flat.size, step):
+            hi = min(lo + step, flat.size)
+            out[lo:hi] = op(np.ascontiguousarray(flat[lo:hi],
+                                                 dtype=np.float64),
+                            root=root)[:hi - lo]
+        return out
+
+    def _payload_op(self, arr: np.ndarray, root: int, op) -> np.ndarray:
+        arr = np.asarray(arr)
+        flat = arr.reshape(-1)
+        if np.issubdtype(arr.dtype, np.complexfloating):
+            re = self._f64_op(flat.real, root, op)
+            im = self._f64_op(flat.imag, root, op)
+            out = (re + 1j * im).astype(arr.dtype)
+        else:
+            out = self._f64_op(flat, root, op).astype(arr.dtype)
+        return out.reshape(arr.shape)
+
+    def bcast_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        """Broadcast a payload of any dtype/shape (returns a new array)."""
+        return self._payload_op(arr, root, self.bcast)
+
+    def reduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        """Sum-reduce a payload of any dtype/shape onto root."""
+        return self._payload_op(arr, root, self.reduce_sum)
+
+    def allreduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
+        return self._payload_op(arr, root, self.allreduce_sum)
+
     def close(self, unlink: bool | None = None):
         if self._h:
             if unlink is None:

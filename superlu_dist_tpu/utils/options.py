@@ -78,6 +78,13 @@ def _env_int(name: str, default: int) -> int:
         return default
 
 
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, ValueError):
+        return default
+
+
 @dataclasses.dataclass
 class Options:
     """Runtime options (analog of superlu_dist_options_t).
@@ -115,6 +122,13 @@ class Options:
     # --- TPU-native knobs -----------------------------------------------------
     factor_dtype: str | None = None   # None => float32 on TPU, float64 on CPU
     ir_dtype: str = "float64"         # residual precision for refinement
+    # fill-tolerant supernode amalgamation (symbfact.amalgamate_supernodes):
+    # merged-front flops may grow up to this factor per merge.  The MXU
+    # wants wide pivots; the measured padding/dispatch win dwarfs the
+    # ≤ tol structural-flop cost.  0 disables (reference-style zero-fill
+    # supernodes + leaf relaxation only).
+    amalg_tol: float = dataclasses.field(
+        default_factory=lambda: _env_float("SLU_TPU_AMALG_TOL", 1.2))
     bucket_growth: float = 1.5        # geometric padding factor for front
                                       # size buckets (static-shape batching)
     min_bucket: int = dataclasses.field(   # smallest padded front dimension

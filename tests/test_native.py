@@ -106,9 +106,10 @@ def test_threaded_symbolic_same_fill(nthreads):
     for sym in _cases() + [symmetrize_pattern(poisson3d(8))]:
         n = sym.n_rows
         order = np.arange(n)
-        ser = symbolic_factorize(sym, order, relax=4, max_supernode=64)
+        ser = symbolic_factorize(sym, order, relax=4, max_supernode=64,
+                                 amalg_tol=0)
         par = symbolic_factorize(sym, order, relax=4, max_supernode=64,
-                                 nthreads=nthreads)
+                                 nthreads=nthreads, amalg_tol=0)
         assert np.array_equal(_per_column_fill(ser), _per_column_fill(par))
         assert par.nnz_L >= ser.nnz_L   # fewer merges => never less padding
 
@@ -155,8 +156,10 @@ def test_mmd_scales_beyond_python():
     n = sym.n_rows
     order = native.mmd(n, sym.indptr, sym.indices)
     assert sorted(order) == list(range(n))
-    sf = symbolic_factorize(sym, order, relax=1, max_supernode=64)
-    nat = symbolic_factorize(sym, np.arange(n), relax=1, max_supernode=64)
+    sf = symbolic_factorize(sym, order, relax=1, max_supernode=64,
+                            amalg_tol=0)
+    nat = symbolic_factorize(sym, np.arange(n), relax=1, max_supernode=64,
+                             amalg_tol=0)
     assert sf.nnz_L < 0.5 * nat.nnz_L             # real fill reduction
 
 
@@ -167,7 +170,8 @@ def test_mlnd_is_valid_permutation_and_beats_bfs():
     assert sorted(order) == list(range(n))
 
     def fill(o):
-        return symbolic_factorize(a, o, relax=1, max_supernode=64).nnz_L
+        return symbolic_factorize(a, o, relax=1, max_supernode=64,
+                                  amalg_tol=0).nnz_L
 
     # the multilevel ordering must clearly beat the BFS level-set fallback
     assert fill(order) < fill(bfs_nd(n, a.indptr, a.indices))
@@ -182,7 +186,8 @@ def test_mlnd_fill_quality_vs_scipy_colamd():
     sym = symmetrize_pattern(a0)
     n = sym.n_rows
     order = native.mlnd(n, sym.indptr, sym.indices)
-    sf = symbolic_factorize(sym, order, relax=1, max_supernode=64)
+    sf = symbolic_factorize(sym, order, relax=1, max_supernode=64,
+                            amalg_tol=0)
     data = np.where(sym.data == 0, 1e-8, sym.data)
     A = sp.csr_matrix((data, sym.indices, sym.indptr), shape=(n, n)).tocsc()
     lu = spl.splu(A, permc_spec="COLAMD",
