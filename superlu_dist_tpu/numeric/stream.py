@@ -29,6 +29,7 @@ import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.numeric.factor import group_step
+from superlu_dist_tpu.symbolic.symbfact import _front_flops
 
 
 _OFFLOAD_LAG = 8   # groups of factored panels allowed in flight device-side
@@ -177,12 +178,8 @@ class StreamExecutor:
         The ratio executed/structural is the padding overhead the MFU
         tuning fights (the reference's analog is its GEMM padding trick,
         dSchCompUdt-2Ddynamic.c:212-237)."""
-        tot = 0.0
-        for grp in self.plan.groups:
-            b = _bucket_len(grp.batch, 1)
-            w, u = grp.w, grp.u
-            tot += b * (2 / 3 * w ** 3 + 2 * w * w * u + 2 * w * u * u)
-        return tot
+        return float(sum(_bucket_len(g.batch, 1) * _front_flops(g.w, g.u)
+                         for g in self.plan.groups))
 
     def _level_fn(self, level, entries):
         """One jitted program running every group of `level` (index maps
@@ -260,8 +257,7 @@ class StreamExecutor:
                 jax.block_until_ready(lp)
                 (b, m, w, u), _, _, _, _ = key
                 grp = plan.groups[gi]
-                gflop = (2 / 3 * w**3 + 2 * w * w * u
-                         + 2 * w * u * u) * grp.batch / 1e9
+                gflop = float(_front_flops(w, u)) * grp.batch / 1e9
                 self.last_profile.append({
                     "level": grp.level, "batch": b, "m": m, "w": w, "u": u,
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
@@ -319,8 +315,7 @@ class StreamExecutor:
             tiny = tiny + t
             if profile:
                 jax.block_until_ready(outs)
-                gflop = sum((2 / 3 * g.w**3 + 2 * g.w * g.w * g.u
-                             + 2 * g.w * g.u * g.u) * g.batch
+                gflop = sum(float(_front_flops(g.w, g.u)) * g.batch
                             for g, _ in chunk) / 1e9
                 # a LEVEL aggregate, not one kernel's shape: m/w/u are
                 # maxima over the level's heterogeneous groups

@@ -43,11 +43,18 @@ _UNROLL = 16   # panel width factored by the unrolled column loop
 _PRECISION_TIERS = {"default": lax.Precision.DEFAULT,
                     "high": lax.Precision.HIGH,
                     "highest": lax.Precision.HIGHEST}
-_prec_env = os.environ.get("SLU_TPU_PRECISION", "highest").strip().lower()
-if _prec_env not in _PRECISION_TIERS:
-    raise ValueError(f"SLU_TPU_PRECISION={_prec_env!r} — expected one of "
-                     f"{sorted(_PRECISION_TIERS)}")
-_PRECISION = _PRECISION_TIERS[_prec_env]
+
+
+@functools.lru_cache(maxsize=None)
+def _precision():
+    """Resolved lazily at first kernel build (not import) so a typo'd env
+    var fails the matmul path with a pointed error instead of making the
+    whole package unimportable for host-only work."""
+    name = os.environ.get("SLU_TPU_PRECISION", "highest").strip().lower()
+    if name not in _PRECISION_TIERS:
+        raise ValueError(f"SLU_TPU_PRECISION={name!r} — expected one of "
+                         f"{sorted(_PRECISION_TIERS)}")
+    return _PRECISION_TIERS[name]
 
 
 def _fix_pivot(piv, thresh):
@@ -121,7 +128,7 @@ def lu_nopivot(a, thresh):
     f11, c1 = lu_nopivot(a11, thresh)
     u12 = solve_triangular(f11, a12, lower=True, unit_diagonal=True)
     l21 = solve_triangular(f11, a21.T, trans=1, lower=False).T
-    s = a22 - jnp.matmul(l21, u12, precision=_PRECISION)
+    s = a22 - jnp.matmul(l21, u12, precision=_precision())
     f22, c2 = lu_nopivot(s, thresh)
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, f22], axis=1)
@@ -136,7 +143,7 @@ def partial_front_factor(f, thresh, w):
         return f11, count
     u12 = solve_triangular(f11, f[:w, w:], lower=True, unit_diagonal=True)
     l21 = solve_triangular(f11, f[w:, :w].T, trans=1, lower=False).T
-    s = f[w:, w:] - jnp.matmul(l21, u12, precision=_PRECISION)
+    s = f[w:, w:] - jnp.matmul(l21, u12, precision=_precision())
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, s], axis=1)
     return jnp.concatenate([top, bot], axis=0), count
@@ -185,7 +192,7 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
                                                   unit_diagonal=True))(f11, a12)
     l21 = jax.vmap(lambda u_, b_: solve_triangular(u_, b_.T, trans=1,
                                                    lower=False).T)(f11, a21)
-    s = a22 - jnp.matmul(l21, u12, precision=_PRECISION)
+    s = a22 - jnp.matmul(l21, u12, precision=_precision())
     if front_sharding is not None:
         s = wsc(s, front_sharding)
     lpanel = jnp.concatenate([f11, l21], axis=1)

@@ -93,7 +93,10 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     n = a_loc.n
     b_loc = np.asarray(b_loc)
     one_d = b_loc.ndim == 1
-    b2 = b_loc.reshape(b_loc.shape[0], -1)
+    # NOT reshape(m_loc, -1): on an empty trailing block (m_loc == 0,
+    # legitimate from distribute_rows' ceil stepping) reshape(0, -1)
+    # raises and the surviving ranks would deadlock in the collectives
+    b2 = b_loc[:, None] if one_d else b_loc
     nrhs = b2.shape[1]
     complex_in = (np.issubdtype(np.asarray(a_loc.data).dtype,
                                 np.complexfloating)

@@ -18,6 +18,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"   # for any subprocesses
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# the dryrun's n=1e5 pool-partition phase duplicates
+# tests/test_pool_partition.py (~4 compile-minutes); run it only in the
+# driver's standalone dryrun, not again inside the suite
+os.environ.setdefault("SLU_TPU_DRYRUN_BIG", "0")
 
 import jax
 
