@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Measure the df64 (double-float) factorization cost ratio vs f32 on the
+real accelerator — PLAN.md §3/§4: the VPU-emulated ~2^-48 path is expected
+at ~20-30 f32 flops per MAC; this pins the measured ratio and the df64
+residual with refinement off (raw factor quality).
+
+Prints one JSON line per size and appends to docs/df64_cost_tpu.jsonl.
+Warm timings (executors cached, SamePattern tier).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    import jax
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), ".cache", "jax"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    import jax.numpy as jnp
+
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    from superlu_dist_tpu.numeric.df64_factor import get_df64_executor
+    from superlu_dist_tpu.ops.df64 import df64_from_f64
+
+    backend = jax.default_backend()
+    out_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "df64_cost_tpu.jsonl")
+    sizes = tuple(int(s) for s in
+                  os.environ.get("DF64_NX", "12,16,20").split(","))
+    for nx in sizes:
+        a = poisson3d(nx)
+        n = a.n_rows
+        sym = symmetrize_pattern(a)
+        col_order = get_perm_c(Options(), a, sym)
+        sf = symbolic_factorize(sym, col_order, relax=256,
+                                max_supernode=1024)
+        plan = build_plan(sf, min_bucket=32, growth=1.3)
+        avals64 = sym.data[sf.value_perm].astype(np.float64)
+        thresh = np.sqrt(np.finfo(np.float32).eps) * a.norm_max()
+
+        ex32 = StreamExecutor(plan, "float32")
+        a32 = jnp.asarray(avals64, jnp.float32)
+        t32 = jnp.asarray(thresh, jnp.float32)
+        out = ex32(a32, t32)
+        jax.block_until_ready(out[0])
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = ex32(a32, t32)
+            jax.block_until_ready(out[0])
+            reps.append(time.perf_counter() - t0)
+        f32_s = min(reps)
+
+        exd = get_df64_executor(plan)
+        ah, al = df64_from_f64(jnp.asarray(avals64))
+        outd = exd(ah, al, jnp.asarray(thresh, jnp.float32))
+        jax.block_until_ready(outd[0])
+        reps = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            outd = exd(ah, al, jnp.asarray(thresh, jnp.float32))
+            jax.block_until_ready(outd[0])
+            reps.append(time.perf_counter() - t0)
+        df64_s = min(reps)
+
+        rec = {"n": n, "backend": backend,
+               "f32_factor_seconds": round(f32_s, 5),
+               "df64_factor_seconds": round(df64_s, 5),
+               "cost_ratio": round(df64_s / max(f32_s, 1e-12), 2),
+               "flops": plan.flops}
+        print(json.dumps(rec), flush=True)
+        with open(out_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -54,9 +54,14 @@ run 1500 BENCH_NX=48 SLU_TPU_PRECISION=high
 run 900  BENCH_NX=32 BENCH_DTYPE=bfloat16
 
 # largest single-chip sizes (compact fronts; offload auto-engages if the
-# factor bytes outgrow HBM)
+# factor bytes outgrow HBM).  NX=80 is n=512,000 — the BASELINE config-4
+# class pushed as far as one chip + host offload goes: pool 8.9 GB +
+# fronts 5.8 GB ~ 14.7 GB padded f32, so the factor panels are forced to
+# stream to host RAM to leave transient headroom.
 run 1800 BENCH_NX=56
 run 2400 BENCH_NX=64
+run 3000 BENCH_NX=72 SLU_TPU_FRONT_BYTES_LIMIT=4000000000
+run 3600 BENCH_NX=80 SLU_TPU_FRONT_BYTES_LIMIT=4000000000
 
 grep -h '"value"' "$OUT" | python -c '
 import json, sys
