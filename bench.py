@@ -283,6 +283,11 @@ def main():
                                     lu.solve_factored)
         RESULT["residual"] = float(np.linalg.norm(b - a.matvec(x))
                                    / max(np.linalg.norm(b), 1e-300))
+        # warm solve timing (the reference's solve Mflops line,
+        # SRC/util.c:521-529; flops ~ 4*nnz(L) per solve)
+        t0 = time.perf_counter()
+        lu.solve_factored(b)
+        RESULT["solve_seconds"] = round(time.perf_counter() - t0, 5)
         solve_path = ("device" if lu.solve_path == "auto"
                       and backend != "cpu" and not numeric.on_host
                       else "host")
