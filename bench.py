@@ -216,11 +216,35 @@ def main():
          f"flops={plan.flops / 1e9:.0f} GF")
 
     RESULT["phase"] = "factor-compile"
-    # BENCH_GRANULARITY=level fuses each elimination level into one
-    # dispatch (fewer, bigger XLA programs) — for dispatch-bound runs
-    ex = StreamExecutor(plan, DTYPE,
-                        granularity=os.environ.get("BENCH_GRANULARITY",
-                                                   "group"))
+    # BENCH_GRANULARITY: "group" (one kernel per shape key, streamed),
+    # "level" (one program per elimination level), or "fused" (the WHOLE
+    # factorization as one XLA program — viable again now that
+    # amalgamation leaves ~45 groups; zero dispatch overhead, XLA
+    # schedules across groups)
+    gran = os.environ.get("BENCH_GRANULARITY", "group")
+    if gran == "fused":
+        from superlu_dist_tpu.numeric.factor import make_factor_fn
+
+        class _Fused:
+            offload = "none"
+            granularity = "fused"
+            n_kernels = 1
+            last_profile = None
+            last_dispatch_seconds = None
+
+            def __init__(self):
+                from superlu_dist_tpu.symbolic.symbfact import _front_flops
+                self._fn = make_factor_fn(plan, DTYPE)
+                # the fused path keeps real batch sizes (no pow-2 pad)
+                self.executed_flops = float(sum(
+                    g.batch * _front_flops(g.w, g.u) for g in plan.groups))
+
+            def __call__(self, avals, thresh):
+                return self._fn(avals, thresh)
+
+        ex = _Fused()
+    else:
+        ex = StreamExecutor(plan, DTYPE, granularity=gran)
     RESULT["offload"] = ex.offload
     RESULT["granularity"] = ex.granularity
     RESULT["n_kernels"] = ex.n_kernels

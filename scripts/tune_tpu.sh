@@ -30,10 +30,14 @@ run 900  BENCH_NX=32
 run 1200 BENCH_NX=40
 run 1500 BENCH_NX=48
 
-# dispatch granularity (one program per elimination level; ~13 levels
-# after amalgamation)
+# dispatch granularity: level = one program per elimination level (~13
+# after amalgamation); fused = the whole factorization as ONE XLA
+# program (zero dispatch overhead, no batch padding — viable again at
+# ~45 groups)
 run 900  BENCH_NX=32 BENCH_GRANULARITY=level
 run 1500 BENCH_NX=48 BENCH_GRANULARITY=level
+run 1200 BENCH_NX=32 BENCH_GRANULARITY=fused
+run 1800 BENCH_NX=48 BENCH_GRANULARITY=fused
 
 # amalgamation tolerance (the round-3 MFU lever) and padding ladder
 run 900  BENCH_NX=32 BENCH_AMALG=0

@@ -75,6 +75,12 @@ def _load():
                 ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64, _I64,
                 _I64, ctypes.POINTER(_I64)]
             lib.slu_free_i64.argtypes = [_I64]
+            lib.slu_amalgamate.restype = ctypes.c_int64
+            lib.slu_amalgamate.argtypes = [
+                ctypes.c_int64, ctypes.c_int64, _I64, _I64, _I64,
+                ctypes.c_double, ctypes.c_int64, ctypes.c_int64,
+                ctypes.c_double, _I64, _I64, _I64, _I64, _I64,
+                ctypes.POINTER(_I64)]
             lib.slu_mc64.restype = ctypes.c_int
             lib.slu_mc64.argtypes = [ctypes.c_int64, _I64, _I64, _F64,
                                      _I64, _F64, _F64]
@@ -183,6 +189,43 @@ def symbolic(n: int, indptr, indices, parent, relax: int, max_supernode: int,
     lib.slu_free_i64(rows_data_p)
     return (sn_start[:ns + 1].copy(), col_to_sn, sn_parent[:ns].copy(),
             sn_level[:ns].copy(), rows_ptr[:ns + 1].copy(), rows_data)
+
+
+def amalgamate(n: int, sn_start, rows_ptr, rows_data, tol: float,
+               max_width: int, narrow: int, hard_tol: float):
+    """Native fill-tolerant supernode amalgamation (twin of
+    symbfact.amalgamate_supernodes).  Takes/returns structures in the
+    `symbolic` output protocol; returns (sn_start, col_to_sn, sn_parent,
+    sn_level, rows_ptr, rows_data) or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    sn_start = _as_i64(sn_start)
+    rows_ptr = _as_i64(rows_ptr)
+    rows_data = _as_i64(rows_data)
+    ns = len(sn_start) - 1
+    o_sn_start = np.empty(n + 1, dtype=np.int64)
+    o_col_to_sn = np.empty(n, dtype=np.int64)
+    o_sn_parent = np.empty(max(ns, 1), dtype=np.int64)
+    o_sn_level = np.empty(max(ns, 1), dtype=np.int64)
+    o_rows_ptr = np.empty(n + 1, dtype=np.int64)
+    o_rows_data_p = _I64()
+    k = lib.slu_amalgamate(n, ns, _ptr_i64(sn_start), _ptr_i64(rows_ptr),
+                           _ptr_i64(rows_data), float(tol), int(max_width),
+                           int(narrow), float(hard_tol),
+                           _ptr_i64(o_sn_start), _ptr_i64(o_col_to_sn),
+                           _ptr_i64(o_sn_parent), _ptr_i64(o_sn_level),
+                           _ptr_i64(o_rows_ptr),
+                           ctypes.byref(o_rows_data_p))
+    if k < 0:
+        return None
+    total = int(o_rows_ptr[k])
+    out_rows = np.ctypeslib.as_array(o_rows_data_p,
+                                     shape=(max(total, 1),))[:total].copy()
+    lib.slu_free_i64(o_rows_data_p)
+    return (o_sn_start[:k + 1].copy(), o_col_to_sn,
+            o_sn_parent[:k].copy(), o_sn_level[:k].copy(),
+            o_rows_ptr[:k + 1].copy(), out_rows)
 
 
 def mc64(n: int, indptr, indices, absval):

@@ -383,6 +383,121 @@ i64 slu_symbolic_mt(i64 n, const i64* indptr, const i64* indices,
 void slu_free_i64(i64* p) { std::free(p); }
 
 // ---------------------------------------------------------------------------
+// Fill-tolerant supernode amalgamation — native twin of
+// symbolic/symbfact.py:amalgamate_supernodes (the TPU-first whole-tree
+// extension of the reference's leaf-only relax_snode, SRC/symbfact.c:224).
+// Greedy merge of the column-adjacent rightmost descendant path, each merge
+// tested against the constituents' ORIGINAL front flops (globally bounded
+// growth).  Inputs are a symbolic partition in the slu_symbolic output
+// protocol; outputs use the same protocol (o_rows_data malloc'd here, freed
+// by the caller via slu_free_i64).  Returns the new supernode count, or -1.
+// ---------------------------------------------------------------------------
+static double front_flops_d(double w, double u) {
+  return 2.0 / 3.0 * w * w * w + 2.0 * w * w * u + 2.0 * w * u * u;
+}
+
+i64 slu_amalgamate(i64 n, i64 ns, const i64* sn_start, const i64* rows_ptr,
+                   const i64* rows_data, double tol, i64 max_width,
+                   i64 narrow, double hard_tol, i64* o_sn_start,
+                   i64* o_col_to_sn, i64* o_sn_parent, i64* o_sn_level,
+                   i64* o_rows_ptr, i64** o_rows_data) {
+  if (n < 0 || ns < 0) return -1;
+  HeapScope heap_scope;
+  std::vector<i64> first(ns), end(ns);
+  std::vector<std::vector<i64>> rows(ns);
+  for (i64 s = 0; s < ns; ++s) {
+    first[s] = sn_start[s];
+    end[s] = sn_start[s + 1];
+    rows[s].assign(rows_data + rows_ptr[s], rows_data + rows_ptr[s + 1]);
+  }
+  std::vector<i64> c2s(n);
+  for (i64 s = 0; s < ns; ++s)
+    for (i64 j = first[s]; j < end[s]; ++j) c2s[j] = s;
+  std::vector<i64> rep(ns);
+  for (i64 s = 0; s < ns; ++s) rep[s] = s;
+  auto find = [&](i64 s) {
+    while (rep[s] != s) { rep[s] = rep[rep[s]]; s = rep[s]; }
+    return s;
+  };
+  std::vector<i64> by_end(n + 1, -1);
+  for (i64 s = 0; s < ns; ++s) by_end[end[s]] = s;
+  std::vector<double> base(ns);
+  for (i64 s = 0; s < ns; ++s)
+    base[s] = front_flops_d((double)(end[s] - first[s]),
+                            (double)rows[s].size());
+  std::vector<char> alive(ns, 1);
+  std::vector<i64> merged;
+  for (i64 p = 0; p < ns; ++p) {
+    if (!alive[p]) continue;
+    for (;;) {
+      i64 c = by_end[first[p]];
+      if (c < 0) break;
+      c = find(c);
+      if (!alive[c]) break;
+      const auto& rc = rows[c];
+      if (rc.empty()) break;
+      if (find(c2s[rc[0]]) != p) break;
+      i64 w_m = (end[c] - first[c]) + (end[p] - first[p]);
+      if (w_m > max_width) break;
+      const i64* lo = std::lower_bound(rc.data(), rc.data() + rc.size(),
+                                       end[p]);
+      merged.clear();
+      std::set_union(lo, rc.data() + rc.size(), rows[p].begin(),
+                     rows[p].end(), std::back_inserter(merged));
+      double fl = front_flops_d((double)w_m, (double)merged.size());
+      double budget = base[p] + base[c];
+      if (!(fl <= tol * budget ||
+            (w_m <= narrow && fl <= hard_tol * budget)))
+        break;
+      by_end[first[p]] = -1;
+      first[p] = first[c];
+      rows[p].swap(merged);
+      alive[c] = 0;
+      rep[c] = p;
+      base[p] = budget;
+    }
+  }
+  // compact to live supernodes (column order is preserved: live first[]
+  // ascend because merges only extend a supernode downward); parents are
+  // reconstructed from o_col_to_sn[first row] below, so no old->new map
+  // is needed
+  i64 k = 0;
+  i64 total = 0;
+  for (i64 s = 0; s < ns; ++s)
+    if (alive[s]) {
+      ++k;
+      total += (i64)rows[s].size();
+    }
+  i64* rd = (i64*)std::malloc(sizeof(i64) * (size_t)std::max<i64>(total, 1));
+  if (!rd) return -1;
+  i64 off = 0, i = 0;
+  for (i64 s = 0; s < ns; ++s) {
+    if (!alive[s]) continue;
+    o_sn_start[i] = first[s];
+    o_rows_ptr[i] = off;
+    std::copy(rows[s].begin(), rows[s].end(), rd + off);
+    off += (i64)rows[s].size();
+    for (i64 j = first[s]; j < end[s]; ++j) o_col_to_sn[j] = i;
+    ++i;
+  }
+  o_sn_start[k] = n;
+  o_rows_ptr[k] = off;
+  *o_rows_data = rd;
+  for (i64 s2 = 0; s2 < k; ++s2) {
+    o_sn_parent[s2] = o_rows_ptr[s2] < o_rows_ptr[s2 + 1]
+                          ? o_col_to_sn[rd[o_rows_ptr[s2]]]
+                          : -1;
+    o_sn_level[s2] = 0;
+  }
+  for (i64 s2 = 0; s2 < k; ++s2) {
+    i64 p = o_sn_parent[s2];
+    if (p >= 0 && o_sn_level[p] < o_sn_level[s2] + 1)
+      o_sn_level[p] = o_sn_level[s2] + 1;
+  }
+  return k;
+}
+
+// ---------------------------------------------------------------------------
 // Batched front-position queries for plan building: for query q, the
 // position of global index x[q] within the front of supernode s[q] —
 // pivot columns map to x - first[s], below-diagonal rows to

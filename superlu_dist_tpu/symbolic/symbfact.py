@@ -261,8 +261,28 @@ def amalgamate_supernodes(sf: SymbolicFact, tol: float = 1.2,
     the structural flops; tol=1.2 yields median width ~150, 10707→587
     supernodes, 325→13 levels, and ~1.7× padding at growth=1.3.
     """
+    from superlu_dist_tpu import native
     ns = sf.n_supernodes
     start = sf.sn_start
+    us0 = np.array([len(r) for r in sf.sn_rows], dtype=np.int64)
+    if native.available():
+        # flat marshalling is O(nnz(L)) — only worth it when the native
+        # twin will actually consume it
+        nat_ptr = np.zeros(ns + 1, dtype=np.int64)
+        np.cumsum(us0, out=nat_ptr[1:])
+        nat_data = (np.concatenate(sf.sn_rows) if ns
+                    else np.empty(0, dtype=np.int64))
+        nat = native.amalgamate(sf.n, start, nat_ptr, nat_data, tol,
+                                max_width, narrow, hard_tol)
+        if nat is not None:
+            (sn_start, col_to_sn_new, sn_parent, sn_level, rows_ptr,
+             rows_data) = nat
+            sn_rows = np.split(rows_data, rows_ptr[1:-1])
+            us = np.diff(rows_ptr)
+            return _finish(sf.n, sf.perm, sf.parent, sn_start,
+                           col_to_sn_new, sn_rows, sn_parent, sn_level, us,
+                           sf.pattern_indptr, sf.pattern_indices,
+                           sf.value_perm)
     first = start[:-1].copy()
     end = start[1:].copy()              # exclusive end column; fixed
     rows_of = list(sf.sn_rows)
@@ -270,9 +290,7 @@ def amalgamate_supernodes(sf: SymbolicFact, tol: float = 1.2,
     rep = np.arange(ns)
     col_to_sn = sf.col_to_sn
     # original constituent flops per live supernode (the merge budget)
-    base = _front_flops(np.diff(start),
-                        np.array([len(r) for r in sf.sn_rows]))
-    base = np.asarray(base, dtype=float)
+    base = np.asarray(_front_flops(np.diff(start), us0), dtype=float)
 
     def find(s: int) -> int:
         while rep[s] != s:

@@ -110,3 +110,31 @@ def test_amalg_max_width_cap():
                              amalg_tol=0)
     sf = amalgamate_supernodes(sf0, tol=2.0, max_width=48)
     assert np.diff(sf.sn_start).max() <= 48
+
+
+def test_amalg_native_matches_python(monkeypatch):
+    """The native slu_amalgamate must reproduce the Python amalgamation
+    exactly (same greedy order, same budget test) — the same parity
+    discipline as the native symbolic."""
+    from superlu_dist_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    sym = symmetrize_pattern(poisson3d(10))
+    n = sym.n_rows
+    sf0 = symbolic_factorize(sym, np.arange(n), relax=8, max_supernode=256,
+                             amalg_tol=0)
+    sf_nat = amalgamate_supernodes(sf0, tol=1.3, max_width=256)
+    monkeypatch.setenv("SLU_TPU_NO_NATIVE", "1")
+    native._tried, native._lib = False, None
+    try:
+        sf_py = amalgamate_supernodes(sf0, tol=1.3, max_width=256)
+    finally:
+        monkeypatch.delenv("SLU_TPU_NO_NATIVE")
+        native._tried, native._lib = False, None
+    assert np.array_equal(sf_nat.sn_start, sf_py.sn_start)
+    assert np.array_equal(sf_nat.sn_parent, sf_py.sn_parent)
+    assert np.array_equal(sf_nat.sn_level, sf_py.sn_level)
+    assert np.array_equal(sf_nat.col_to_sn, sf_py.col_to_sn)
+    for rn, rp in zip(sf_nat.sn_rows, sf_py.sn_rows):
+        assert np.array_equal(rn, rp)
+    assert sf_nat.flops == sf_py.flops
