@@ -48,6 +48,9 @@ def _build_parser():
                         "CPU; df64 = emulated double on f32 hardware)")
     p.add_argument("-x", "--relax", type=int, default=None,
                    help="supernode relaxation (sp_ienv(2) / pdtest -x)")
+    p.add_argument("--amalg-tol", type=float, default=None,
+                   help="fill-tolerant supernode amalgamation tolerance "
+                        "(SLU_TPU_AMALG_TOL; 0 disables)")
     p.add_argument("-m", "--maxsuper", type=int, default=None,
                    help="max supernode size (sp_ienv(3) / pdtest -m)")
     p.add_argument("--backend", default=None, choices=["cpu", "tpu"],
@@ -87,6 +90,8 @@ def _options(args, **overrides):
         kw["relax"] = args.relax
     if args.maxsuper is not None:
         kw["max_supernode"] = args.maxsuper
+    if args.amalg_tol is not None:
+        kw["amalg_tol"] = args.amalg_tol
     kw.update(overrides)
     return Options(**kw)
 
