@@ -194,7 +194,16 @@ def main():
     RESULT["backend"] = backend
     RESULT["phase"] = "prepare"
 
-    a = poisson3d(NX)
+    # BENCH_MATRIX=geo3d swaps in the irregular FEM-like family
+    # (random_geometric_3d, the audikw_1-class surrogate — BASELINE
+    # config 5) at the same n = NX^3, guarding blocking choices against
+    # overfitting to the regular Poisson stencil
+    MATRIX = os.environ.get("BENCH_MATRIX", "poisson3d")
+    if MATRIX == "geo3d":
+        from superlu_dist_tpu.models.gallery import random_geometric_3d
+        a = random_geometric_3d(NX ** 3)
+    else:
+        a = poisson3d(NX)
     opts = Options()
     sym = symmetrize_pattern(a)
     col_order = get_perm_c(opts, a, sym)
@@ -209,7 +218,7 @@ def main():
     eps = float(jnp.finfo(jnp.dtype(DTYPE)).eps)
     thresh_np = np.asarray(np.sqrt(eps) * a.norm_max(), host_dt)
     n = a.n_rows
-    RESULT["metric"] = f"lu_factor_gflops_poisson3d_n{n}_{DTYPE}"
+    RESULT["metric"] = f"lu_factor_gflops_{MATRIX}_n{n}_{DTYPE}"
     RESULT["flops"] = plan.flops
     RESULT["n_groups"] = len(plan.groups)
     _log(f"prepared n={n} groups={len(plan.groups)} "

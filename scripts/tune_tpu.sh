@@ -57,6 +57,9 @@ run 1500 BENCH_NX=48 SLU_TPU_PRECISION=high
 # native-MXU-rate factors (IR recovers f64 residuals; more steps)
 run 900  BENCH_NX=32 BENCH_DTYPE=bfloat16
 
+# irregular-graph family (audikw_1-class surrogate, BASELINE config 5)
+run 1200 BENCH_NX=32 BENCH_MATRIX=geo3d
+
 # largest single-chip sizes (compact fronts; offload auto-engages if the
 # factor bytes outgrow HBM).  NX=80 is n=512,000 — the BASELINE config-4
 # class pushed as far as one chip + host offload goes: pool 8.9 GB +
