@@ -199,6 +199,8 @@ def main():
     # config 5) at the same n = NX^3, guarding blocking choices against
     # overfitting to the regular Poisson stencil
     MATRIX = os.environ.get("BENCH_MATRIX", "poisson3d")
+    if MATRIX not in ("poisson3d", "geo3d"):
+        raise SystemExit(f"BENCH_MATRIX={MATRIX!r}: expected poisson3d|geo3d")
     if MATRIX == "geo3d":
         from superlu_dist_tpu.models.gallery import random_geometric_3d
         a = random_geometric_3d(NX ** 3)

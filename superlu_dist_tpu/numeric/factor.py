@@ -209,7 +209,12 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
-    key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition))
+    from superlu_dist_tpu.ops.dense import pivot_kernel
+    # the fused executor bakes the pivot-kernel choice into its one traced
+    # program, so the choice must be part of its identity; StreamExecutor
+    # re-reads it per call (stream._kernel / _level_fns key on it)
+    key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition),
+           pivot_kernel() if executor == "fused" else None)
     fn = cache.get(key)
     if fn is None:
         if executor == "stream":

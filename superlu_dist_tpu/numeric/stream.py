@@ -49,8 +49,8 @@ def _pad_to(arr: np.ndarray, length: int, fill) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=None)
-def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh=None,
-            pool_partition=False):
+def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh,
+            pool_partition, pivot):
     """Jitted group step for one shape key (optionally mesh-sharded).
 
     With a mesh, the dense factor math shards batch-over-"snode" and
@@ -184,7 +184,8 @@ class StreamExecutor:
     def _level_fn(self, level, entries):
         """One jitted program running every group of `level` (index maps
         are closed over — jit hoists them to constants)."""
-        fn = self._level_fns.get(level)
+        from superlu_dist_tpu.ops.dense import pivot_kernel
+        fn = self._level_fns.get((level, pivot_kernel()))
         if fn is not None:
             return fn
         from superlu_dist_tpu.numeric.factor import pool_spec
@@ -221,7 +222,7 @@ class StreamExecutor:
             return outs, pool, tiny
 
         fn = jax.jit(run, donate_argnums=(1,))
-        self._level_fns[level] = fn
+        self._level_fns[(level, pivot_kernel())] = fn
         return fn
 
     def __call__(self, avals, thresh):
@@ -248,8 +249,10 @@ class StreamExecutor:
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
         t_issue0 = time.perf_counter()
+        from superlu_dist_tpu.ops.dense import pivot_kernel
+        pivot = pivot_kernel()
         for gi, (key, a, child_arrs, nreal) in enumerate(self._steps):
-            kern = _kernel(*key, self.mesh, self.pool_partition)
+            kern = _kernel(*key, self.mesh, self.pool_partition, pivot)
             if profile:
                 t0 = time.perf_counter()
             (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)

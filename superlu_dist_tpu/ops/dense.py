@@ -135,6 +135,122 @@ def lu_nopivot(a, thresh):
     return jnp.concatenate([top, bot], axis=0), jnp.concatenate([c1, c2])
 
 
+_PANEL_BLOCK = 128   # outer panel width of the blocked right-looking LU
+
+
+def pivot_kernel() -> str:
+    """Resolve SLU_TPU_PIVOT_KERNEL (validated like _precision).  Read at
+    trace time — executors bake the choice into their cached programs, so
+    callers that cache jitted kernels must include this name in their
+    cache key (stream._kernel, factor.get_executor do)."""
+    name = os.environ.get("SLU_TPU_PIVOT_KERNEL", "blocked").strip().lower()
+    if name not in ("blocked", "recursive"):
+        raise ValueError(f"SLU_TPU_PIVOT_KERNEL={name!r} — expected "
+                         f"'blocked' or 'recursive'")
+    return name
+
+
+def _blocked_partial_factor(f, thresh, w):
+    """Right-looking blocked partial LU of one front — compile-bounded.
+
+    The recursive formulation (lu_nopivot) emits O(w/16) distinct
+    triangular_solve/GEMM shapes; the TPU compiler takes minutes per
+    kernel on wide panels (w ≥ 400 observed >8 min through the remote
+    tunnel), which round 2 hit as the "compile wall" (BENCH_r02 null).
+    This version is the classic blocked getrf as ONE fori_loop whose body
+    has a single static shape: eliminate a PB-wide panel with masked
+    rank-1 steps, one (PB,PB)⁻¹·(PB,M) unit-lower triangular solve for
+    the U rows, one (M,PB)×(PB,M) trailing GEMM — the MXU-shaped k=PB
+    update that carries all the flops (the reference's aggregated Schur
+    GEMM, dSchCompUdt-2Ddynamic.c:566-578, fused with the panel factor).
+    Compile cost is O(1) in w; executed flops ≈ 2·M²·w (full-width
+    trailing updates — the masked-padding trade noted in _lu_masked).
+
+    Columns j ≥ w and identity-padding columns behave as unit pivots with
+    zero multipliers, so the loop runs a static ceil(w/PB) panels and the
+    final matrix carries packed LU in [:w,:w], L21 below, U12 right, and
+    the Schur complement in [w:,w:] — same layout as partial_front_factor.
+
+    NOTE: uses dynamic_slice/dynamic_update_slice on the column axis, so
+    it must NOT be used with a column-sharded front (XLA SPMD handles
+    that poorly); group_partial_factor keeps the recursive path when
+    shardings are requested.
+
+    Returns (packed front (M_ext→M, M), tiny flags (w,)).
+    """
+    m = f.shape[0]
+    pb = min(_PANEL_BLOCK, -(-w // 16) * 16)
+    nsteps = -(-w // pb)
+    # shrink the panel so nsteps*pb hugs w: e.g. w=136 would otherwise
+    # run 2×128 panels and pad the front to 256 columns — up to ~4× the
+    # area in solves/GEMMs for wide-pivot small-U buckets
+    pb = -(-(-(-w // nsteps)) // 16) * 16
+    nsteps = -(-w // pb)
+    m_ext = max(m, nsteps * pb)
+    if m_ext > m:
+        # zero padding; padded columns are never eliminated (j >= w ->
+        # inactive) and padded rows stay zero throughout
+        f = jnp.pad(f, ((0, m_ext - m), (0, m_ext - m)))
+    rows = jnp.arange(m_ext)
+    cols_pb = jnp.arange(pb)
+    zero = jnp.zeros((), f.dtype)
+    one = jnp.ones((), f.dtype)
+
+    def inner(jj, carry):
+        panel, flags, j0 = carry
+        j = j0 + jj                                   # global column
+        active = (j < w)
+        col = lax.dynamic_index_in_dim(panel, jj, axis=1, keepdims=False)
+        rowj = lax.dynamic_index_in_dim(panel, j, axis=0, keepdims=False)
+        piv_raw = lax.dynamic_index_in_dim(rowj, jj, axis=0, keepdims=False)
+        piv, tiny = _fix_pivot(piv_raw, thresh)
+        piv = jnp.where(active, piv, one)
+        below = rows > j
+        l = jnp.where(below & active, col / piv, zero)
+        urow = jnp.where((cols_pb > jj) & active, rowj, zero)
+        panel = panel - l[:, None] * urow[None, :]
+        # write the multipliers + fixed pivot back into column jj —
+        # inactive columns (j >= w: Schur region / identity padding) keep
+        # their values untouched
+        newcol = jnp.where(active,
+                           jnp.where(below, l, col)
+                           + (piv - piv_raw) * (rows == j), col)
+        e = (cols_pb == jj).astype(f.dtype)
+        cur = lax.dynamic_index_in_dim(panel, jj, axis=1, keepdims=False)
+        panel = panel + (newcol - cur)[:, None] * e[None, :]
+        flags = flags + tiny * active.astype(jnp.int32) * (
+            jnp.arange(w) == j).astype(jnp.int32)
+        return panel, flags, j0
+
+    def outer(p, carry):
+        a, flags = carry
+        j0 = p * pb
+        panel = lax.dynamic_slice(a, (0, j0), (m_ext, pb))
+        panel, flags, _ = lax.fori_loop(0, pb, inner, (panel, flags, j0))
+        a = lax.dynamic_update_slice(a, panel, (0, j0))
+        # U rows: solve unit-L11 against the columns right of the panel
+        l11 = lax.dynamic_slice(panel, (j0, 0), (pb, pb))
+        rtop = lax.dynamic_slice(a, (j0, 0), (pb, m_ext))
+        right = rows[None, :] >= j0 + pb              # (1, m_ext) col mask
+        u12 = solve_triangular(l11, jnp.where(right, rtop, zero),
+                               lower=True, unit_diagonal=True)
+        rowact = (j0 + jnp.arange(pb)) < w            # pivot rows only
+        u12 = jnp.where(rowact[:, None] & right, u12, zero)
+        a = lax.dynamic_update_slice(
+            a, jnp.where(rowact[:, None] & right, u12, rtop), (j0, 0))
+        # trailing update: every non-pivot row — rows below the panel AND
+        # Schur rows (>= w) that fall inside the panel's row range —
+        # against all columns to the right
+        lpan = jnp.where(((rows >= j0 + pb) | (rows >= w))[:, None],
+                         panel, zero)
+        a = a - jnp.matmul(lpan, u12, precision=_precision())
+        return a, flags
+
+    a, flags = lax.fori_loop(0, nsteps, outer,
+                             (f, jnp.zeros(w, jnp.int32)))
+    return a[:m, :m], flags
+
+
 def partial_front_factor(f, thresh, w):
     """Factor the leading w columns of one front; see module docstring."""
     m = f.shape[0]
@@ -175,6 +291,16 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
     from jax.lax import with_sharding_constraint as wsc
     m = fronts.shape[-1]
     b = fronts.shape[0]
+    if (front_sharding is None and pivot_sharding is None
+            and pivot_kernel() == "blocked"):
+        # unsharded: the compile-bounded blocked kernel (see
+        # _blocked_partial_factor).  Sharded runs keep the recursive
+        # path — its scatter-free masked core is what the SPMD
+        # partitioner handles.
+        packed, tiny = jax.vmap(
+            lambda x: _blocked_partial_factor(x, thresh, w))(fronts)
+        return (packed[:, :, :w], packed[:, :w, w:],
+                packed[:, w:, w:], tiny)
     f11_in = fronts[:, :w, :w]
     if pivot_sharding is not None:
         f11_in = wsc(f11_in, pivot_sharding)
@@ -201,16 +327,27 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
     return lpanel, u12, s, tiny
 
 
-@functools.lru_cache(maxsize=None)
 def make_front_kernel(m: int, w: int, dtype: str):
     """Jitted batched front factorization for bucket shape (M=m, W=w).
 
     Returns fn(F: (B, m, m), thresh) -> (F_packed: (B, m, m), tiny: int32).
-    Cached per (m, w, dtype); batch size participates in jit's own cache.
+    Cached per (m, w, dtype, pivot kernel); batch size participates in
+    jit's own cache.  Honors SLU_TPU_PIVOT_KERNEL like the executors.
     """
+    return _make_front_kernel(m, w, dtype, pivot_kernel())
 
-    def kernel(fronts, thresh):
-        outs, counts = jax.vmap(lambda f: partial_front_factor(f, thresh, w))(fronts)
-        return outs, jnp.sum(counts)
+
+@functools.lru_cache(maxsize=None)
+def _make_front_kernel(m: int, w: int, dtype: str, pivot: str):
+    if pivot == "blocked":
+        def kernel(fronts, thresh):
+            outs, flags = jax.vmap(
+                lambda f: _blocked_partial_factor(f, thresh, w))(fronts)
+            return outs, jnp.sum(flags)
+    else:
+        def kernel(fronts, thresh):
+            outs, counts = jax.vmap(
+                lambda f: partial_front_factor(f, thresh, w))(fronts)
+            return outs, jnp.sum(counts)
 
     return jax.jit(kernel)

@@ -78,3 +78,41 @@ def test_partial_front_factor(m, w, u_real, w_real):
                                    rtol=1e-10, atol=1e-10)
         np.testing.assert_allclose(out[b][w:w + u_real, w:w + u_real],
                                    ref[w_real:, w_real:], rtol=1e-10, atol=1e-10)
+
+
+@pytest.mark.parametrize("m,w", [(40, 16), (130, 120), (300, 144),
+                                 (64, 31), (200, 137), (56, 56), (24, 9)])
+@pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+def test_blocked_matches_recursive(m, w, dtype):
+    """The compile-bounded blocked kernel (the unsharded default,
+    _blocked_partial_factor) must agree with the recursive path on every
+    output — packed LU, L21, U12, Schur, and tiny-pivot flags — including
+    w not a multiple of the 128 panel block and identity-padded columns."""
+    import os
+    from superlu_dist_tpu.ops.dense import group_partial_factor
+    rng = np.random.default_rng(m + w)
+    f = rng.standard_normal((2, m, m)) + m * np.eye(m)
+    if np.issubdtype(dtype, np.complexfloating):
+        f = f + 1j * rng.standard_normal((2, m, m))
+    f = f.astype(dtype)
+    # identity-pad the last 5 pivot columns of slot 1 (ws < w case)
+    f[1, :, w - 5:w] = 0
+    f[1, w - 5:w, :] = 0
+    for k in range(w - 5, w):
+        f[1, k, k] = 1.0
+    thresh = jnp.asarray(1e-300)
+    old = os.environ.get("SLU_TPU_PIVOT_KERNEL")
+    try:
+        os.environ["SLU_TPU_PIVOT_KERNEL"] = "blocked"
+        got = group_partial_factor(jnp.asarray(f), thresh, w)
+        os.environ["SLU_TPU_PIVOT_KERNEL"] = "recursive"
+        ref = group_partial_factor(jnp.asarray(f), thresh, w)
+    finally:
+        if old is None:
+            os.environ.pop("SLU_TPU_PIVOT_KERNEL", None)
+        else:
+            os.environ["SLU_TPU_PIVOT_KERNEL"] = old
+    for g, r in zip(got[:3], ref[:3]):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(r),
+                                   rtol=1e-9, atol=1e-9)
+    np.testing.assert_array_equal(np.asarray(got[3]), np.asarray(ref[3]))
