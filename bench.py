@@ -98,15 +98,26 @@ def _probe_device(timeout_s: float = 240.0) -> bool:
     t.join(timeout_s)
     if ok:
         _log(f"device probe ok, backend={ok[0]}")
-        return True
+        return ok[0]
     _log("device probe FAILED (timeout or error)")
-    return False
+    return None
 
 
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
 
-    if not os.environ.get("BENCH_NO_PROBE") and not _probe_device():
+    probed = (None if os.environ.get("BENCH_NO_PROBE")
+              else _probe_device())
+    if os.environ.get("BENCH_REQUIRE_TPU") and not os.environ.get(
+            "BENCH_NO_PROBE") and (probed is None or probed == "cpu"):
+        # sweep hygiene: a tuning row measured on the CPU backend —
+        # whether from a dead tunnel or a silent platform fallback — is
+        # noise, not data; report and stop (the driver's official run
+        # does NOT set this, so it still gets the fallback number)
+        RESULT["phase"] = "tpu-unreachable"
+        _emit(final=True)
+        return
+    if not os.environ.get("BENCH_NO_PROBE") and probed is None:
         # accelerator unreachable: rerun on the CPU backend so the driver
         # still gets a real measurement (marked backend=cpu)
         _log("falling back to CPU backend in a fresh process")

@@ -1,0 +1,116 @@
+#!/bin/bash
+# Round-3 hardware session: serialized, probe-gated, idempotent.
+#
+# Tunnel-wedge lesson (observed twice, r2 + r3): killing a bench client
+# mid-compile (the bench watchdog's os._exit, or an outer `timeout`)
+# aborts the in-flight remote compile RPC and wedges the relay for
+# minutes-to-hours — the next probe then fails even though nothing OOMed.
+# So this session NEVER kills a running client: deadlines sit far above
+# worst-case compile (~20-40 s/kernel through the tunnel), exactly one
+# client runs at a time, and when the tunnel is down we wait, not retry-
+# kill.  Each config is marked done (.hw_done/) only when it yields a
+# non-null TPU row, so the script can be re-run after any interruption.
+#
+# Order: the driver-critical config first (BENCH_NX=48 default blocking —
+# the exact kernel set BENCH_r03.json needs warm in .cache/jax), then the
+# MFU variants smallest-first, then big sizes, then the auxiliary
+# measurement scripts (BASELINE fixtures 1-3, df64 cost).
+set -u
+cd "$(dirname "$0")/.."
+OUT=tune_results.jsonl
+LOG=tune_results.err
+MARK=.hw_done
+mkdir -p "$MARK"
+
+probe() {
+  python - <<'EOF' >/dev/null 2>&1
+import subprocess, sys
+try:
+    r = subprocess.run([sys.executable, "-c",
+        "import jax, jax.numpy as jnp; "
+        "(jnp.ones((64,64)) @ jnp.ones((64,64))).block_until_ready()"],
+        timeout=240, capture_output=True)
+    sys.exit(r.returncode)
+except Exception:
+    sys.exit(1)
+EOF
+}
+
+wait_up() {
+  until probe; do
+    echo "[hw] $(date -u +%H:%M:%S) tunnel down; retry in 180s" >&2
+    sleep 180
+  done
+}
+
+row_ok() {
+  tail -1 "$OUT" | python -c '
+import json, sys
+try:
+    r = json.loads(sys.stdin.read())
+except Exception:
+    sys.exit(1)
+sys.exit(0 if r.get("value") is not None and r.get("backend") != "cpu"
+         else 1)'
+}
+
+run() {  # run <marker> <deadline_s> [ENV=VAL ...]
+  local mark="$1" deadline="$2"; shift 2
+  [ -e "$MARK/$mark" ] && return 0
+  wait_up
+  echo "[hw] $(date -u +%H:%M:%S) start $mark: $*" >&2
+  env "$@" BENCH_REPS=3 BENCH_REQUIRE_TPU=1 BENCH_DEADLINE_S="$deadline" \
+      python bench.py >> "$OUT" 2>> "$LOG"
+  if row_ok; then
+    touch "$MARK/$mark"
+    echo "[hw] $(date -u +%H:%M:%S) done $mark" >&2
+  else
+    echo "[hw] $(date -u +%H:%M:%S) $mark yielded no TPU number" >&2
+  fi
+}
+
+script_once() {  # script_once <marker> <script> [env...]
+  local mark="$1" scr="$2"; shift 2
+  [ -e "$MARK/$mark" ] && return 0
+  wait_up
+  echo "[hw] $(date -u +%H:%M:%S) start $mark ($scr)" >&2
+  if env "$@" python "$scr" >> "$LOG" 2>&1; then
+    touch "$MARK/$mark"
+  else
+    echo "[hw] $(date -u +%H:%M:%S) $mark FAILED (rc=$?)" >&2
+  fi
+}
+
+# ---- 1. driver-critical: the exact BENCH_r03 config (NX=48 defaults) ----
+run nx48_default 10800 BENCH_NX=48
+
+# ---- 2. MFU variants at NX=32 (cheap compiles, fast reps) ----
+run nx32_default 4000 BENCH_NX=32
+run nx32_profile 4000 BENCH_NX=32 SLU_TPU_PROFILE=1
+run nx32_fused   6000 BENCH_NX=32 BENCH_GRANULARITY=fused
+run nx32_level   4000 BENCH_NX=32 BENCH_GRANULARITY=level
+run nx32_prec_hi 4000 BENCH_NX=32 SLU_TPU_PRECISION=high
+run nx32_bf16    4000 BENCH_NX=32 BENCH_DTYPE=bfloat16
+run nx32_host3e7 4000 BENCH_NX=32 SLU_TPU_HOST_FLOPS=3e7
+run nx32_amalg0  4000 BENCH_NX=32 BENCH_AMALG=0
+run nx32_amalg15 4000 BENCH_NX=32 BENCH_AMALG=1.5
+run nx32_ms512   4000 BENCH_NX=32 BENCH_MAXSUPER=512
+run nx32_geo3d   6000 BENCH_NX=32 BENCH_MATRIX=geo3d
+
+# ---- 3. best-variant checks at the driver size ----
+run nx48_fused   10800 BENCH_NX=48 BENCH_GRANULARITY=fused
+run nx48_prec_hi 6000  BENCH_NX=48 SLU_TPU_PRECISION=high
+run nx48_profile 6000  BENCH_NX=48 SLU_TPU_PROFILE=1
+
+# ---- 4. size ladder upward (config-4 class) ----
+run nx24_default 3000 BENCH_NX=24
+run nx56 12000 BENCH_NX=56
+run nx64 14400 BENCH_NX=64
+run nx72 14400 BENCH_NX=72 SLU_TPU_FRONT_BYTES_LIMIT=4000000000
+run nx80 14400 BENCH_NX=80 SLU_TPU_FRONT_BYTES_LIMIT=4000000000
+
+# ---- 5. auxiliary hardware measurements ----
+script_once baseline_fixtures scripts/baseline_fixtures_tpu.py
+script_once df64_cost scripts/df64_cost_tpu.py
+
+echo "[hw] session complete $(date -u +%H:%M:%S)" >&2

@@ -125,10 +125,13 @@ class NumericFactorization:
 
     @property
     def on_host(self) -> bool:
-        """True when the factors already live in host memory (either the
-        executor streamed them off-device — offload mode — or we run on
-        the CPU backend)."""
-        return bool(self.fronts) and isinstance(self.fronts[0][0], np.ndarray)
+        """True when the factors ALL live in host memory (the executor
+        streamed them off-device — offload mode — or we run on the CPU
+        backend).  A host-share split (stream.py SLU_TPU_HOST_FLOPS)
+        leaves only the leading leaf panels as numpy — that is a
+        device-resident factorization and must keep the device solve."""
+        return bool(self.fronts) and all(
+            isinstance(lp, np.ndarray) for lp, _ in self.fronts)
 
     def pull_to_host(self):
         """Transfer factors to host once (the dSolveInit analog,
@@ -213,8 +216,13 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     # the fused executor bakes the pivot-kernel choice into its one traced
     # program, so the choice must be part of its identity; StreamExecutor
     # re-reads it per call (stream._kernel / _level_fns key on it)
+    import os
     key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition),
-           pivot_kernel() if executor == "fused" else None)
+           pivot_kernel() if executor == "fused" else None,
+           # StreamExecutor latches the host-share threshold at
+           # construction — a changed SLU_TPU_HOST_FLOPS needs a new one
+           float(os.environ.get("SLU_TPU_HOST_FLOPS", "0"))
+           if executor == "stream" else None)
     fn = cache.get(key)
     if fn is None:
         if executor == "stream":

@@ -99,7 +99,7 @@ class StreamExecutor:
 
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
                  offload: str = "auto", pool_partition: bool = False,
-                 granularity: str = "group"):
+                 granularity: str = "group", host_flops=None):
         """offload: "none" keeps every factored panel on the device;
         "host" streams each group's (lpanel, upanel) to host memory as
         soon as it is produced (copy_to_host_async overlaps the next
@@ -139,9 +139,48 @@ class StreamExecutor:
         self.offload = offload
         self.last_profile = None   # filled when SLU_TPU_PROFILE is set
         self.last_dispatch_seconds = None   # async-issue time of last call
+
+        # Host-share split (the reference's CPU/GPU work division:
+        # gemm_division_cpu_gpu + the N_GEMM flops threshold,
+        # SRC/util.c:1271-1360, sp_ienv case 7).  Leading elimination
+        # levels whose every group executes fewer than `host_flops` flops
+        # run on the host CPU backend — they are dispatch-latency-bound on
+        # the accelerator (thousands of tiny leaf LUs cost more in kernel
+        # launch + tunnel RPC than in math) — with ONE pool handoff to the
+        # device where the large fronts begin.  Disabled by default
+        # (host_flops=0); env SLU_TPU_HOST_FLOPS overrides.  Mesh-sharded
+        # runs keep everything on the mesh.
+        if host_flops is None:
+            host_flops = float(os.environ.get("SLU_TPU_HOST_FLOPS", "0"))
+        self._host_levels = set()
+        self._cpu_dev = None
+        if host_flops > 0 and mesh is None:
+            try:
+                self._cpu_dev = jax.devices("cpu")[0]
+            except RuntimeError:
+                self._cpu_dev = None
+        if self._cpu_dev is not None:
+            lv_max = {}
+            for g in plan.groups:
+                fl = _bucket_len(g.batch, 1) * _front_flops(g.w, g.u)
+                lv_max[g.level] = max(lv_max.get(g.level, 0.0), fl)
+            for lv in sorted(lv_max):
+                if lv_max[lv] < host_flops:
+                    self._host_levels.add(lv)
+                else:
+                    break
+        self.host_levels = len(self._host_levels)
+        self._n_host_groups = sum(1 for g in plan.groups
+                                  if g.level in self._host_levels)
+
         n_avals = len(plan.pattern_indices)
         self._steps = []
         for grp in plan.groups:
+            on_host = grp.level in self._host_levels
+            # host-group index arrays go straight numpy -> cpu device (a
+            # jnp.asarray first would bounce them through the accelerator)
+            _put = ((lambda x: jax.device_put(x, self._cpu_dev))
+                    if on_host else jnp.asarray)
             b = _bucket_len(grp.batch, 1)
             la = _bucket_len(len(grp.a_src), lo=64, base=4.0)
             # batch padding: slot b-? -> identity fronts via ws=0; scatter
@@ -156,20 +195,20 @@ class StreamExecutor:
                 rel = np.full((c, cs.ub), grp.m, dtype=np.int64)
                 rel[:len(cs.rel)] = cs.rel
                 child_arrs.extend([
-                    jnp.asarray(_pad_to(cs.child_off, c, plan.pool_size)),
-                    jnp.asarray(_pad_to(cs.child_slot, c, b)),
-                    jnp.asarray(rel)])
+                    _put(_pad_to(cs.child_off, c, plan.pool_size)),
+                    _put(_pad_to(cs.child_slot, c, b)),
+                    _put(rel)])
                 child_shapes.append((cs.ub, c))
             key = ((b, grp.m, grp.w, grp.u), la, tuple(child_shapes),
                    plan.pool_size, self.dtype)
-            self._steps.append((key, tuple(jnp.asarray(x) for x in a),
-                               tuple(child_arrs), grp.batch))
+            self._steps.append((key, tuple(_put(x) for x in a),
+                               tuple(child_arrs), grp.batch, on_host))
 
     @property
     def n_kernels(self) -> int:
         if self.granularity == "level":
             return len({g.level for g in self.plan.groups})
-        return len({key for key, _, _, _ in self._steps})
+        return len({key for key, _, _, _, _ in self._steps})
 
     @property
     def executed_flops(self) -> float:
@@ -204,7 +243,7 @@ class StreamExecutor:
         def run(avals, pool, thresh):
             outs = []
             tiny = jnp.zeros((), jnp.int32)
-            for key, a, child_arrs, nreal in entries:
+            for key, a, child_arrs, nreal, _host in entries:
                 (dims, l_a, child_shapes, _, _) = key
                 if psh is not None:
                     pool = jax.lax.with_sharding_constraint(pool, psh)
@@ -251,7 +290,22 @@ class StreamExecutor:
         t_issue0 = time.perf_counter()
         from superlu_dist_tpu.ops.dense import pivot_kernel
         pivot = pivot_kernel()
-        for gi, (key, a, child_arrs, nreal) in enumerate(self._steps):
+        # host-share prologue: the leading levels' kernels run on the CPU
+        # device, so pool/avals/thresh start there; the first device group
+        # triggers the one H2D handoff (mirrors the reference keeping the
+        # leading blocks' GEMMs on the CPU while the accelerator streams,
+        # dSchCompUdt-cuda.c:253-294)
+        avals_dev, thresh_dev = avals, thresh
+        on_host_now, avals, thresh, pool = self._host_prologue(
+            avals, thresh, pool)
+        tiny_host = 0
+        for gi, (key, a, child_arrs, nreal, on_host) in \
+                enumerate(self._steps):
+            if on_host_now and not on_host:
+                tiny_host, pool = self._host_handoff(tiny, pool)
+                tiny = jnp.zeros((), jnp.int32)
+                avals, thresh = avals_dev, thresh_dev
+                on_host_now = False
             kern = _kernel(*key, self.mesh, self.pool_partition, pivot)
             if profile:
                 t0 = time.perf_counter()
@@ -263,9 +317,11 @@ class StreamExecutor:
                 gflop = float(_front_flops(w, u)) * grp.batch / 1e9
                 self.last_profile.append({
                     "level": grp.level, "batch": b, "m": m, "w": w, "u": u,
+                    "host": on_host,
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
-            self._emit_front(fronts, lp, up, nreal)
+            self._emit_front(fronts, lp, up, nreal, on_host)
             tiny = tiny + t
+        tiny = tiny + tiny_host
         # dispatch-gap instrumentation (the PROFlevel comm-split analog,
         # pdgstrf.c:1930-1951): time spent ISSUING the async stream.  If
         # this approaches the end-to-end factor time, the run is
@@ -273,7 +329,25 @@ class StreamExecutor:
         self.last_dispatch_seconds = time.perf_counter() - t_issue0
         return self._finalize_fronts(fronts), tiny
 
-    def _emit_front(self, fronts, lp, up, nreal):
+    def _host_prologue(self, avals, thresh, pool):
+        """(active, avals, thresh, pool): when the plan opens with
+        host-share levels, commit the stream inputs to the cpu device.
+        Shared by both granularities so their handoff logic cannot
+        diverge."""
+        if not (self._steps and self._steps[0][4]):
+            return False, avals, thresh, pool
+        return (True, jax.device_put(avals, self._cpu_dev),
+                jax.device_put(thresh, self._cpu_dev),
+                jax.device_put(pool, self._cpu_dev))
+
+    @staticmethod
+    def _host_handoff(tiny, pool):
+        """End of the host prefix: sync its tiny-pivot count on the cheap
+        host stream and move the pool to the accelerator (the ONE H2D
+        transfer of the split)."""
+        return int(tiny), jax.device_put(np.asarray(pool))
+
+    def _emit_front(self, fronts, lp, up, nreal, on_host=False):
         """Append one group's factored panels; in offload mode start the
         D2H transfer now (it overlaps the following kernels — the
         copy-back stream of the reference's GPU path,
@@ -281,7 +355,12 @@ class StreamExecutor:
         the device never holds more than a few groups of panels."""
         if lp.shape[0] != nreal:
             lp, up = lp[:nreal], up[:nreal]
-        if self.offload == "host":
+        if on_host:
+            # host-share groups: panels already live on the cpu device;
+            # keep them async here (a per-group np.asarray would block the
+            # host stream) — _finalize_fronts materializes the prefix
+            fronts.append((lp, up))
+        elif self.offload == "host":
             lp.copy_to_host_async()
             up.copy_to_host_async()
             fronts.append((lp, up))
@@ -293,10 +372,15 @@ class StreamExecutor:
             fronts.append((lp, up))
 
     def _finalize_fronts(self, fronts):
-        if self.offload == "host":
-            fronts = [(lp if isinstance(lp, np.ndarray) else np.asarray(lp),
-                       up if isinstance(up, np.ndarray) else np.asarray(up))
-                      for lp, up in fronts]
+        if self.offload == "host" or self._n_host_groups:
+            # offload mode: everything to numpy.  Host-share only: just
+            # the leading host-group prefix (the trailing device fronts
+            # stay resident so the device solve keeps working on them).
+            fronts = [
+                (lp, up) if isinstance(lp, np.ndarray)
+                or (self.offload != "host" and i >= self._n_host_groups)
+                else (np.asarray(lp), np.asarray(up))
+                for i, (lp, up) in enumerate(fronts)]
         return tuple(fronts)
 
     def _call_levels(self, avals, pool, thresh, profile):
@@ -307,10 +391,20 @@ class StreamExecutor:
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
         pairs = list(zip(plan.groups, self._steps))
+        avals_dev, thresh_dev = avals, thresh
+        on_host_now, avals, thresh, pool = self._host_prologue(
+            avals, thresh, pool)
+        tiny_host = 0
         for level, chunk in itertools.groupby(pairs,
                                               key=lambda p: p[0].level):
             chunk = list(chunk)
             entries = tuple(step for _, step in chunk)
+            lv_host = entries[0][4]
+            if on_host_now and not lv_host:
+                tiny_host, pool = self._host_handoff(tiny, pool)
+                tiny = jnp.zeros((), jnp.int32)
+                avals, thresh = avals_dev, thresh_dev
+                on_host_now = False
             fn = self._level_fn(level, entries)
             if profile:
                 t0 = time.perf_counter()
@@ -323,12 +417,12 @@ class StreamExecutor:
                 # a LEVEL aggregate, not one kernel's shape: m/w/u are
                 # maxima over the level's heterogeneous groups
                 self.last_profile.append({
-                    "level": level, "aggregate": True,
+                    "level": level, "aggregate": True, "host": lv_host,
                     "batch": sum(g.batch for g, _ in chunk),
                     "m": max(g.m for g, _ in chunk),
                     "w": max(g.w for g, _ in chunk),
                     "u": max(g.u for g, _ in chunk),
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
-            for (grp, (_, _, _, nreal)), (lp, up) in zip(chunk, outs):
-                self._emit_front(fronts, lp, up, nreal)
-        return self._finalize_fronts(fronts), tiny
+            for (grp, (_, _, _, nreal, g_host)), (lp, up) in zip(chunk, outs):
+                self._emit_front(fronts, lp, up, nreal, g_host)
+        return self._finalize_fronts(fronts), tiny + tiny_host

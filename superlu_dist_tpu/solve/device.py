@@ -207,6 +207,13 @@ class DeviceSolver:
         first = sf.sn_start[:-1]
         self._groups = []
         self._invs_cached = None
+        # a host-share factorization (stream.py SLU_TPU_HOST_FLOPS) leaves
+        # the leading leaf panels as numpy: upload those once so the
+        # jitted sweeps don't re-transfer them on every solve
+        if (any(isinstance(lp, np.ndarray) for lp, _ in fact.fronts)
+                and not fact.on_host):
+            fact.fronts = [(jnp.asarray(lp), jnp.asarray(up))
+                           for lp, up in fact.fronts]
         for grp, (lp, up) in zip(plan.groups, fact.fronts):
             firsts = jnp.asarray(first[grp.sns])
             rows = np.full((grp.batch, grp.u), self.n, dtype=np.int64)

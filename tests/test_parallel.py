@@ -233,3 +233,54 @@ def test_offload_with_pool_partition():
                                    rtol=1e-12, atol=1e-12)
         np.testing.assert_allclose(up, np.asarray(rup),
                                    rtol=1e-12, atol=1e-12)
+
+
+def test_host_share_split_matches_plain():
+    """The CPU-share split (SLU_TPU_HOST_FLOPS — the reference's
+    gemm_division_cpu_gpu + N_GEMM threshold, SRC/util.c:1271-1360):
+    leading small levels run on the host CPU device with one pool handoff.
+    On the CPU backend the handoff is same-device, but the full routing /
+    handoff / mixed-front finalize path executes and must be bit-equal to
+    the unsplit stream, at both granularities."""
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    from superlu_dist_tpu.numeric.stream import _bucket_len
+    from superlu_dist_tpu.symbolic.symbfact import _front_flops
+
+    # fine supernodes (no amalgamation) give the real shape: many cheap
+    # leaf levels below a few big ancestor levels
+    a = poisson2d(16)
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(Options(), a, sym)
+    sf = symbolic_factorize(sym, col_order, relax=4, max_supernode=16,
+                            amalg_tol=0.0)
+    plan = build_plan(sf)
+    avals = sym.data[sf.value_perm]
+    thresh = np.sqrt(np.finfo(np.float64).eps) * a.norm_max()
+
+    ref = StreamExecutor(plan, "float64", host_flops=0)(
+        jnp.asarray(avals), jnp.asarray(thresh))
+    # threshold above the leaf level's cost but below the costliest level,
+    # so the split engages AND leaves trailing levels on the device
+    lv_cost = {}
+    for g in plan.groups:
+        fl = _bucket_len(g.batch, 1) * _front_flops(g.w, g.u)
+        lv_cost[g.level] = max(lv_cost.get(g.level, 0), fl)
+    costs = [lv_cost[lv] for lv in sorted(lv_cost)]
+    cut = max(costs)
+    assert costs[0] < cut, "plan must have a cheap leaf level"
+    for gran in ("group", "level"):
+        ex = StreamExecutor(plan, "float64", granularity=gran,
+                            host_flops=cut)
+        assert ex.host_levels > 0, "threshold must engage on this plan"
+        assert ex.host_levels < len({g.level for g in plan.groups}), \
+            "split must leave trailing levels on the device"
+        out = ex(jnp.asarray(avals), jnp.asarray(thresh))
+        assert int(out[1]) == int(ref[1])
+        for (lp, up), (rlp, rup) in zip(out[0], ref[0]):
+            np.testing.assert_array_equal(np.asarray(lp), np.asarray(rlp))
+            np.testing.assert_array_equal(np.asarray(up), np.asarray(rup))
+    # a mesh-sharded executor ignores the host share (everything stays on
+    # the mesh)
+    grid = gridinit(4, 2)
+    exm = StreamExecutor(plan, "float64", mesh=grid.mesh, host_flops=1e7)
+    assert exm.host_levels == 0
