@@ -1,0 +1,132 @@
+#!/usr/bin/env python
+"""BASELINE config-4 class (3D Poisson, target n=1M) executed end-to-end
+on the 8-device VIRTUAL CPU mesh with the partitioned Schur pool — the
+exact multi-chip recipe (pool_partition + host-offloaded fronts) that a
+real v5p slice would run, validated at full problem size.
+
+The point is EXECUTION at scale, not speed: n=1M's ~22 GB pool exceeds
+one v5e chip's HBM, so the single-tunneled-chip environment cannot run it;
+the 8-way virtual mesh (shared host RAM) proves the sharded program
+compiles AND executes with the per-device pool share genuinely smaller
+than the whole (the no-rank-holds-the-whole-factor property,
+reference SRC/pddistribute.c:322).
+
+Writes docs/config4_virtual_n{n}.json and prints one JSON line.
+Env: CONFIG4_NX (default 100 -> n=1e6), CONFIG4_DTYPE (float32).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".cache", "jax"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    import jax.numpy as jnp
+
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    from superlu_dist_tpu.numeric.factor import NumericFactorization
+    from superlu_dist_tpu.drivers.gssvx import LUFactorization
+    from superlu_dist_tpu.refine.ir import iterative_refinement
+    from superlu_dist_tpu.parallel.grid import gridinit
+
+    nx = int(os.environ.get("CONFIG4_NX", "100"))
+    dtype = os.environ.get("CONFIG4_DTYPE", "float32")
+    t_all = time.perf_counter()
+
+    def log(msg):
+        print(f"[config4 +{time.perf_counter() - t_all:8.1f}s] {msg}",
+              file=sys.stderr, flush=True)
+
+    a = poisson3d(nx)
+    n = a.n_rows
+    log(f"matrix n={n} nnz={a.nnz}")
+
+    t0 = time.perf_counter()
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(Options(), a, sym)
+    sf = symbolic_factorize(sym, col_order, relax=256, max_supernode=1024,
+                            amalg_tol=1.2)
+    plan = build_plan(sf, min_bucket=32, growth=1.3)
+    t_analyze = time.perf_counter() - t0
+    log(f"analysis {t_analyze:.1f}s; groups={len(plan.groups)} "
+        f"pool={plan.pool_size * 4 / 1e9:.1f} GB(f32) "
+        f"flops={plan.flops / 1e12:.2f} TF")
+
+    grid = gridinit(4, 2)
+    share = -(-plan.pool_size // grid.mesh.size)
+    assert share < plan.pool_size, "pool must exceed one device share"
+
+    ex = StreamExecutor(plan, dtype, mesh=grid.mesh, pool_partition=True,
+                        offload="host")
+    avals = np.asarray(sym.data[sf.value_perm], dtype=np.float32)
+    eps = float(jnp.finfo(jnp.dtype(dtype)).eps)
+    thresh = np.asarray(np.sqrt(eps) * a.norm_max(), np.float32)
+
+    t0 = time.perf_counter()
+    fronts, tiny = ex(jnp.asarray(avals), jnp.asarray(thresh))
+    jax.block_until_ready(
+        [lp for lp, _ in fronts if not isinstance(lp, np.ndarray)])
+    t_factor = time.perf_counter() - t0
+    log(f"factor (incl. compile) {t_factor:.1f}s  tiny={int(tiny)}")
+
+    numeric = NumericFactorization(plan=plan, fronts=list(fronts),
+                                   tiny_pivots=int(tiny),
+                                   dtype=jnp.dtype(dtype))
+    ones = np.ones(n)
+    ident = np.arange(n, dtype=np.int64)
+    lu = LUFactorization(n=n, options=Options(), equed="N", dr=ones,
+                         dc=ones, r1=ones, c1=ones, row_order=ident,
+                         col_order=None, sf=sf, plan=plan,
+                         numeric=numeric, a=a)
+    xt = np.random.default_rng(0).standard_normal(n)
+    b = a.matvec(xt)
+    t0 = time.perf_counter()
+    x, steps = iterative_refinement(a, b, lu.solve_factored(b),
+                                    lu.solve_factored)
+    t_solve = time.perf_counter() - t0
+    resid = float(np.linalg.norm(b - a.matvec(x))
+                  / max(np.linalg.norm(b), 1e-300))
+    log(f"solve+IR {t_solve:.1f}s  residual {resid:.2e}")
+
+    rec = {"config": "4-virtual", "matrix": f"poisson3d nx={nx}", "n": n,
+           "mesh": "4x2 virtual-cpu", "pool_partition": True,
+           "pool_bytes_total": plan.pool_size * 4,
+           "pool_share_per_device": int(share) * 4,
+           "dtype": dtype, "flops": plan.flops,
+           "analyze_seconds": round(t_analyze, 1),
+           "factor_seconds_incl_compile": round(t_factor, 1),
+           "solve_ir_seconds": round(t_solve, 1),
+           "residual": resid, "tiny_pivots": int(tiny),
+           "backend": "cpu-virtual-mesh",
+           "note": ("execution-at-scale artifact: single-core host, "
+                    "timing not a perf claim; the same program shards "
+                    "onto a real multi-chip mesh")}
+    out = os.path.join(REPO, "docs", f"config4_virtual_n{n}.json")
+    with open(out, "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    assert resid < 1e-10, resid
+
+
+if __name__ == "__main__":
+    main()
