@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Summarize tuning rows + kernel-shape traces into an MFU report.
+
+Inputs: tune_results.jsonl (one JSON row per bench config) and
+tune_results.err (stderr log containing `# lvl=... m=... w=... u=...`
+kernel-trace lines emitted by bench.py when SLU_TPU_PROFILE=1 — the
+reference's dgemm_mnk.dat analog, SRC/pdgstrf.c:380-387).
+
+Prints: ranked result table, dispatch-vs-compute split, and the top
+kernel-time sinks — the "top-3 MFU thieves" evidence VERDICT r2 #9 asks
+for.  Pure text processing; safe to run anywhere.
+"""
+
+import json
+import re
+import sys
+
+
+def main():
+    out = sys.argv[1] if len(sys.argv) > 1 else "tune_results.jsonl"
+    err = sys.argv[2] if len(sys.argv) > 2 else "tune_results.err"
+
+    rows = []
+    for line in open(out):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rows.append(json.loads(line))
+        except json.JSONDecodeError:
+            pass
+
+    tpu = [r for r in rows if r.get("value") is not None
+           and r.get("backend") not in (None, "cpu")]
+    tpu.sort(key=lambda r: -r["value"])
+    print("== TPU rows (ranked by factor GFLOP/s) ==")
+    for r in tpu:
+        disp = r.get("dispatch_seconds")
+        fs = r.get("factor_seconds", 0.0) or 0.0
+        dshare = (f" dispatch {100 * disp / fs:4.0f}%"
+                  if disp is not None and fs else "")
+        print(f"{r['value']:8.1f} GF/s  mfu {r.get('mfu_pct', 0):5.2f}%  "
+              f"pad {r.get('padding_factor', '?'):>4}  "
+              f"{r.get('granularity', '?'):<6} "
+              f"kern {r.get('n_kernels', '?'):>3}{dshare}  "
+              f"resid {r.get('residual', float('nan')):.1e}  "
+              f"{r['metric']}"
+              + (f"  [{','.join(str(b) for b in r['blocking'])}]"
+                 if r.get("blocking") else ""))
+
+    # kernel trace lines: "# lvl=3  B=16  m=512  w=256  u=256  12.34 ms  567.8 GF/s"
+    pat = re.compile(
+        r"# lvl=\s*(\d+)\s+B=\s*(\d+)\s+m=\s*(\d+)\s+w=\s*(\d+)\s+"
+        r"u=\s*(\d+)\s+([\d.]+) ms\s+([\d.]+) GF/s")
+    kernels = []
+    try:
+        for line in open(err):
+            m = pat.search(line)
+            if m:
+                lvl, B, mm, w, u = (int(m.group(i)) for i in range(1, 6))
+                ms, gfs = float(m.group(6)), float(m.group(7))
+                kernels.append((ms, gfs, lvl, B, mm, w, u))
+    except FileNotFoundError:
+        pass
+    if kernels:
+        total = sum(k[0] for k in kernels)
+        print(f"\n== kernel trace: {len(kernels)} entries, "
+              f"{total:.1f} ms profiled ==")
+        print("top sinks (ms, GF/s, lvl, batch, m, w, u, % of profiled):")
+        for ms, gfs, lvl, B, mm, w, u in sorted(kernels)[::-1][:12]:
+            print(f"  {ms:8.2f} ms {gfs:8.1f} GF/s  lvl={lvl:<3d} B={B:<5d} "
+                  f"m={mm:<5d} w={w:<5d} u={u:<5d}  {100 * ms / total:4.1f}%")
+
+
+if __name__ == "__main__":
+    main()
