@@ -113,4 +113,15 @@ run nx80 14400 BENCH_NX=80 SLU_TPU_FRONT_BYTES_LIMIT=4000000000
 script_once baseline_fixtures scripts/baseline_fixtures_tpu.py
 script_once df64_cost scripts/df64_cost_tpu.py
 
+# ---- 6. hardware-only tests (complex on the accelerator etc.) ----
+if [ ! -e "$MARK/hw_tests" ]; then
+  wait_up
+  if SLU_TPU_HW_TESTS=1 python -m pytest tests/test_tpu_hw.py -v \
+      >> "$LOG" 2>&1; then
+    touch "$MARK/hw_tests"
+  else
+    echo "[hw] hw_tests FAILED" >&2
+  fi
+fi
+
 echo "[hw] session complete $(date -u +%H:%M:%S)" >&2

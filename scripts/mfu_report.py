@@ -21,14 +21,17 @@ def main():
     err = sys.argv[2] if len(sys.argv) > 2 else "tune_results.err"
 
     rows = []
-    for line in open(out):
-        line = line.strip()
-        if not line:
-            continue
-        try:
-            rows.append(json.loads(line))
-        except json.JSONDecodeError:
-            pass
+    try:
+        for line in open(out):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rows.append(json.loads(line))
+            except json.JSONDecodeError:
+                pass
+    except FileNotFoundError:
+        pass
 
     tpu = [r for r in rows if r.get("value") is not None
            and r.get("backend") not in (None, "cpu")]
