@@ -295,6 +295,9 @@ def main():
         RESULT["mfu_pct"] = round(100.0 * plan.flops / t_dev / PEAK_F32, 2)
         if ex.last_dispatch_seconds is not None:
             RESULT["dispatch_seconds"] = round(ex.last_dispatch_seconds, 4)
+        if getattr(ex, "last_offload_wait_seconds", None):
+            RESULT["offload_wait_seconds"] = round(
+                ex.last_offload_wait_seconds, 4)
         _log(f"rep {rep}: {dt:.3f}s -> "
              f"{plan.flops / dt / 1e9:.1f} GFLOP/s")
     fronts, tiny = out

@@ -114,14 +114,16 @@ script_once baseline_fixtures scripts/baseline_fixtures_tpu.py
 script_once df64_cost scripts/df64_cost_tpu.py
 
 # ---- 6. hardware-only tests (complex on the accelerator etc.) ----
-if [ ! -e "$MARK/hw_tests" ]; then
-  wait_up
-  if SLU_TPU_HW_TESTS=1 python -m pytest tests/test_tpu_hw.py -v \
-      >> "$LOG" 2>&1; then
-    touch "$MARK/hw_tests"
-  else
-    echo "[hw] hw_tests FAILED" >&2
+for t in test_complex_c64_on_accelerator test_f32_device_pipeline; do
+  if [ ! -e "$MARK/hw_$t" ]; then
+    wait_up
+    if SLU_TPU_HW_TESTS=1 python -m pytest "tests/test_tpu_hw.py::$t" -v \
+        >> "$LOG" 2>&1; then
+      touch "$MARK/hw_$t"
+    else
+      echo "[hw] hw test $t FAILED" >&2
+    fi
   fi
-fi
+done
 
 echo "[hw] session complete $(date -u +%H:%M:%S)" >&2

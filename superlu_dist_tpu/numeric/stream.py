@@ -139,6 +139,11 @@ class StreamExecutor:
         self.offload = offload
         self.last_profile = None   # filled when SLU_TPU_PROFILE is set
         self.last_dispatch_seconds = None   # async-issue time of last call
+        # time blocked materializing offloaded panels (D2H waits inside
+        # the dispatch loop) — with last_dispatch_seconds this is the
+        # PROFlevel comm-split analog (pdgstrf.c:1930-1951): issue /
+        # transfer-wait / (the rest =) device compute
+        self.last_offload_wait_seconds = None
 
         # Host-share split (the reference's CPU/GPU work division:
         # gemm_division_cpu_gpu + the N_GEMM flops threshold,
@@ -283,6 +288,7 @@ class StreamExecutor:
         profile = bool(os.environ.get("SLU_TPU_PROFILE"))
         if profile:
             self.last_profile = []
+        self._offload_wait = 0.0
         if self.granularity == "level":
             return self._call_levels(avals, pool, thresh, profile)
         fronts = []
@@ -327,6 +333,7 @@ class StreamExecutor:
         # this approaches the end-to-end factor time, the run is
         # dispatch-bound (Python + transfer overhead), not compute-bound.
         self.last_dispatch_seconds = time.perf_counter() - t_issue0
+        self.last_offload_wait_seconds = self._offload_wait
         return self._finalize_fronts(fronts), tiny
 
     def _host_prologue(self, avals, thresh, pool):
@@ -364,10 +371,17 @@ class StreamExecutor:
             lp.copy_to_host_async()
             up.copy_to_host_async()
             fronts.append((lp, up))
-            if len(fronts) > _OFFLOAD_LAG:
-                i = len(fronts) - 1 - _OFFLOAD_LAG
+            i = len(fronts) - 1 - _OFFLOAD_LAG
+            # the lag window must not reach into the host-share prefix:
+            # materializing those cpu-device panels here would block on
+            # host-stream COMPUTE (not D2H) and corrupt the comm split —
+            # _finalize_fronts handles the prefix
+            if i >= self._n_host_groups:
                 dlp, dup = fronts[i]
-                fronts[i] = (np.asarray(dlp), np.asarray(dup))
+                if not isinstance(dlp, np.ndarray):
+                    t0 = time.perf_counter()
+                    fronts[i] = (np.asarray(dlp), np.asarray(dup))
+                    self._offload_wait += time.perf_counter() - t0
         else:
             fronts.append((lp, up))
 
@@ -425,4 +439,5 @@ class StreamExecutor:
                     "seconds": time.perf_counter() - t0, "gflop": gflop})
             for (grp, (_, _, _, nreal, g_host)), (lp, up) in zip(chunk, outs):
                 self._emit_front(fronts, lp, up, nreal, g_host)
+        self.last_offload_wait_seconds = self._offload_wait
         return self._finalize_fronts(fronts), tiny + tiny_host

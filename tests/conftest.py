@@ -14,6 +14,12 @@ here.
 
 import os
 
+# stash the session's original platform pin (e.g. "axon") so the opt-in
+# hardware tests (test_tpu_hw.py) can restore it in their subprocesses —
+# unsetting it entirely would re-enable the silent-CPU-fallback mode the
+# pin exists to prevent (see /root/.axon_site/sitecustomize.py)
+os.environ.setdefault("SLU_TPU_ORIG_PLATFORMS",
+                      os.environ.get("JAX_PLATFORMS", ""))
 os.environ["JAX_PLATFORMS"] = "cpu"   # for any subprocesses
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:

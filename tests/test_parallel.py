@@ -279,6 +279,18 @@ def test_host_share_split_matches_plain():
         for (lp, up), (rlp, rup) in zip(out[0], ref[0]):
             np.testing.assert_array_equal(np.asarray(lp), np.asarray(rlp))
             np.testing.assert_array_equal(np.asarray(up), np.asarray(rup))
+    # host-share combined with offload="host": the lag window must not
+    # reach into the host prefix (it would block on host compute and
+    # corrupt the comm split); result still bit-equal, all fronts numpy
+    exc = StreamExecutor(plan, "float64", offload="host", host_flops=cut)
+    assert exc.host_levels > 0
+    outc = exc(jnp.asarray(avals), jnp.asarray(thresh))
+    assert int(outc[1]) == int(ref[1])
+    assert all(isinstance(lp, np.ndarray) for lp, _ in outc[0])
+    for (lp, up), (rlp, rup) in zip(outc[0], ref[0]):
+        np.testing.assert_array_equal(np.asarray(lp), np.asarray(rlp))
+        np.testing.assert_array_equal(np.asarray(up), np.asarray(rup))
+
     # a mesh-sharded executor ignores the host share (everything stays on
     # the mesh)
     grid = gridinit(4, 2)

@@ -33,7 +33,14 @@ def _run_on_hw(code: str, timeout: float = 7200.0):
     tens of kernels) because expiry hard-kills the child, and a kill
     mid-remote-compile wedges the relay (PLAN.md hazards)."""
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)      # conftest set "cpu" for children
+    # conftest set JAX_PLATFORMS=cpu for children and stashed the
+    # session's original pin; restore it (unsetting would allow a silent
+    # CPU fallback if the accelerator plugin half-fails to register)
+    orig = env.pop("SLU_TPU_ORIG_PLATFORMS", "")
+    if orig:
+        env["JAX_PLATFORMS"] = orig
+    else:
+        env.pop("JAX_PLATFORMS", None)
     r = subprocess.run([sys.executable, "-c", code], capture_output=True,
                        text=True, timeout=timeout, cwd=REPO, env=env)
     assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
