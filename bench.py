@@ -223,6 +223,13 @@ def main():
     sf = symbolic_factorize(sym, col_order, relax=RELAX,
                             max_supernode=MAX_SUPER, amalg_tol=AMALG)
     plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH)
+    if plan.pool_size >= 2 ** 31 and not jax.config.jax_enable_x64:
+        # beyond-int32 pool (n>=~600k at f32): indices must stay int64
+        # (the reference's XSDK_INDEX_SIZE=64 tier); costs some index
+        # bandwidth on device, required for correctness
+        _log(f"pool_size {plan.pool_size:.3g} >= 2^31 — enabling x64 "
+             "index mode")
+        jax.config.update("jax_enable_x64", True)
     # numpy has no bf16, so that case stages through f32; every other
     # dtype keeps full precision.  The executor casts to DTYPE on upload;
     # the GESP threshold uses DTYPE's own epsilon.
@@ -295,7 +302,7 @@ def main():
         RESULT["mfu_pct"] = round(100.0 * plan.flops / t_dev / PEAK_F32, 2)
         if ex.last_dispatch_seconds is not None:
             RESULT["dispatch_seconds"] = round(ex.last_dispatch_seconds, 4)
-        if getattr(ex, "last_offload_wait_seconds", None):
+        if getattr(ex, "last_offload_wait_seconds", None) is not None:
             RESULT["offload_wait_seconds"] = round(
                 ex.last_offload_wait_seconds, 4)
         _log(f"rep {rep}: {dt:.3f}s -> "

@@ -31,6 +31,11 @@ def main():
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
+    # n=1M's Schur pool exceeds 2^31 entries (22 GB f32): flat pool
+    # indices need int64, which jax silently downcasts to int32 unless
+    # x64 is enabled (the reference's XSDK_INDEX_SIZE=64 build,
+    # superlu_defs.h:85-88)
+    jax.config.update("jax_enable_x64", True)
     jax.config.update("jax_compilation_cache_dir",
                       os.path.join(REPO, ".cache", "jax"))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

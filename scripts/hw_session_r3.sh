@@ -114,8 +114,12 @@ script_once baseline_fixtures scripts/baseline_fixtures_tpu.py
 script_once df64_cost scripts/df64_cost_tpu.py
 
 # ---- 6. hardware-only tests (complex on the accelerator etc.) ----
-for t in test_complex_c64_on_accelerator test_f32_device_pipeline; do
-  if [ ! -e "$MARK/hw_$t" ]; then
+# test list collected from the file so new tests are picked up; the
+# legacy combined marker (hw_tests) counts as done for all of them
+HW_TESTS=$(SLU_TPU_HW_TESTS=1 python -m pytest tests/test_tpu_hw.py \
+           --collect-only -q 2>/dev/null | grep '::' | sed 's/.*:://')
+for t in $HW_TESTS; do
+  if [ ! -e "$MARK/hw_$t" ] && [ ! -e "$MARK/hw_tests" ]; then
     wait_up
     if SLU_TPU_HW_TESTS=1 python -m pytest "tests/test_tpu_hw.py::$t" -v \
         >> "$LOG" 2>&1; then

@@ -198,6 +198,7 @@ class Df64Executor:
     def __init__(self, plan: FactorPlan, mesh=None):
         from superlu_dist_tpu.numeric.stream import _bucket_len, _pad_to
 
+        plan.check_index_width()
         self.plan = plan
         self.mesh = mesh
         self.n_avals = len(plan.pattern_indices)

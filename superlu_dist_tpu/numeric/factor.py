@@ -164,6 +164,7 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
     of extra collectives per extend-add.
     """
     dtype = jnp.dtype(dtype)
+    plan.check_index_width()
     sharding = pivot_sharding = replicated = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P

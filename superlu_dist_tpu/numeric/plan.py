@@ -90,6 +90,19 @@ class FactorPlan:
     def n_levels(self) -> int:
         return int(self.sf.sn_level.max()) + 1 if len(self.sf.sn_level) else 0
 
+    def check_index_width(self):
+        """Flat pool offsets must fit the active jax integer width.
+        Beyond 2^31 entries (n≳600k at f32) the int64 index maps need
+        jax_enable_x64 — the XSDK_INDEX_SIZE=64 build analog
+        (superlu_defs.h:85-88); without it jax silently downcasts them
+        to int32 and scatters wrap.  Called by every executor."""
+        import jax
+        if self.pool_size >= 2 ** 31 and not jax.config.jax_enable_x64:
+            raise ValueError(
+                f"pool_size {self.pool_size} exceeds int32 index range; "
+                "enable jax_enable_x64 (the XSDK_INDEX_SIZE=64 analog) — "
+                "without it jax silently downcasts the int64 index maps")
+
 
 def _bucket_sizes(max_needed: int, min_bucket: int, growth: float):
     sizes = []

@@ -113,6 +113,7 @@ class StreamExecutor:
         SLU_TPU_FRONT_BYTES_LIMIT (default 6e9) on an accelerator backend.
         """
         import os
+        plan.check_index_width()
         self.plan = plan
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
