@@ -125,3 +125,32 @@ def test_f32_factor_quality():
     want = np.linalg.solve(m, b)
     # single-precision factors: ~1e-5 relative accuracy pre-refinement
     assert np.linalg.norm(x - want) / np.linalg.norm(want) < 1e-4
+
+
+def test_index_width_guard():
+    """pool_size >= 2^31 without x64 must raise the XSDK_INDEX_SIZE=64
+    guidance instead of silently downcasting index maps (the n=1M bug:
+    flat pool offsets wrapped negative)."""
+    import dataclasses
+    import jax
+    from superlu_dist_tpu.numeric.factor import make_factor_fn
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+
+    a = poisson2d(6)
+    sym = symmetrize_pattern(a)
+    sf = symbolic_factorize(sym, np.arange(a.n_rows), relax=4,
+                            max_supernode=16)
+    plan = build_plan(sf)
+    big = dataclasses.replace(plan, pool_size=2 ** 31)
+    # x64 is ON in the suite (conftest): the guard must pass
+    big.check_index_width()
+    try:
+        jax.config.update("jax_enable_x64", False)
+        with pytest.raises(ValueError, match="XSDK_INDEX_SIZE"):
+            big.check_index_width()
+        with pytest.raises(ValueError, match="int32 index range"):
+            StreamExecutor(big, "float32")
+        with pytest.raises(ValueError, match="int32 index range"):
+            make_factor_fn(big, "float32")
+    finally:
+        jax.config.update("jax_enable_x64", True)
