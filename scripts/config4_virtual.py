@@ -1,22 +1,30 @@
 #!/usr/bin/env python
 """BASELINE config-4 class (3D Poisson, target n=1M) executed end-to-end
-on the 8-device VIRTUAL CPU mesh with the partitioned Schur pool — the
-exact multi-chip recipe (pool_partition + host-offloaded fronts) that a
-real v5p slice would run, validated at full problem size.
+on the CPU backend at full problem size.
 
 The point is EXECUTION at scale, not speed: n=1M's ~22 GB pool exceeds
-one v5e chip's HBM, so the single-tunneled-chip environment cannot run it;
-the 8-way virtual mesh (shared host RAM) proves the sharded program
-compiles AND executes with the per-device pool share genuinely smaller
-than the whole (the no-rank-holds-the-whole-factor property,
-reference SRC/pddistribute.c:322).
+one v5e chip's HBM, so the single-tunneled-chip environment cannot run
+it.  Two modes (CONFIG4_MESH):
 
-Writes docs/config4_virtual_n{n}.json and prints one JSON line.
-Env: CONFIG4_NX (default 100 -> n=1e6), CONFIG4_DTYPE (float32).
+- "1" (default): single-device execution — the fastest path to a
+  numeric-at-n=1M artifact (the pool partition is separately proven
+  bit-equal at n=102,400, tests/test_pool_partition.py).  Artifact:
+  docs/config4_virtual_n{n}_1dev.json.
+- "RxC" (e.g. "4x2"): partitioned Schur pool over the R*C-device
+  virtual mesh — the real multi-chip recipe (pool_partition +
+  host-offloaded fronts); proves the sharded program compiles AND
+  executes with the per-device pool share genuinely smaller than the
+  whole (the no-rank-holds-the-whole-factor property, reference
+  SRC/pddistribute.c:322).  On this 1-core box the collectives are
+  hours of memcpy at n=1M.  Artifact: docs/config4_virtual_n{n}.json.
+
+Env: CONFIG4_NX (default 100 -> n=1e6), CONFIG4_DTYPE (float32),
+CONFIG4_MESH (default "1").
 """
 
 import json
 import os
+import re
 import sys
 import time
 
@@ -28,6 +36,14 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def main():
+    # the in-process CPU communicator's rendezvous hard-kills the process
+    # when a collective stalls past its terminate timeout — on this
+    # 1-core box an 8-thread all-gather of a ~22 GB pool legitimately
+    # takes minutes, so raise both dials before backend init
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+        + " --xla_cpu_collective_call_terminate_timeout_seconds=14400")
     import jax
     jax.config.update("jax_platforms", "cpu")
     jax.config.update("jax_num_cpu_devices", 8)
@@ -56,6 +72,11 @@ def main():
 
     nx = int(os.environ.get("CONFIG4_NX", "100"))
     dtype = os.environ.get("CONFIG4_DTYPE", "float32")
+    mesh_spec = os.environ.get("CONFIG4_MESH", "1")
+    if mesh_spec != "1" and not re.fullmatch(r"\d+x\d+", mesh_spec):
+        raise SystemExit(
+            f"CONFIG4_MESH={mesh_spec!r}: expected '1' (single device) "
+            "or 'RxC' (virtual mesh, e.g. '4x2')")
     t_all = time.perf_counter()
 
     def log(msg):
@@ -77,12 +98,17 @@ def main():
         f"pool={plan.pool_size * 4 / 1e9:.1f} GB(f32) "
         f"flops={plan.flops / 1e12:.2f} TF")
 
-    grid = gridinit(4, 2)
-    share = -(-plan.pool_size // grid.mesh.size)
-    assert share < plan.pool_size, "pool must exceed one device share"
-
-    ex = StreamExecutor(plan, dtype, mesh=grid.mesh, pool_partition=True,
-                        offload="host")
+    if mesh_spec == "1":
+        grid = None
+        share = plan.pool_size
+        ex = StreamExecutor(plan, dtype, offload="none")
+    else:
+        nprow, npcol = (int(v) for v in mesh_spec.split("x"))
+        grid = gridinit(nprow, npcol)
+        share = -(-plan.pool_size // grid.mesh.size)
+        assert share < plan.pool_size, "pool must exceed one device share"
+        ex = StreamExecutor(plan, dtype, mesh=grid.mesh,
+                            pool_partition=True, offload="host")
     avals = np.asarray(sym.data[sf.value_perm], dtype=np.float32)
     eps = float(jnp.finfo(jnp.dtype(dtype)).eps)
     thresh = np.asarray(np.sqrt(eps) * a.norm_max(), np.float32)
@@ -114,7 +140,9 @@ def main():
     log(f"solve+IR {t_solve:.1f}s  residual {resid:.2e}")
 
     rec = {"config": "4-virtual", "matrix": f"poisson3d nx={nx}", "n": n,
-           "mesh": "4x2 virtual-cpu", "pool_partition": True,
+           "mesh": (f"{mesh_spec} virtual-cpu" if grid is not None
+                    else "single-device cpu"),
+           "pool_partition": grid is not None,
            "pool_bytes_total": plan.pool_size * 4,
            "pool_share_per_device": int(share) * 4,
            "dtype": dtype, "flops": plan.flops,
@@ -122,11 +150,16 @@ def main():
            "factor_seconds_incl_compile": round(t_factor, 1),
            "solve_ir_seconds": round(t_solve, 1),
            "residual": resid, "tiny_pivots": int(tiny),
-           "backend": "cpu-virtual-mesh",
+           "backend": ("cpu-virtual-mesh" if grid is not None
+                       else "cpu-single-device"),
            "note": ("execution-at-scale artifact: single-core host, "
-                    "timing not a perf claim; the same program shards "
-                    "onto a real multi-chip mesh")}
-    out = os.path.join(REPO, "docs", f"config4_virtual_n{n}.json")
+                    "timing not a perf claim"
+                    + ("; the same sharded program runs on a real "
+                       "multi-chip mesh" if grid is not None else ""))}
+    # the unsuffixed path is reserved for the partitioned-mesh artifact
+    # (the stronger claim); single-device runs carry the _1dev suffix
+    suffix = "_1dev" if grid is None else ""
+    out = os.path.join(REPO, "docs", f"config4_virtual_n{n}{suffix}.json")
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec), flush=True)
