@@ -339,11 +339,14 @@ def main():
                                     lu.solve_factored)
         RESULT["residual"] = float(np.linalg.norm(b - a.matvec(x))
                                    / max(np.linalg.norm(b), 1e-300))
-        # warm solve timing (the reference's solve Mflops line,
-        # SRC/util.c:521-529; flops ~ 4*nnz(L) per solve)
+        # warm solve timing + rate — the reference's solve Mflops line
+        # (SRC/util.c:521-529); flops ~ 2*(nnz(L)+nnz(U)) per RHS
         t0 = time.perf_counter()
         lu.solve_factored(b)
         RESULT["solve_seconds"] = round(time.perf_counter() - t0, 5)
+        RESULT["solve_gflops"] = round(
+            2.0 * (sf.nnz_L + sf.nnz_U)
+            / max(RESULT["solve_seconds"], 1e-12) / 1e9, 3)
         solve_path = ("device" if lu.solve_path == "auto"
                       and backend != "cpu" and not numeric.on_host
                       else "host")

@@ -44,9 +44,21 @@ def main():
         os.environ.get("XLA_FLAGS", "")
         + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
         + " --xla_cpu_collective_call_terminate_timeout_seconds=14400")
+    # parse + validate the mesh spec BEFORE anything expensive (and
+    # before the device count is pinned)
+    mesh_spec = os.environ.get("CONFIG4_MESH", "1")
+    if mesh_spec == "1":
+        n_dev = 1
+    else:
+        m = re.fullmatch(r"(\d+)x(\d+)", mesh_spec)
+        n_dev = int(m.group(1)) * int(m.group(2)) if m else 0
+        if n_dev < 2:
+            raise SystemExit(
+                f"CONFIG4_MESH={mesh_spec!r}: expected '1' (single "
+                "device) or 'RxC' with R*C >= 2 (e.g. '4x2')")
     import jax
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    jax.config.update("jax_num_cpu_devices", max(n_dev, 1))
     # n=1M's Schur pool exceeds 2^31 entries (22 GB f32): flat pool
     # indices need int64, which jax silently downcasts to int32 unless
     # x64 is enabled (the reference's XSDK_INDEX_SIZE=64 build,
@@ -72,11 +84,6 @@ def main():
 
     nx = int(os.environ.get("CONFIG4_NX", "100"))
     dtype = os.environ.get("CONFIG4_DTYPE", "float32")
-    mesh_spec = os.environ.get("CONFIG4_MESH", "1")
-    if mesh_spec != "1" and not re.fullmatch(r"\d+x\d+", mesh_spec):
-        raise SystemExit(
-            f"CONFIG4_MESH={mesh_spec!r}: expected '1' (single device) "
-            "or 'RxC' (virtual mesh, e.g. '4x2')")
     t_all = time.perf_counter()
 
     def log(msg):
