@@ -21,6 +21,7 @@ keys), not O(#groups).
 from __future__ import annotations
 
 import functools
+import sys
 import time
 
 import numpy as np
@@ -289,6 +290,11 @@ class StreamExecutor:
         profile = bool(os.environ.get("SLU_TPU_PROFILE"))
         if profile:
             self.last_profile = []
+        # SLU_TPU_PROGRESS=K: log every K groups/levels issued (async
+        # issue order, not completion) — hours-long runs are otherwise
+        # silent between plan build and the final block_until_ready
+        progress = int(os.environ.get("SLU_TPU_PROGRESS", "0") or 0)
+        self._progress = max(progress, 0)
         self._offload_wait = 0.0
         if self.granularity == "level":
             return self._call_levels(avals, pool, thresh, profile)
@@ -314,6 +320,10 @@ class StreamExecutor:
                 avals, thresh = avals_dev, thresh_dev
                 on_host_now = False
             kern = _kernel(*key, self.mesh, self.pool_partition, pivot)
+            if self._progress and gi % self._progress == 0:
+                print(f"[stream] issuing group {gi}/{len(self._steps)} "
+                      f"(+{time.perf_counter() - t_issue0:.1f}s)",
+                      file=sys.stderr, flush=True)
             if profile:
                 t0 = time.perf_counter()
             (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)
@@ -421,6 +431,10 @@ class StreamExecutor:
                 avals, thresh = avals_dev, thresh_dev
                 on_host_now = False
             fn = self._level_fn(level, entries)
+            if self._progress:
+                print(f"[stream] issuing level {level} "
+                      f"({len(entries)} groups)", file=sys.stderr,
+                      flush=True)
             if profile:
                 t0 = time.perf_counter()
             outs, pool, t = fn(avals, pool, thresh)
