@@ -18,8 +18,11 @@ it.  Two modes (CONFIG4_MESH):
   SRC/pddistribute.c:322).  On this 1-core box the collectives are
   hours of memcpy at n=1M.  Artifact: docs/config4_virtual_n{n}.json.
 
-Env: CONFIG4_NX (default 100 -> n=1e6), CONFIG4_DTYPE (float32),
-CONFIG4_MESH (default "1").
+Env: CONFIG4_NX (default 100 -> n=1e6), CONFIG4_MESH (default "1"),
+CONFIG4_DTYPE (default float32; a complex dtype, e.g. complex64, runs
+the z-twin class — off-diagonals rotated into the complex plane — and
+suffixes the artifact with the canonical dtype name, e.g.
+docs/config4_virtual_n{n}_complex64_1dev.json).
 """
 
 import json
@@ -91,8 +94,19 @@ def main():
               file=sys.stderr, flush=True)
 
     a = poisson3d(nx)
+    jdt = np.dtype(dtype)
+    if np.issubdtype(jdt, np.complexfloating):
+        # complex variant (the z-twin class, reference pzgstrf.c): rotate
+        # the off-diagonals into the complex plane — non-Hermitian, same
+        # pattern, still diagonally dominant
+        from superlu_dist_tpu.sparse.formats import SparseCSR
+        cdata = a.data.astype(np.complex128)
+        off = a.indices != np.repeat(np.arange(a.n_rows),
+                                     np.diff(a.indptr))
+        cdata[off] *= (0.8 + 0.6j)
+        a = SparseCSR(a.n_rows, a.n_cols, a.indptr, a.indices, cdata)
     n = a.n_rows
-    log(f"matrix n={n} nnz={a.nnz}")
+    log(f"matrix n={n} nnz={a.nnz} dtype={dtype}")
 
     t0 = time.perf_counter()
     sym = symmetrize_pattern(a)
@@ -102,7 +116,7 @@ def main():
     plan = build_plan(sf, min_bucket=32, growth=1.3)
     t_analyze = time.perf_counter() - t0
     log(f"analysis {t_analyze:.1f}s; groups={len(plan.groups)} "
-        f"pool={plan.pool_size * 4 / 1e9:.1f} GB(f32) "
+        f"pool={plan.pool_size * jdt.itemsize / 1e9:.1f} GB({dtype}) "
         f"flops={plan.flops / 1e12:.2f} TF")
 
     if mesh_spec == "1":
@@ -116,9 +130,10 @@ def main():
         assert share < plan.pool_size, "pool must exceed one device share"
         ex = StreamExecutor(plan, dtype, mesh=grid.mesh,
                             pool_partition=True, offload="host")
-    avals = np.asarray(sym.data[sf.value_perm], dtype=np.float32)
-    eps = float(jnp.finfo(jnp.dtype(dtype)).eps)
-    thresh = np.asarray(np.sqrt(eps) * a.norm_max(), np.float32)
+    avals = np.asarray(sym.data[sf.value_perm], dtype=jdt)
+    real_dt = np.finfo(jdt).dtype          # f32 for c64, identity for real
+    eps = float(np.finfo(real_dt).eps)
+    thresh = np.asarray(np.sqrt(eps) * a.norm_max(), real_dt)
 
     t0 = time.perf_counter()
     fronts, tiny = ex(jnp.asarray(avals), jnp.asarray(thresh))
@@ -150,9 +165,14 @@ def main():
            "mesh": (f"{mesh_spec} virtual-cpu" if grid is not None
                     else "single-device cpu"),
            "pool_partition": grid is not None,
-           "pool_bytes_total": plan.pool_size * 4,
-           "pool_share_per_device": int(share) * 4,
-           "dtype": dtype, "flops": plan.flops,
+           "pool_bytes_total": plan.pool_size * jdt.itemsize,
+           "pool_share_per_device": int(share) * jdt.itemsize,
+           # complex MACs are ~4 real flops (reference z-routines count
+           # 6+2 per mult+add); report real-equivalent so rates are
+           # comparable across dtypes
+           "dtype": jdt.name,
+           "flops": plan.flops * (4.0 if np.issubdtype(
+               jdt, np.complexfloating) else 1.0),
            "analyze_seconds": round(t_analyze, 1),
            "factor_seconds_incl_compile": round(t_factor, 1),
            "solve_ir_seconds": round(t_solve, 1),
@@ -166,6 +186,8 @@ def main():
     # the unsuffixed path is reserved for the partitioned-mesh artifact
     # (the stronger claim); single-device runs carry the _1dev suffix
     suffix = "_1dev" if grid is None else ""
+    if jdt != np.dtype(np.float32):
+        suffix = f"_{jdt.name}" + suffix
     out = os.path.join(REPO, "docs", f"config4_virtual_n{n}{suffix}.json")
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
