@@ -115,9 +115,14 @@ def main():
                             amalg_tol=1.2)
     plan = build_plan(sf, min_bucket=32, growth=1.3)
     t_analyze = time.perf_counter() - t0
+    # complex MACs are ~4 real flops (reference z-routines count 6+2 per
+    # mult+add); one real-equivalent figure feeds both the log and the
+    # artifact so they cannot diverge
+    flops_req = plan.flops * (4.0 if np.issubdtype(
+        jdt, np.complexfloating) else 1.0)
     log(f"analysis {t_analyze:.1f}s; groups={len(plan.groups)} "
         f"pool={plan.pool_size * jdt.itemsize / 1e9:.1f} GB({dtype}) "
-        f"flops={plan.flops / 1e12:.2f} TF")
+        f"flops={flops_req / 1e12:.2f} TF (real-equivalent)")
 
     if mesh_spec == "1":
         grid = None
@@ -167,12 +172,8 @@ def main():
            "pool_partition": grid is not None,
            "pool_bytes_total": plan.pool_size * jdt.itemsize,
            "pool_share_per_device": int(share) * jdt.itemsize,
-           # complex MACs are ~4 real flops (reference z-routines count
-           # 6+2 per mult+add); report real-equivalent so rates are
-           # comparable across dtypes
            "dtype": jdt.name,
-           "flops": plan.flops * (4.0 if np.issubdtype(
-               jdt, np.complexfloating) else 1.0),
+           "flops": flops_req,
            "analyze_seconds": round(t_analyze, 1),
            "factor_seconds_incl_compile": round(t_factor, 1),
            "solve_ir_seconds": round(t_solve, 1),

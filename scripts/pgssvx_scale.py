@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""Multi-process driver (pgssvx, the pdgssvx-with-NR_loc analog) at the
+driver bench size: block-row distributed A and b across 4 real
+processes, shared-memory tree-collective gather to the factoring root,
+distributed refinement back out (parallel/pgsrfs.py) — the capability
+the reference exercises with `mpiexec -n 4 pdtest` on one box
+(SURVEY.md §4, .travis_tests.sh).
+
+Writes docs/pgssvx_4proc_n{n}.json.  Env: PGS_NX (default 48).
+"""
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _worker(name, n_ranks, rank, part, b_loc, q):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.parallel.pgssvx import pgssvx
+    from superlu_dist_tpu.utils.options import Options
+    with TreeComm(name, n_ranks, rank, max_len=1 << 20,
+                  create=False) as tc:
+        x, info = pgssvx(tc, Options(), part, b_loc)
+        q.put((rank, info,
+               float(np.linalg.norm(x)) if x is not None else None))
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".cache", "jax"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.parallel.pgssvx import pgssvx
+
+    nx = int(os.environ.get("PGS_NX", "48"))
+    a = poisson3d(nx)
+    n = a.n_rows
+    xtrue = np.random.default_rng(2).standard_normal(n)
+    b = a.matvec(xtrue)
+
+    nranks = 4
+    parts = distribute_rows(a, nranks)
+    b_blocks = [b[p.fst_row:p.fst_row + p.m_loc] for p in parts]
+
+    name = f"/slu_pgs_{os.getpid()}"
+    t0 = time.perf_counter()
+    procs = []
+    owner = TreeComm(name, nranks, 0, max_len=1 << 20, create=True)
+    try:
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        procs += [ctx.Process(target=_worker,
+                              args=(name, nranks, r, parts[r], b_blocks[r],
+                                    q))
+                  for r in range(1, nranks)]
+        for p in procs:
+            p.start()
+        x, info = pgssvx(owner, slu.Options(), parts[0], b_blocks[0])
+        t_total = time.perf_counter() - t0
+        others = [q.get(timeout=1800) for _ in procs]
+        for p in procs:
+            p.join(timeout=60)
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+                p.join(timeout=30)
+        owner.close(unlink=True)
+    assert info == 0 and all(i == 0 for _, i, _ in others), \
+        (info, others)
+    resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
+    err = float(np.max(np.abs(x - xtrue)) / np.max(np.abs(x)))
+    rec = {"driver": "pgssvx", "processes": nranks, "n": n,
+           "matrix": f"poisson3d nx={nx}", "total_seconds": round(t_total, 1),
+           "residual": resid, "xtrue_inf_error": err, "info": info,
+           "backend": "cpu, 4 host processes over shm tree collectives"}
+    with open(os.path.join(REPO, "docs", f"pgssvx_4proc_n{n}.json"),
+              "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    assert resid < 1e-10, resid
+
+
+if __name__ == "__main__":
+    main()
