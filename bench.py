@@ -339,6 +339,10 @@ def main():
                                     lu.solve_factored)
         RESULT["residual"] = float(np.linalg.norm(b - a.matvec(x))
                                    / max(np.linalg.norm(b), 1e-300))
+        # ||x - xtrue||_inf / ||x||_inf — the pdinf_norm_error metric
+        # (EXAMPLE/pddrive.c:235)
+        RESULT["xtrue_inf_error"] = float(
+            np.max(np.abs(x - xt)) / max(np.max(np.abs(x)), 1e-300))
         # warm solve timing + rate — the reference's solve Mflops line
         # (SRC/util.c:521-529); flops ~ 2*(nnz(L)+nnz(U)) per RHS
         t0 = time.perf_counter()
