@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""df64 (emulated-double) at scale on a genuinely ill-conditioned system.
+
+Shifts the 3D Poisson operator to A − σI with σ just below the ANALYTIC
+λ_min = 6 − 6·cos(π/(nx+1)) (7-pt stencil eigenvalues are
+6 − 2Σ cos(k_iπ/(nx+1)) — no dense eigensolve needed at scale), giving
+κ ≈ DF64S_KAPPA (default 1e10).  At this conditioning f32 factors +
+f64 IR converge on the residual but the SOLUTION is garbage (forward
+error ≈ κ·2⁻²⁴ ≫ 1e-3), while df64 factors (~2⁻⁴⁸) recover it — the
+SURVEY §7 hard-part-1 story (f64-on-TPU) demonstrated beyond toy size.
+
+Writes docs/df64_scale_n{n}.json.  Env: DF64S_NX (default 16 → n=4096),
+DF64S_KAPPA (default 1e10).
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def main():
+    # error-free df64 transformations must survive the CPU compiler:
+    # fusion re-associates the two-float arithmetic (same recipe as
+    # tests/test_df64.py); TPU runs don't need this
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_disable_hlo_passes="
+                                 "fusion,cpu-instruction-fusion")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".cache", "jax"))
+    import superlu_dist_tpu as slu
+    import superlu_dist_tpu.sparse.formats as fmts
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.utils.options import Options
+
+    nx = int(os.environ.get("DF64S_NX", "16"))
+    kappa = float(os.environ.get("DF64S_KAPPA", "1e10"))
+
+    a0 = poisson3d(nx)
+    n = a0.n_rows
+    lmin = 6.0 - 6.0 * np.cos(np.pi / (nx + 1))
+    lmax = 6.0 + 6.0 * np.cos(np.pi / (nx + 1))
+    delta = lmax / (lmin * kappa)
+    sigma = lmin * (1.0 - delta)
+    rows = np.repeat(np.arange(n), np.diff(a0.indptr))
+    vals = a0.data.copy()
+    vals[rows == a0.indices] -= sigma
+    a = fmts.SparseCSR(n, n, a0.indptr, a0.indices, vals)
+    xt = np.random.default_rng(0).standard_normal(n)
+    b = a.matvec(xt)
+    print(f"[df64s] n={n} sigma={sigma:.6f} target kappa={kappa:.1e}",
+          file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    x32, _, _, i32 = slu.gssvx(Options(factor_dtype="float32"), a, b)
+    t32 = time.perf_counter() - t0
+    e32 = float(np.linalg.norm(x32 - xt) / np.linalg.norm(xt))
+    print(f"[df64s] f32+IR {t32:.1f}s forward_err={e32:.2e}",
+          file=sys.stderr, flush=True)
+
+    t0 = time.perf_counter()
+    xdf, _, _, idf = slu.gssvx(Options(factor_dtype="df64"), a, b)
+    tdf = time.perf_counter() - t0
+    edf = float(np.linalg.norm(xdf - xt) / np.linalg.norm(xt))
+    rdf = float(np.linalg.norm(b - a.matvec(xdf)) / np.linalg.norm(b))
+    print(f"[df64s] df64 {tdf:.1f}s forward_err={edf:.2e} resid={rdf:.2e}",
+          file=sys.stderr, flush=True)
+
+    rec = {"experiment": "df64-vs-f32IR at kappa",
+           "matrix": f"poisson3d nx={nx} shifted near lambda_min",
+           "n": n, "kappa_target": kappa,
+           "f32_ir_forward_error": e32, "df64_forward_error": edf,
+           "df64_residual": rdf, "info": [i32, idf],
+           "f32_seconds": round(t32, 1), "df64_seconds": round(tdf, 1),
+           "backend": "cpu (1 core; timing not a perf claim)"}
+    with open(os.path.join(REPO, "docs", f"df64_scale_n{n}.json"),
+              "w") as f:
+        json.dump(rec, f, indent=1)
+    print(json.dumps(rec), flush=True)
+    assert i32 == 0 and idf == 0
+    # expectations scale with κ: f32 forward error ~ κ·2⁻²⁴, df64's
+    # ~ κ·2⁻⁴⁸ — use two-orders-of-magnitude slack on each side
+    assert e32 > 0.01 * kappa * 2.0 ** -24, (e32, kappa)
+    assert edf < 100.0 * kappa * 2.0 ** -48, (edf, kappa)
+
+
+if __name__ == "__main__":
+    main()
