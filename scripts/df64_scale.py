@@ -86,9 +86,11 @@ def main():
         json.dump(rec, f, indent=1)
     print(json.dumps(rec), flush=True)
     assert i32 == 0 and idf == 0
-    # expectations scale with κ: f32 forward error ~ κ·2⁻²⁴, df64's
-    # ~ κ·2⁻⁴⁸ — use two-orders-of-magnitude slack on each side
-    assert e32 > 0.01 * kappa * 2.0 ** -24, (e32, kappa)
+    # the experiment's claim is the RATIO (df64 recovers digits the f32
+    # factors cannot) plus an absolute bound that scales with κ·2⁻⁴⁸;
+    # e32's absolute level depends on how far IR stalls, so it is not
+    # asserted directly
+    assert edf < 1e-3 * max(e32, 1e-300), (edf, e32)
     assert edf < 100.0 * kappa * 2.0 ** -48, (edf, kappa)
 
 
