@@ -1,0 +1,31 @@
+"""Shared setup for the CPU-backend measurement scripts in this
+directory (config4_virtual, df64_scale, pgssvx_scale).
+
+Not used by the TPU-session scripts (baseline_fixtures_tpu,
+df64_cost_tpu) — those must NOT pin the CPU platform.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cpu_session(n_devices: int = 1, x64: bool = True):
+    """Pin the CPU platform (with `n_devices` virtual devices), enable
+    x64, and point jax at the persistent compile cache.  Must run before
+    the first jax operation; any XLA_FLAGS the caller needs go into the
+    environment BEFORE this call (backend init snapshots them).
+    Returns the configured jax module."""
+    sys.path.insert(0, REPO)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    if n_devices > 1:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    if x64:
+        jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(REPO, ".cache", "jax"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    return jax

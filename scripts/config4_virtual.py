@@ -33,9 +33,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, cpu_session  # noqa: E402
 
 
 def main():
@@ -59,18 +58,10 @@ def main():
             raise SystemExit(
                 f"CONFIG4_MESH={mesh_spec!r}: expected '1' (single "
                 "device) or 'RxC' with R*C >= 2 (e.g. '4x2')")
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", max(n_dev, 1))
-    # n=1M's Schur pool exceeds 2^31 entries (22 GB f32): flat pool
-    # indices need int64, which jax silently downcasts to int32 unless
-    # x64 is enabled (the reference's XSDK_INDEX_SIZE=64 build,
+    # x64: n=1M's Schur pool exceeds 2^31 entries — flat pool indices
+    # need int64 (the reference's XSDK_INDEX_SIZE=64 build,
     # superlu_defs.h:85-88)
-    jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".cache", "jax"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax = cpu_session(n_devices=n_dev)
     import jax.numpy as jnp
 
     from superlu_dist_tpu.models.gallery import poisson3d

@@ -20,8 +20,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, cpu_session  # noqa: E402
 
 
 def main():
@@ -31,11 +31,7 @@ def main():
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_disable_hlo_passes="
                                  "fusion,cpu-instruction-fusion")
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".cache", "jax"))
+    cpu_session()
     import superlu_dist_tpu as slu
     import superlu_dist_tpu.sparse.formats as fmts
     from superlu_dist_tpu.models.gallery import poisson3d

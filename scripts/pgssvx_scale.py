@@ -17,8 +17,8 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO, cpu_session  # noqa: E402
 
 
 def _worker(name, n_ranks, rank, part, b_loc, q):
@@ -35,13 +35,7 @@ def _worker(name, n_ranks, rank, part, b_loc, q):
 
 
 def main():
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".cache", "jax"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    cpu_session()
     import superlu_dist_tpu as slu
     from superlu_dist_tpu.models.gallery import poisson3d
     from superlu_dist_tpu.parallel.dist import distribute_rows
