@@ -156,11 +156,8 @@ def main():
         # reliably pins the CPU backend (same recipe as tests/conftest.py)
         jax.config.update("jax_platforms", "cpu")
 
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                   ".cache", "jax"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
 
     from superlu_dist_tpu.models.gallery import poisson3d
     from superlu_dist_tpu.sparse.formats import symmetrize_pattern

@@ -24,8 +24,6 @@ def cpu_session(n_devices: int = 1, x64: bool = True):
         jax.config.update("jax_num_cpu_devices", n_devices)
     if x64:
         jax.config.update("jax_enable_x64", True)
-    jax.config.update("jax_compilation_cache_dir",
-                      os.path.join(REPO, ".cache", "jax"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
     return jax

@@ -20,12 +20,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 def main():
     import jax
-    jax.config.update(
-        "jax_compilation_cache_dir",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))), ".cache", "jax"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
     import jax.numpy as jnp
 
     from superlu_dist_tpu.models.gallery import poisson3d

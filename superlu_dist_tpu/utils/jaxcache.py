@@ -1,0 +1,29 @@
+"""Persistent XLA compile-cache policy, in one place.
+
+Every driver/bench/measurement entry point points jax at the repo-local
+cache (`.cache/jax`, gitignored) so kernels compile once per machine —
+through the remote-compile TPU tunnel a single kernel costs ~8-40 s, so
+cache reuse is the difference between a bench that finishes and one
+that hits its watchdog (BASELINE.md round-2/3 compile-wall history).
+"""
+
+import os
+
+
+def enable_compile_cache(cache_dir: str | None = None) -> None:
+    """Point jax at the persistent compile cache (default: the repo's
+    `.cache/jax`, resolved relative to this package).  Caches every
+    entry regardless of size/compile time.  Never raises — the cache is
+    an optimization, not a failure reason.  Call any time before (or
+    after) backend init; only subsequent compiles are affected."""
+    import jax
+    if cache_dir is None:
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".cache", "jax")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    except Exception:
+        pass
