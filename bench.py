@@ -27,7 +27,9 @@ numbers, marked backend="cpu".
 Prints ONE JSON line:
   {"metric": ..., "value": GFLOPS, "unit": "GFLOP/s", "vs_baseline": ...}
 
-Env knobs: BENCH_NX (grid edge, default 48 -> n=110592), BENCH_REPS,
+Env knobs: BENCH_NX (grid edge, default 48 -> n=110592; a default-config
+TPU run downsizes to 16 when the compile cache is cold and the deadline
+is tight — see the cold-cache guard in main), BENCH_REPS,
 BENCH_DEADLINE_S (watchdog, default 1350), BENCH_PEAK_F32_TFLOPS (MFU
 denominator), BENCH_NO_PROBE (skip the device-reachability probe).
 """
@@ -171,6 +173,33 @@ def main():
     from superlu_dist_tpu.refine.ir import iterative_refinement
 
     NX = int(os.environ.get("BENCH_NX", "48"))   # n = NX^3 = 110,592:
+    # Cold-cache guard: compiling the default NX=48 kernel set through
+    # the remote tunnel takes ~20-40 min — far past the default watchdog
+    # — and a watchdog kill mid-compile both yields a null row AND wedges
+    # the relay (the r2/r3 outage trigger).  .hw_done/nx48_default marks
+    # the default set warm in .cache/jax (written by
+    # scripts/hw_session_r3.sh AND by this script itself after a
+    # successful default-config warm); without it, a DEFAULT-config TPU
+    # run inside a tight deadline drops to NX=16, whose 14 kernels
+    # compile in ~2 min — a real measured number instead of a timeout.
+    # Any kernel-set-affecting env knob means a deliberate sweep run
+    # with its own deadline discipline: the guard stays out of the way.
+    _KNOBS = ("BENCH_NX", "BENCH_DTYPE", "BENCH_GRANULARITY",
+              "BENCH_MAXSUPER", "BENCH_RELAX", "BENCH_MINBUCKET",
+              "BENCH_GROWTH", "BENCH_AMALG", "BENCH_MATRIX",
+              "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
+              "SLU_TPU_HOST_FLOPS")
+    _default_cfg = not any(k in os.environ for k in _KNOBS)
+    _marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".hw_done", "nx48_default")
+    if (_default_cfg and jax.default_backend() != "cpu"
+            and DEADLINE - (time.perf_counter() - T0) < 2400
+            and not os.path.exists(_marker)):
+        _log("cold compile cache + tight deadline: dropping to NX=16 "
+             "(guaranteed-compile size) — run scripts/hw_session_r3.sh "
+             "to warm the NX=48 set")
+        RESULT["downsized_from_nx"] = NX
+        NX = 16
     # large enough that the big separator fronts drive the MXU (the r1
     # bench at NX=24 was latency-bound, VERDICT weak #3); with compact
     # (lpanel, upanel) factor storage the whole factorization fits
@@ -282,6 +311,12 @@ def main():
     jax.block_until_ready(out[0])
     _log(f"warm (compile) done, kernels={ex.n_kernels}, "
          f"offload={ex.offload}")
+    if _default_cfg and NX == 48 and backend != "cpu":
+        # default NX=48 set is now in .cache/jax: future default runs
+        # need not downsize (self-healing, same marker the hardware
+        # session writes)
+        os.makedirs(os.path.dirname(_marker), exist_ok=True)
+        open(_marker, "a").close()
 
     RESULT["phase"] = "factor-time"
     times = []
