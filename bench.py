@@ -229,6 +229,15 @@ def main():
 
     backend = jax.default_backend()
     RESULT["backend"] = backend
+    if os.environ.get("BENCH_REQUIRE_TPU") and backend == "cpu":
+        # closes the BENCH_NO_PROBE hole: with the probe skipped the
+        # earlier require-check can't fire, so verify the resolved
+        # backend itself — a TPU-only sweep must never record a CPU row
+        RESULT["phase"] = "tpu-unreachable"
+        _log("BENCH_REQUIRE_TPU set but the backend resolved to cpu — "
+             "refusing to record a CPU row")
+        _emit(final=True)
+        return
     RESULT["phase"] = "prepare"
 
     # BENCH_MATRIX=geo3d swaps in the irregular FEM-like family
@@ -275,8 +284,13 @@ def main():
     # "level" (one program per elimination level), or "fused" (the WHOLE
     # factorization as one XLA program — viable again now that
     # amalgamation leaves ~45 groups; zero dispatch overhead, XLA
-    # schedules across groups)
-    gran = os.environ.get("BENCH_GRANULARITY", "group")
+    # schedules across groups).  Default follows get_executor's "auto"
+    # rule (numeric/factor.py): fused on CPU — per-group streaming there
+    # spent 56% of factor time in Python dispatch (BENCH_r03, 0.66x
+    # scipy) while compile is cheap; group on accelerators, where
+    # per-kernel compile through the tunnel dominates instead.
+    gran = os.environ.get("BENCH_GRANULARITY",
+                          "fused" if backend == "cpu" else "group")
     if gran == "fused":
         from superlu_dist_tpu.numeric.factor import make_factor_fn
 

@@ -59,13 +59,18 @@ run() {  # run <marker> <deadline_s> [ENV=VAL ...]
   [ -e "$MARK/$mark" ] && return 0
   wait_up
   echo "[hw] $(date -u +%H:%M:%S) start $mark: $*" >&2
+  # a crashed bench emits no row: require a NEW line AND rc=0 before
+  # marking done, else tail -1 would re-judge the previous config's row
+  local n0
+  n0=$(wc -l < "$OUT" 2>/dev/null || echo 0)
   env "$@" BENCH_REPS=3 BENCH_REQUIRE_TPU=1 BENCH_DEADLINE_S="$deadline" \
       python bench.py >> "$OUT" 2>> "$LOG"
-  if row_ok; then
+  local rc=$?
+  if [ "$rc" -eq 0 ] && [ "$(wc -l < "$OUT")" -gt "$n0" ] && row_ok; then
     touch "$MARK/$mark"
     echo "[hw] $(date -u +%H:%M:%S) done $mark" >&2
   else
-    echo "[hw] $(date -u +%H:%M:%S) $mark yielded no TPU number" >&2
+    echo "[hw] $(date -u +%H:%M:%S) $mark yielded no TPU number (rc=$rc)" >&2
   fi
 }
 

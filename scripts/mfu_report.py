@@ -17,8 +17,13 @@ import sys
 
 
 def main():
+    import os
+    # live session logs are gitignored; fall back to the committed
+    # docs/ snapshot of the latest hardware session when absent
     out = sys.argv[1] if len(sys.argv) > 1 else "tune_results.jsonl"
     err = sys.argv[2] if len(sys.argv) > 2 else "tune_results.err"
+    if len(sys.argv) <= 1 and not os.path.exists(out):
+        out, err = "docs/tune_results_r3.jsonl", "docs/tune_results_r3.err"
 
     rows = []
     try:

@@ -296,16 +296,13 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
             if np.issubdtype(a.data.dtype, np.complexfloating):
                 raise SuperLUError("factor_dtype='df64' supports real "
                                    "matrices only (use complex128 on CPU)")
-            if options.pool_partition:
-                raise SuperLUError(
-                    "factor_dtype='df64' does not support pool_partition "
-                    "yet (its hi/lo pools are replicated)")
             from superlu_dist_tpu.numeric.df64_factor import (
                 df64_numeric_factorize)
             numeric = df64_numeric_factorize(
                 plan, bvals, anorm,
                 replace_tiny=options.replace_tiny_pivot,
-                mesh=grid.mesh if grid is not None else None)
+                mesh=grid.mesh if grid is not None else None,
+                pool_partition=options.pool_partition)
         else:
             numeric = numeric_factorize(
                 plan, bvals, anorm, dtype=dtype,

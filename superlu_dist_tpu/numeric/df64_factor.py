@@ -101,22 +101,29 @@ def df64_partial_front_factor(fh, fl, thresh, w):
 
 
 @functools.lru_cache(maxsize=None)
-def _df64_group_kernel(dims, child_shapes, pool_size, mesh=None):
+def _df64_group_kernel(dims, child_shapes, pool_size, mesh=None,
+                       pool_partition=False):
     """One (level, bucket) group in df64: assemble (hi, lo), factor,
     scatter the Schur block into the (hi, lo) pools.
 
     With a mesh, the batch dimension shards over "snode" (the vmapped
     elimination is per-front independent, so sharding cannot perturb the
-    error-free transforms); the pools stay replicated.  The "panel" axis
-    is idle here — splitting the masked elimination's minor dims would
-    turn every per-step row/column reduction into a collective."""
+    error-free transforms).  The "panel" axis is idle here — splitting
+    the masked elimination's minor dims would turn every per-step
+    row/column reduction into a collective.  pool_partition shards the
+    hi/lo Schur pools 1-D across ALL mesh devices (same layout as the
+    f32 path, factor.pool_spec): per-chip pool memory divides by the
+    device count, so the df64 tier reaches the same n≈1M class as f32.
+    Sharding a scatter/gather cannot perturb the error-free transforms
+    either — each pool entry still receives exactly the same summands in
+    the same order."""
     batch, m, w, u = dims
     front_sharding = pool_sharding = None
     if mesh is not None:
         from jax.sharding import NamedSharding, PartitionSpec as P
         from superlu_dist_tpu.numeric.factor import pool_spec
         front_sharding = NamedSharding(mesh, P("snode", None, None))
-        pool_sharding = pool_spec(mesh, False)   # hi/lo pools replicated
+        pool_sharding = pool_spec(mesh, pool_partition)
 
     def step(avals_h, avals_l, pool_h, pool_l, thresh,
              a_slot, a_flat, a_src, ws, off, *child_arr):
@@ -195,12 +202,14 @@ class Df64Executor:
     executor across factorizations (the reference keeps its schedules in
     LUstruct across SamePattern calls, SRC/pdgssvx.c:1132-1166)."""
 
-    def __init__(self, plan: FactorPlan, mesh=None):
+    def __init__(self, plan: FactorPlan, mesh=None,
+                 pool_partition: bool = False):
         from superlu_dist_tpu.numeric.stream import _bucket_len, _pad_to
 
         plan.check_index_width()
         self.plan = plan
         self.mesh = mesh
+        self.pool_partition = bool(pool_partition and mesh is not None)
         self.n_avals = len(plan.pattern_indices)
         self._groups = []     # (grp, a-arrays, child_arrs, kernel)
         for grp in plan.groups:
@@ -241,13 +250,21 @@ class Df64Executor:
                     child_shapes.append((cs.ub, c))
             kern = _df64_group_kernel((b, grp.m, grp.w, grp.u),
                                       tuple(child_shapes), plan.pool_size,
-                                      mesh)
+                                      mesh, self.pool_partition)
             self._groups.append((grp, a, child_arrs, kern))
 
     def __call__(self, avals_h, avals_l, thresh):
         """Run the factorization; returns (fronts [host f64], tiny)."""
         pool_h = jnp.zeros(self.plan.pool_size, jnp.float32)
         pool_l = jnp.zeros(self.plan.pool_size, jnp.float32)
+        if self.mesh is not None:
+            # commit the pools to their mesh layout up front (partitioned
+            # or replicated) so the first kernel starts from the right
+            # sharding instead of inserting a reshard
+            from superlu_dist_tpu.numeric.factor import pool_spec
+            psh = pool_spec(self.mesh, self.pool_partition)
+            pool_h = jax.device_put(pool_h, psh)
+            pool_l = jax.device_put(pool_l, psh)
         fronts = []
         tiny = 0
         for grp, a, child_arrs, kern in self._groups:
@@ -263,23 +280,27 @@ class Df64Executor:
         return fronts, tiny
 
 
-def get_df64_executor(plan: FactorPlan, mesh=None) -> Df64Executor:
+def get_df64_executor(plan: FactorPlan, mesh=None,
+                      pool_partition: bool = False) -> Df64Executor:
     """Df64Executor cached on the plan (same cache dict as
     factor.get_executor, keyed distinctly)."""
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
-    key = ("df64", "df64", mesh, False)
+    key = ("df64", "df64", mesh, bool(pool_partition and mesh is not None))
     ex = cache.get(key)
     if ex is None:
-        ex = cache[key] = Df64Executor(plan, mesh=mesh)
+        ex = cache[key] = Df64Executor(plan, mesh=mesh,
+                                       pool_partition=pool_partition)
     return ex
 
 
 def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                            anorm: float,
                            replace_tiny: bool = True,
-                           mesh=None) -> NumericFactorization:
+                           mesh=None,
+                           pool_partition: bool = False
+                           ) -> NumericFactorization:
     """Factor with ~f64 accuracy on f32-only hardware.
 
     values must be float64 (split exactly into df64 pairs host-side).
@@ -292,7 +313,7 @@ def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     eps64 = float(np.finfo(np.float64).eps)
     thresh = jnp.asarray(np.sqrt(eps64) * max(float(anorm), 1e-300)
                          if replace_tiny else 0.0, jnp.float32)
-    ex = get_df64_executor(plan, mesh=mesh)
+    ex = get_df64_executor(plan, mesh=mesh, pool_partition=pool_partition)
     fronts, tiny = ex(avals_h, avals_l, thresh)
     finite, info_col = (True, -1)
     if not replace_tiny:

@@ -66,3 +66,7 @@ def test_fortran_driver_compiles_and_runs(tmp_path):
         capture_output=True, cwd=str(tmp_path))
     assert r.returncode == 0, r.stderr.decode()
     _run_client(exe)
+
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

@@ -28,3 +28,9 @@ def test_cmake_build_and_ctest(tmp_path):
                        capture_output=True, timeout=900)
     assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
 
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

@@ -41,6 +41,7 @@ def test_roundtrip_and_ops_precision():
     np.testing.assert_allclose(p, x * y, rtol=1e-13, atol=1e-13)
 
 
+@pytest.mark.slow
 def test_df64_matmul_beats_f32_by_orders():
     """Full df64 accuracy under jit.  XLA:CPU's instruction fusion breaks
     the error-free transforms (see ops/df64.py caveat), so the strict gate
@@ -99,6 +100,7 @@ def test_df64_matmul_eager_exact_in_process():
     assert np.abs(got - a @ b).max() < 1e-12
 
 
+@pytest.mark.slow
 def test_df64_factorization_end_to_end():
     """factor_dtype="df64": true ~2^-48 factors on an f32-only backend.
 
@@ -176,6 +178,7 @@ print(f"DF64 FACTOR OK f32={r32:.2e} df64={rdf:.2e} generic={rg:.2e}")
     assert "DF64 FACTOR OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_df64_front_factor_vs_exact_lu():
     """Front-level pin: df64 partial factorization vs exact f64 LU of the
     same front — the ~2^-48 contract measured directly, including a
@@ -221,6 +224,7 @@ print("DF64 FRONT OK")
     assert "DF64 FRONT OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_df64_executor_cached_same_pattern():
     """SamePattern_SameRowPerm reuse hits ONE cached Df64Executor:
     refactoring new
@@ -280,6 +284,7 @@ print("DF64 CACHE OK", r)
     assert "DF64 CACHE OK" in res.stdout
 
 
+@pytest.mark.slow
 def test_df64_sharded_matches_single_device():
     """df64 over a mesh (batch sharded on "snode") must equal the
     single-device result bitwise — sharding a vmapped elimination cannot
@@ -326,6 +331,58 @@ print("DF64 SHARDED OK", r)
     assert "DF64 SHARDED OK" in res.stdout
 
 
+@pytest.mark.slow
+def test_df64_pool_partition_matches_replicated():
+    """df64 with the hi/lo Schur pools PARTITIONED 1-D over the mesh must
+    equal the replicated-pool mesh result bitwise (the same guarantee
+    tests/test_pool_partition.py pins for the f32 path): sharding the
+    pool scatter/gathers cannot change which summands reach an entry or
+    their order, so the error-free transforms are untouched.  This is the
+    path that takes the emulated-f64 tier to the n≈1M class whose pool
+    exceeds one chip (VERDICT r3 missing #4)."""
+    import os
+    import subprocess
+    import sys
+    code = """
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_disable_hlo_passes=fusion,cpu-instruction-fusion"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+import numpy as np
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.parallel.grid import gridinit
+from superlu_dist_tpu.utils.options import Options, IterRefine
+
+a = poisson2d(11)
+xt = np.random.default_rng(2).standard_normal(a.n_rows)
+b = a.matvec(xt)
+grid = gridinit(4, 2)
+opt = dict(factor_dtype="df64", iter_refine=IterRefine.NOREFINE)
+x0, lu0, _, i0 = slu.gssvx(Options(**opt), a, b, grid=grid)
+x1, lu1, _, i1 = slu.gssvx(Options(pool_partition=True, **opt), a, b,
+                           grid=grid)
+assert i0 == 0 and i1 == 0
+for (lp0, up0), (lp1, up1) in zip(lu0.numeric.fronts, lu1.numeric.fronts):
+    np.testing.assert_array_equal(lp0, lp1)
+    np.testing.assert_array_equal(up0, up1)
+np.testing.assert_array_equal(x0, x1)
+r = np.linalg.norm(b - a.matvec(x1)) / np.linalg.norm(b)
+assert r < 1e-12, r
+print("DF64 POOLPART OK", r)
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env, timeout=900,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "DF64 POOLPART OK" in res.stdout
+
+
+@pytest.mark.slow
 def test_df64_beats_f32_ir_at_kappa_1e10():
     """The df64 raison d'être: genuine spectral ill-conditioning at
     κ≈1e10, where f32 factors + f64 IR converge on the RESIDUAL but the

@@ -26,3 +26,9 @@ def test_example_runs_clean(script):
         capture_output=True, timeout=600, env=env, cwd=ROOT)
     assert r.returncode == 0, r.stdout.decode() + r.stderr.decode()
     assert b"residual" in r.stdout
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

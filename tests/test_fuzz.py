@@ -74,3 +74,9 @@ def test_random_options_random_matrix(seed):
     r = np.linalg.norm(b - op.matvec(x)) / max(np.linalg.norm(b), 1e-300)
     tol = 1e-8 if opts.iter_refine != IterRefine.NOREFINE else 1e-6
     assert np.isfinite(r) and r < tol, (r, opts)
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

@@ -194,3 +194,9 @@ def test_pgssvx_complex_conj_multi_rhs():
                      lambda a: (rng.standard_normal((a.n_rows, 2))
                                 + 1j * rng.standard_normal((a.n_rows, 2))),
                      slu.Options(trans=Trans.CONJ), check=chk)
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

@@ -53,3 +53,9 @@ def test_pool_partition_bit_equal_at_1e5():
     for (lp, up), (plp, pup) in zip(rf, pf):
         assert np.array_equal(np.asarray(lp), np.asarray(plp))
         assert np.array_equal(np.asarray(up), np.asarray(pup))
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

@@ -56,3 +56,9 @@ def test_native_under_tsan(tmp_path):
 
 def test_native_under_asan(tmp_path):
     _build_and_run(tmp_path, "address", "sanitize_asan")
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow

@@ -98,3 +98,9 @@ def test_single_rank_noop():
         tc.bcast(b)
         tc.reduce_sum(b)
         np.testing.assert_array_equal(b, np.arange(4.0))
+
+
+import pytest  # noqa: E402
+
+# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
+pytestmark = pytest.mark.slow
