@@ -188,7 +188,7 @@ def main():
               "BENCH_MAXSUPER", "BENCH_RELAX", "BENCH_MINBUCKET",
               "BENCH_GROWTH", "BENCH_AMALG", "BENCH_MATRIX",
               "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
-              "SLU_TPU_HOST_FLOPS")
+              "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV")
     _default_cfg = not any(k in os.environ for k in _KNOBS)
     _marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            ".hw_done", "nx48_default")
@@ -212,19 +212,26 @@ def main():
     # v5e peak ~197 TFLOP/s bf16; f32 via HIGHEST-precision MXU passes
     # ~1/4 of that.  MFU is reported against the f32 figure.
     PEAK_F32 = float(os.environ.get("BENCH_PEAK_F32_TFLOPS", "49")) * 1e12
-    # TPU-tuned blocking: wide supernodes feed the MXU (SURVEY.md §7 step
-    # 10 — the reference's NSUP=128 is CPU-cache-sized) and keep the
-    # streamed executor's kernel count small.  Env-overridable for
-    # on-hardware tuning sweeps.
-    RELAX = int(os.environ.get("BENCH_RELAX", "256"))
-    MAX_SUPER = int(os.environ.get("BENCH_MAXSUPER", "1024"))
-    MIN_BUCKET = int(os.environ.get("BENCH_MINBUCKET", "32"))
-    GROWTH = float(os.environ.get("BENCH_GROWTH", "1.3"))
+    # Blocking defaults are backend-specific.  TPU: wide supernodes feed
+    # the MXU (SURVEY.md §7 step 10 — the reference's NSUP=128 is
+    # CPU-cache-sized) and keep the streamed executor's kernel count
+    # small.  CPU fallback: no MXU to feed, so minimize PADDING instead —
+    # tighter buckets/amalgamation cut executed/structural flops from
+    # 1.37x to 1.09x and put the fused executor at 1.18x scipy splu at
+    # NX=32 (the r4 CPU sweep; r3's group-streamed CPU row lost at
+    # 0.66x).  Env-overridable for on-hardware tuning sweeps.
+    _cpu = jax.default_backend() == "cpu"
+    RELAX = int(os.environ.get("BENCH_RELAX", "128" if _cpu else "256"))
+    MAX_SUPER = int(os.environ.get("BENCH_MAXSUPER",
+                                   "256" if _cpu else "1024"))
+    MIN_BUCKET = int(os.environ.get("BENCH_MINBUCKET",
+                                    "16" if _cpu else "32"))
+    GROWTH = float(os.environ.get("BENCH_GROWTH", "1.05" if _cpu else "1.3"))
     # fill-tolerant amalgamation (symbfact.amalgamate_supernodes) is the
     # round-3 MFU lever: at NX=48 it cuts 10707 supernodes/325 levels/119
     # kernels to 587/13/~45 and the executed-over-structural flop ratio
     # from 15.7x to ~1.7x
-    AMALG = float(os.environ.get("BENCH_AMALG", "1.2"))
+    AMALG = float(os.environ.get("BENCH_AMALG", "1.05" if _cpu else "1.2"))
     RESULT["blocking"] = [RELAX, MAX_SUPER, MIN_BUCKET, GROWTH, AMALG]
 
     backend = jax.default_backend()

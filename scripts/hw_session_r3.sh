@@ -101,6 +101,11 @@ run nx32_amalg0  4000 BENCH_NX=32 BENCH_AMALG=0
 run nx32_amalg15 4000 BENCH_NX=32 BENCH_AMALG=1.5
 run nx32_ms512   4000 BENCH_NX=32 BENCH_MAXSUPER=512
 run nx32_geo3d   6000 BENCH_NX=32 BENCH_MATRIX=geo3d
+# solve ladder (VERDICT r3 weak #4): DiagInv turns the device solve's
+# triangular solves into batched GEMMs — bench already reports
+# solve_seconds/solve_gflops per row, so these rows A/B the knob
+run nx32_diaginv 4000 BENCH_NX=32 SLU_TPU_DIAG_INV=1
+run nx48_diaginv 6000 BENCH_NX=48 SLU_TPU_DIAG_INV=1
 
 # ---- 3. best-variant checks at the driver size ----
 run nx48_fused   10800 BENCH_NX=48 BENCH_GRANULARITY=fused

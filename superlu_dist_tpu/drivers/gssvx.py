@@ -74,6 +74,13 @@ class LUFactorization:
                                        # crash — the pdtest harness survives
                                        # partial failures, TEST/pdtest.c)
     solve_fallback_reason: str = None  # why the device path was abandoned
+    mesh: object = None                # the grid mesh the factors are
+                                       # sharded over (None off-grid).  When
+                                       # it spans multiple PROCESSES the
+                                       # solve must run collectively on it —
+                                       # no process can pull the whole
+                                       # factor (pdgstrs over the process
+                                       # grid, SRC/pdgstrs.c:838)
 
     # -- combined transforms --------------------------------------------------
     @property
@@ -137,7 +144,14 @@ class LUFactorization:
         import warnings
 
         import jax
-        use_device = (self.solve_path == "device"
+        # a mesh spanning multiple processes means no process holds the
+        # whole factor: the solve MUST run collectively on the mesh (and
+        # a host fallback is impossible — it would read non-addressable
+        # shards), exactly like the reference's pdgstrs event loop over
+        # the process grid (SRC/pdgstrs.c:838)
+        multiproc = self.mesh is not None and jax.process_count() > 1
+        use_device = (multiproc
+                      or self.solve_path == "device"
                       or (self.solve_path == "auto"
                           and jax.default_backend() != "cpu"
                           # offloaded (host-resident) factors solve on the
@@ -149,10 +163,11 @@ class LUFactorization:
                 if self.dev_solver is None:
                     from superlu_dist_tpu.solve.device import DeviceSolver
                     self.dev_solver = DeviceSolver(
-                        self.numeric, diag_inv=self.options.diag_inv)
+                        self.numeric, diag_inv=self.options.diag_inv,
+                        mesh=self.mesh if multiproc else None)
                 return device_call(self.dev_solver)
             except Exception as e:
-                if self.solve_path != "auto":
+                if self.solve_path != "auto" or multiproc:
                     raise
                 # device path failed — permanently fall back to the host
                 # solve for this factorization rather than crash the run
@@ -326,7 +341,8 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
                          r1=r1, c1=c1, row_order=row_order,
                          col_order=col_order, sf=sf, plan=plan,
                          numeric=numeric, anorm=anorm, a=a,
-                         a_sym_indptr=sym.indptr, a_sym_indices=sym.indices)
+                         a_sym_indptr=sym.indptr, a_sym_indices=sym.indices,
+                         mesh=grid.mesh if grid is not None else None)
     if not numeric.finite:
         # exactly singular U and no tiny-pivot replacement: info is the
         # 1-based first zero-pivot column, like the reference's Allreduce-MIN

@@ -33,7 +33,15 @@ from superlu_dist_tpu.numeric.factor import group_step
 from superlu_dist_tpu.symbolic.symbfact import _front_flops
 
 
-_OFFLOAD_LAG = 8   # groups of factored panels allowed in flight device-side
+# Look-ahead window (the num_lookaheads analog, reference
+# SRC/pdgstrf.c:624-697 + sp_ienv case 4).  The reference needs a
+# dependency table + look-ahead pipeline because panels wait on MPI
+# messages between ranks; here dispatch is async and every kernel is
+# serialized on the donated Schur pool, so the only look-ahead that
+# matters is how many groups of FACTORED PANELS may stay in flight
+# device-side before their D2H offload is forced to complete — deeper =
+# more compute/transfer overlap, shallower = less HBM held by panels.
+# Env SLU_TPU_OFFLOAD_LAG (default 8), latched per StreamExecutor.
 
 
 def _bucket_len(n: int, lo: int = 8, base: float = 2.0) -> int:
@@ -146,6 +154,7 @@ class StreamExecutor:
         # PROFlevel comm-split analog (pdgstrf.c:1930-1951): issue /
         # transfer-wait / (the rest =) device compute
         self.last_offload_wait_seconds = None
+        self._lag = int(os.environ.get("SLU_TPU_OFFLOAD_LAG", "8"))
 
         # Host-share split (the reference's CPU/GPU work division:
         # gemm_division_cpu_gpu + the N_GEMM flops threshold,
@@ -382,7 +391,7 @@ class StreamExecutor:
             lp.copy_to_host_async()
             up.copy_to_host_async()
             fronts.append((lp, up))
-            i = len(fronts) - 1 - _OFFLOAD_LAG
+            i = len(fronts) - 1 - self._lag
             # the lag window must not reach into the host-share prefix:
             # materializing those cpu-device panels here would block on
             # host-stream COMPUTE (not D2H) and corrupt the comm split —

@@ -33,7 +33,8 @@ def _pad_full(local: np.ndarray, fst_row: int, n: int) -> np.ndarray:
 
 def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
            x0: np.ndarray | None, solve_fn, itmax: int = ITMAX,
-           root: int = 0, trans=None) -> np.ndarray:
+           root: int = 0, trans=None,
+           collective_solve: bool = False) -> np.ndarray:
     """Collectively refine op(A)·x = b (single RHS; op per `trans` —
     NOTRANS/TRANS/CONJ like pdgssvx's trans dispatch; complex payloads
     ride the f64 tree as re/im passes via TreeComm.*_any).
@@ -46,7 +47,13 @@ def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
     solve_fn — correction solver dx = op(A)⁻¹ r; significant on the root
                only (the factor owner — the reference's analog is that
                every rank participates in pdgstrs, here the factors live
-               with the root process).
+               with the root process).  With collective_solve=True, the
+               factors live SHARDED across all ranks' devices (the mesh
+               tier) and solve_fn is an SPMD program every rank must
+               enter: all ranks call it on the same replicated residual
+               and the dx broadcast is skipped — this IS the reference's
+               shape, where pdgstrs runs on the whole grid inside
+               pdgsrfs (SRC/pdgsrfs.c:205).
 
     Returns the full refined x on every rank.
     """
@@ -88,10 +95,15 @@ def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
         if berr <= eps or berr >= lstres / 2.0:
             break
         lstres = berr
-        # correction on the factor owner, broadcast to all
-        dx = np.zeros(n, dtype=wdtype)
-        if tc.rank == root:
+        if collective_solve:
+            # mesh tier: every rank enters the SPMD correction solve with
+            # the identical allreduced residual; results are replicated
             dx = np.asarray(solve_fn(r), dtype=wdtype)
-        dx = tc.bcast_any(dx, root=root)
+        else:
+            # correction on the factor owner, broadcast to all
+            dx = np.zeros(n, dtype=wdtype)
+            if tc.rank == root:
+                dx = np.asarray(solve_fn(r), dtype=wdtype)
+            dx = tc.bcast_any(dx, root=root)
         x = x + dx
     return x

@@ -37,10 +37,14 @@ from superlu_dist_tpu.sparse.formats import SparseCSR
 
 
 def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
-                       root: int = 0) -> SparseCSR | None:
+                       root: int = 0,
+                       all_ranks: bool = False) -> SparseCSR | None:
     """Assemble the global CSR on `root` from every rank's block rows —
     the pdCompRow_loc_to_CompCol_global analog over tree collectives.
-    Returns the matrix on root, None elsewhere."""
+    Returns the matrix on root, None elsewhere.  all_ranks=True assembles
+    on EVERY rank (all-reduce instead of reduce) — the analysis input for
+    the mesh-sharded tier, where each controller must hold the same
+    global pattern but no controller ever holds the factors."""
     n = a_loc.n
     # global nnz offsets: every rank's count, allreduced
     counts = np.zeros(tc.n_ranks)
@@ -50,23 +54,24 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
     offs[1:] = np.cumsum(counts).astype(np.int64)
     total = int(offs[-1])
     lo = int(offs[tc.rank])
+    _reduce = tc.allreduce_sum_any if all_ranks else tc.reduce_sum_any
 
     # row counts (for indptr) and flat index/value arrays, disjoint slots
     rowcnt = np.zeros(n)
     rowcnt[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = \
         np.diff(a_loc.indptr)
-    rowcnt = tc.reduce_sum_any(rowcnt, root=root)
+    rowcnt = _reduce(rowcnt, root=root)
     idx = np.zeros(total)
     idx[lo:lo + a_loc.nnz_loc] = a_loc.indices
-    idx = tc.reduce_sum_any(idx, root=root)
+    idx = _reduce(idx, root=root)
     vdtype = (np.complex128 if np.issubdtype(np.asarray(a_loc.data).dtype,
                                              np.complexfloating)
               else np.float64)
     vals = np.zeros(total, dtype=vdtype)
     vals[lo:lo + a_loc.nnz_loc] = a_loc.data
-    vals = tc.reduce_sum_any(vals, root=root)
+    vals = _reduce(vals, root=root)
 
-    if tc.rank != root:
+    if not all_ranks and tc.rank != root:
         return None
     indptr = np.zeros(n + 1, dtype=np.int64)
     indptr[1:] = np.cumsum(rowcnt).astype(np.int64)
@@ -76,7 +81,7 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
 
 
 def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
-           b_loc: np.ndarray, root: int = 0):
+           b_loc: np.ndarray, root: int = 0, grid=None, lu_out=None):
     """Collectively solve op(A)·X = B from block-row distributed input.
 
     b_loc: (m_loc,) or (m_loc, nrhs) — this rank's block rows of B.
@@ -84,6 +89,21 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     matching b_loc.  options.trans selects op(A) (NOTRANS/TRANS/CONJ,
     the reference's pdgssvx trans dispatch); complex A/b take the
     pzgssvx path.
+
+    `grid` (a parallel.grid.ProcessGrid whose mesh spans ALL the
+    participating processes' devices, from gridinit_multihost) selects
+    the distributed-factors tier: every rank assembles the global
+    analysis input (O(nnz(A)) host memory), then all ranks run the SAME
+    mesh-sharded factorization and collective device solve — the factors
+    and the Schur pool live sharded across the processes' devices and NO
+    process ever materializes them (the reference's defining NR_loc-in,
+    distributed-factors-out property, SRC/pdgssvx.c:505 /
+    pddistribute.c:322).  Without `grid`, the single-host fallback
+    gathers to root and factors there (refinement stays distributed).
+
+    `lu_out`: optional dict; on return, lu_out["lu"] holds this rank's
+    LUFactorization handle (the reference's caller-owned LUstruct — on
+    the fallback tier only the root has one).
     """
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
@@ -103,6 +123,10 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
                   or np.issubdtype(b2.dtype, np.complexfloating))
     wdtype = np.complex128 if complex_in else np.float64
 
+    if grid is not None:
+        return _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
+                            lu_out=lu_out)
+
     a_root = gather_distributed(tc, a_loc, root=root)
     b_full = np.zeros((n, nrhs), dtype=wdtype)
     b_full[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = b2
@@ -118,6 +142,8 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
         x_r, lu, stats, info_r = gssvx(
             opts0, a_root, b_full if nrhs > 1 else b_full[:, 0])
         info[0] = float(info_r)
+        if lu_out is not None:
+            lu_out["lu"] = lu
         if info_r == 0:
             x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
             trans = getattr(options, "trans", Trans.NOTRANS)
@@ -131,6 +157,13 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     if int(info[0]) != 0:
         return None, int(info[0])
     x0 = tc.bcast_any(x0, root=root)
+    return _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d,
+                        nrhs)
+
+
+def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs):
+    from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
+    from superlu_dist_tpu.utils.options import IterRefine, Trans
     if options.iter_refine == IterRefine.NOREFINE:
         x = x0
     else:
@@ -141,5 +174,57 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
         for j in range(nrhs):
             cols.append(pgsrfs(tc, a_loc, b2[:, j], x0[:, j], solve_fn,
                                root=root, trans=trans))
+        x = np.stack(cols, axis=1)
+    return (x[:, 0] if one_d else x), 0
+
+
+def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
+                 lu_out=None):
+    """Distributed-factors tier: every rank assembles the same global
+    analysis input, then all ranks run ONE mesh-sharded gssvx in
+    lockstep — the factorization, Schur pool, and triangular solves are
+    SPMD programs over the grid's (multi-process) mesh, so the factors
+    stay sharded across the processes' devices for their whole lifetime.
+    The collective correction solve also serves the distributed
+    refinement loop (every rank calls it — the pdgsrfs shape where
+    pdgstrs is itself parallel, SRC/pdgsrfs.c:205)."""
+    import dataclasses
+
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
+    from superlu_dist_tpu.utils.options import IterRefine, Trans
+
+    n = a_loc.n
+    nrhs = b2.shape[1]
+    a_all = gather_distributed(tc, a_loc, all_ranks=True)
+    b_full = np.zeros((n, nrhs), dtype=wdtype)
+    b_full[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = b2
+    b_full = tc.allreduce_sum_any(b_full, root=0)
+
+    # refinement runs distributed below (block rows stay with their
+    # owners); gssvx does analysis + mesh factorization + first solve
+    opts0 = dataclasses.replace(options, iter_refine=IterRefine.NOREFINE)
+    x_r, lu, stats, info_r = gssvx(
+        opts0, a_all, b_full if nrhs > 1 else b_full[:, 0], grid=grid)
+    if lu_out is not None:
+        lu_out["lu"] = lu
+    if info_r != 0:
+        return None, int(info_r)
+    x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
+
+    trans = getattr(options, "trans", Trans.NOTRANS)
+    if trans == Trans.NOTRANS:
+        solve_fn = lu.solve_factored
+    else:
+        solve_fn = (lambda r: lu.solve_factored_trans(
+            r, conj=trans == Trans.CONJ))
+    if options.iter_refine == IterRefine.NOREFINE:
+        x = x0
+    else:
+        # collective=True: every rank calls solve_fn (the mesh solve is
+        # an SPMD program all controllers must enter), so no dx broadcast
+        cols = [pgsrfs(tc, a_loc, b2[:, j], x0[:, j], solve_fn,
+                       trans=trans, collective_solve=True)
+                for j in range(nrhs)]
         x = np.stack(cols, axis=1)
     return (x[:, 0] if one_d else x), 0

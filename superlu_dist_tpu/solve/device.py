@@ -194,34 +194,50 @@ class DeviceSolver:
     """
 
     def __init__(self, fact: NumericFactorization, diag_inv: bool = False,
-                 fused: str | bool = "auto"):
+                 fused: str | bool = "auto", mesh=None):
+        """mesh: a jax.sharding.Mesh the factors are sharded over.  Needed
+        when the mesh spans MULTIPLE PROCESSES (the pdgstrs-over-the-grid
+        case): the RHS then uploads replicated over the global mesh and
+        the index maps stay numpy (pjit treats identical host arrays as
+        replicated global inputs), so every controller runs the same SPMD
+        sweeps and reads the replicated result locally.  Single-process
+        solves (including virtual meshes) don't need it."""
         self.fact = fact
         self.diag_inv = diag_inv
+        self.mesh = mesh
         if fused == "auto":
             fused = len(fact.plan.groups) <= 256
         self.fused = bool(fused)
         self._fused_cache = {}
+        self._replicate = None
         plan = fact.plan
         sf = plan.sf
         self.n = plan.n
         first = sf.sn_start[:-1]
         self._groups = []
         self._invs_cached = None
+        # with a (multi-process) mesh the index arrays must not commit to
+        # one local device — numpy args are what pjit accepts uniformly
+        _put = (lambda x: np.asarray(x)) if mesh is not None else jnp.asarray
         # a host-share factorization (stream.py SLU_TPU_HOST_FLOPS) leaves
         # the leading leaf panels as numpy: upload those once so the
-        # jitted sweeps don't re-transfer them on every solve
+        # jitted sweeps don't re-transfer them on every solve.  The
+        # uploaded list lives on the SOLVER (self.fronts) — assigning back
+        # to fact.fronts would silently flip fact.on_host and force a
+        # later host solve on the same factorization to re-pull everything
         if (any(isinstance(lp, np.ndarray) for lp, _ in fact.fronts)
                 and not fact.on_host):
-            fact.fronts = [(jnp.asarray(lp), jnp.asarray(up))
+            self.fronts = [(jnp.asarray(lp), jnp.asarray(up))
                            for lp, up in fact.fronts]
-        for grp, (lp, up) in zip(plan.groups, fact.fronts):
-            firsts = jnp.asarray(first[grp.sns])
+        else:
+            self.fronts = fact.fronts
+        for grp, (lp, up) in zip(plan.groups, self.fronts):
+            firsts = _put(first[grp.sns])
             rows = np.full((grp.batch, grp.u), self.n, dtype=np.int64)
             for slot, s in enumerate(grp.sns):
                 r = sf.sn_rows[s]
                 rows[slot, :len(r)] = r
-            self._groups.append((grp, firsts, jnp.asarray(rows),
-                                 jnp.asarray(grp.ws)))
+            self._groups.append((grp, firsts, _put(rows), _put(grp.ws)))
 
     @property
     def _invs(self):
@@ -235,7 +251,7 @@ class DeviceSolver:
                     _diag_inv_kernel(grp.w, str(jnp.dtype(self.fact.dtype)))(
                         jnp.asarray(lp))
                     for (grp, _, _, _), (lp, _) in zip(self._groups,
-                                                       self.fact.fronts)]
+                                                       self.fronts)]
             else:
                 self._invs_cached = [(None, None)] * len(self._groups)
         return self._invs_cached
@@ -307,9 +323,25 @@ class DeviceSolver:
         kb = _bucket_nrhs(k)
         pad = np.zeros((self.n + 1, kb), dtype=jnp.dtype(self.fact.dtype))
         pad[:self.n, :k] = r2
-        x = jnp.asarray(pad)
-        lsum = jnp.zeros_like(x)
-        x = sweeps(x, lsum, kb)
+        if self.mesh is not None:
+            # replicated over the global mesh: every process supplies the
+            # same host array, every process can read the result locally
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P(None, None))
+            if self._replicate is None:
+                # cached: a fresh lambda per solve would miss jax's trace
+                # cache on every IR correction solve
+                self._replicate = jax.jit(lambda a: a, out_shardings=rep)
+            x = jax.device_put(pad, rep)
+            lsum = jax.device_put(np.zeros_like(pad), rep)
+            x = sweeps(x, lsum, kb)
+            # normalize whatever sharding GSPMD inferred back to fully
+            # replicated so np.asarray below is process-local
+            x = self._replicate(x)
+        else:
+            x = jnp.asarray(pad)
+            lsum = jnp.zeros_like(x)
+            x = sweeps(x, lsum, kb)
         out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
         return out[:, 0] if squeeze else out
 
@@ -328,17 +360,17 @@ class DeviceSolver:
                 fwd, bwd = self._fused_trans_fns(kb, conj)
                 idx = [(firsts, rows, ws)
                        for _, firsts, rows, ws in self._groups]
-                x, lsum = fwd(x, lsum, fact.fronts, idx)
-                return bwd(x, fact.fronts, idx)
+                x, lsum = fwd(x, lsum, self.fronts, idx)
+                return bwd(x, self.fronts, idx)
             # Uᵀ forward, levels ascending
             for (grp, firsts, rows, ws), (lp, up) in zip(
-                    self._groups, fact.fronts):
+                    self._groups, self.fronts):
                 kern = _fwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
                                          kb, n1, str(dt), conj)
                 x, lsum = kern(lp, up, x, lsum, firsts, rows, ws)
             # Lᵀ backward, levels descending
             for (grp, firsts, rows, ws), (lp, up) in zip(
-                    reversed(self._groups), reversed(fact.fronts)):
+                    reversed(self._groups), reversed(self.fronts)):
                 kern = _bwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
                                          kb, n1, str(dt), conj)
                 x = kern(lp, x, firsts, rows, ws)
@@ -358,11 +390,11 @@ class DeviceSolver:
                 fwd, bwd = self._fused_fns(kb)
                 idx = [(firsts, rows, ws)
                        for _, firsts, rows, ws in self._groups]
-                x, lsum = fwd(x, lsum, fact.fronts, idx, self._invs)
-                return bwd(x, fact.fronts, idx, self._invs)
+                x, lsum = fwd(x, lsum, self.fronts, idx, self._invs)
+                return bwd(x, self.fronts, idx, self._invs)
             # forward, levels ascending (groups are in level order)
             for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
-                    self._groups, fact.fronts, self._invs):
+                    self._groups, self.fronts, self._invs):
                 kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
                                    str(dt), use_inv)
                 x, lsum = (kern(lp, x, lsum, firsts, rows, ws, linv)
@@ -370,7 +402,7 @@ class DeviceSolver:
                            kern(lp, x, lsum, firsts, rows, ws))
             # backward, levels descending
             for (grp, firsts, rows, ws), (lp, up), (_, uinv) in zip(
-                    reversed(self._groups), reversed(fact.fronts),
+                    reversed(self._groups), reversed(self.fronts),
                     reversed(self._invs)):
                 kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
                                    str(dt), use_inv)

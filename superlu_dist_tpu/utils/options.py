@@ -105,11 +105,13 @@ class Options:
     replace_tiny_pivot: bool = True
     iter_refine: IterRefine = IterRefine.SLU_DOUBLE
     trans: Trans = Trans.NOTRANS
-    diag_inv: bool = False       # DiagInv (reference default YES-iff-LAPACK,
-                                 # SRC/util.c:397-401): precompute inverted
-                                 # diagonal blocks so device solves replace
-                                 # triangular solves with batched GEMMs —
-                                 # pays off for repeated / many-RHS solves
+    # DiagInv (reference default YES-iff-LAPACK, SRC/util.c:397-401):
+    # precompute inverted diagonal blocks so device solves replace
+    # triangular solves with batched GEMMs — pays off for repeated /
+    # many-RHS solves.  Env SLU_TPU_DIAG_INV=1 flips the default (the
+    # hardware solve-ladder sweep knob).
+    diag_inv: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SLU_TPU_DIAG_INV", 0)))
     print_stat: bool = False
     # --- symbolic / blocking tuning (sp_ienv analogs, SRC/sp_ienv.c:70-123) ---
     # NREL: amalgamate subtrees with <= relax cols

@@ -63,6 +63,115 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+_PGSSVX_WORKER = r"""
+import os, sys, time
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+shm = sys.argv[4]; ngrid = int(sys.argv[5])
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{port}",
+                           num_processes=nproc, process_id=pid)
+# every rank compiles the same SPMD programs; the persistent cache makes
+# rank k>0's compiles (and any rerun's) disk hits instead of minutes of
+# duplicate work on this 1-core box
+from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+enable_compile_cache()
+import numpy as np
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.parallel.grid import gridinit_multihost
+from superlu_dist_tpu.parallel.dist import distribute_rows
+from superlu_dist_tpu.parallel.treecomm import TreeComm
+from superlu_dist_tpu.parallel.pgssvx import pgssvx
+from superlu_dist_tpu.utils.options import Options
+
+grid = gridinit_multihost(1, nproc)
+assert grid.mesh.devices.size == nproc
+
+# block-row input: each rank keeps ONLY its rows (the NR_loc shape);
+# the global build here is test scaffolding for slicing + the residual
+a = poisson2d(ngrid)
+n = a.n_rows
+parts = distribute_rows(a, nproc)
+mine = parts[pid]
+xt = np.random.default_rng(3).standard_normal(n)
+b = a.matvec(xt)
+b_loc = b[mine.fst_row:mine.fst_row + mine.m_loc]
+
+# rank 0 creates the shm tree domain; the rest attach with retry
+if pid == 0:
+    tc = TreeComm(shm, nproc, 0, max_len=4096, create=True)
+else:
+    for _ in range(600):
+        try:
+            tc = TreeComm(shm, nproc, pid, max_len=4096, create=False)
+            break
+        except OSError:
+            time.sleep(0.1)
+    else:
+        raise SystemExit("treecomm attach timeout")
+
+out = {}
+x, info = pgssvx(tc, Options(relax=128, max_supernode=512,
+                             min_bucket=32, bucket_growth=1.3,
+                             amalg_tol=1.2),
+                 mine, b_loc, grid=grid, lu_out=out)
+assert info == 0, info
+resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
+assert resid < 1e-10, resid
+
+# the defining property: factor shards live in DIFFERENT processes —
+# the biggest front spans every process's device, and this process can
+# address only its own piece of it
+lu = out["lu"]
+fronts = lu.numeric.fronts
+big_lp, _ = max(fronts, key=lambda p: p[0].size)
+assert len(big_lp.sharding.device_set) == nproc, big_lp.sharding
+local = sum(s.data.size for s in big_lp.addressable_shards)
+assert local < big_lp.size, (local, big_lp.size)
+tc.close(unlink=pid == 0)
+print(f"proc {pid} pgssvx-mesh ok n={n} resid={resid:.2e}", flush=True)
+"""
+
+
+def _run_pgssvx_mesh(tmp_path, nproc, ngrid, timeout):
+    port = _free_port()
+    script = tmp_path / "pgx_mesh_worker.py"
+    script.write_text(_PGSSVX_WORKER)
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env.pop("XLA_FLAGS", None)
+    shm = f"/slu_mhpgx_{os.getpid()}"
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), str(nproc), str(port),
+         shm, str(ngrid)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(nproc)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=timeout)
+        outs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+        assert f"proc {i} pgssvx-mesh ok" in out
+
+
+def test_pgssvx_mesh_two_processes_small(tmp_path):
+    """Plumbing check at toy size: distributed-factors pgssvx over a
+    2-process mesh — factor sharded across processes, collective device
+    solve, distributed IR, residual at reference accuracy."""
+    _run_pgssvx_mesh(tmp_path, nproc=2, ngrid=24, timeout=600)
+
+
+def test_pgssvx_mesh_four_processes_n100k(tmp_path):
+    """The VERDICT-r3 'done' bar: 4 processes, n >= 1e5 (poisson2d(340)
+    -> n=115,600), factor shards living in different processes, solve +
+    distributed refinement, residual <= 1e-10.  Compile-dominated on a
+    1-core box (4 ranks x the same fused SPMD program; the persistent
+    compile cache makes reruns fast) — budget accordingly."""
+    _run_pgssvx_mesh(tmp_path, nproc=4, ngrid=340, timeout=5400)
+
+
 def test_multihost_factorization_two_processes(tmp_path):
     # self-bounded via communicate(timeout=540) — pytest-timeout is not
     # available in this environment
