@@ -162,9 +162,14 @@ class LUFactorization:
             try:
                 if self.dev_solver is None:
                     from superlu_dist_tpu.solve.device import DeviceSolver
+                    # multiproc: streamed sweeps (fused=False) — the
+                    # whole-sweep programs at n≈1e5 hit the same compile
+                    # wall as the fused factor executor (see
+                    # factor.get_executor's auto rule)
                     self.dev_solver = DeviceSolver(
                         self.numeric, diag_inv=self.options.diag_inv,
-                        mesh=self.mesh if multiproc else None)
+                        mesh=self.mesh if multiproc else None,
+                        fused=False if multiproc else "auto")
                 return device_call(self.dev_solver)
             except Exception as e:
                 if self.solve_path != "auto" or multiproc:

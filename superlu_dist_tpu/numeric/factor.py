@@ -204,12 +204,19 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     executor: "fused" (one XLA program — fast dispatch, compile grows with
     plan size), "stream" (per-bucket kernels — compile count is bounded,
     right for real TPU where program compile is expensive), or "auto"
-    (stream on accelerators, fused on CPU).  mesh shards either executor
-    over ("snode", "panel"); pool_partition shards the Schur pool across
-    all mesh devices (see make_factor_fn).
+    (stream on accelerators AND on multi-process meshes, fused on
+    single-controller CPU).  A mesh spanning processes forces stream for
+    the same reason real TPU does: the fused whole-program jit's compile
+    time grows with the plan (an n≈1e5 SPMD program took >60 min on
+    XLA:CPU), while the streamed kernels' compile count is bounded by
+    distinct shape keys.  mesh shards either executor over
+    ("snode", "panel"); pool_partition shards the Schur pool across all
+    mesh devices (see make_factor_fn).
     """
     if executor == "auto":
-        executor = "fused" if jax.default_backend() == "cpu" else "stream"
+        multiproc = mesh is not None and jax.process_count() > 1
+        executor = ("fused" if jax.default_backend() == "cpu"
+                    and not multiproc else "stream")
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}

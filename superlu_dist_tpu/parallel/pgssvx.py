@@ -103,7 +103,8 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
 
     `lu_out`: optional dict; on return, lu_out["lu"] holds this rank's
     LUFactorization handle (the reference's caller-owned LUstruct — on
-    the fallback tier only the root has one).
+    the fallback tier only the root has one) and lu_out["stats"] the
+    factorization Stats (both tiers; on the fallback tier, root only).
     """
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
@@ -144,6 +145,7 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
         info[0] = float(info_r)
         if lu_out is not None:
             lu_out["lu"] = lu
+            lu_out["stats"] = stats
         if info_r == 0:
             x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
             trans = getattr(options, "trans", Trans.NOTRANS)
@@ -208,6 +210,7 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
         opts0, a_all, b_full if nrhs > 1 else b_full[:, 0], grid=grid)
     if lu_out is not None:
         lu_out["lu"] = lu
+        lu_out["stats"] = stats
     if info_r != 0:
         return None, int(info_r)
     x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)

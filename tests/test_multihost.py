@@ -85,8 +85,17 @@ from superlu_dist_tpu.parallel.treecomm import TreeComm
 from superlu_dist_tpu.parallel.pgssvx import pgssvx
 from superlu_dist_tpu.utils.options import Options
 
+def note(msg):
+    # progress is observable while the harness still holds our pipe;
+    # the shm token makes the path unique per run (no stale lines from
+    # a previous attempt when debugging a long run)
+    tag = shm.strip("/")
+    with open(f"/tmp/pgx_mesh_progress_{tag}_{pid}.log", "a") as fh:
+        fh.write(f"{time.strftime('%H:%M:%S')} {msg}\n")
+
 grid = gridinit_multihost(1, nproc)
 assert grid.mesh.devices.size == nproc
+note("mesh up")
 
 # block-row input: each rank keeps ONLY its rows (the NR_loc shape);
 # the global build here is test scaffolding for slicing + the residual
@@ -111,11 +120,17 @@ else:
     else:
         raise SystemExit("treecomm attach timeout")
 
+note("inputs ready")
 out = {}
 x, info = pgssvx(tc, Options(relax=128, max_supernode=512,
                              min_bucket=32, bucket_growth=1.3,
                              amalg_tol=1.2),
                  mine, b_loc, grid=grid, lu_out=out)
+note("pgssvx returned")
+st = out.get("stats")
+if st is not None:
+    note("utime " + " ".join(f"{k}={v:.1f}" for k, v in st.utime.items()
+                             if v > 0.5))
 assert info == 0, info
 resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
 assert resid < 1e-10, resid
