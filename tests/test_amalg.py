@@ -68,6 +68,7 @@ def test_amalg_coarsens_schedule():
     assert np.median(widths) > np.median(np.diff(sf0.sn_start))
 
 
+@pytest.mark.slow
 def test_amalg_solve_matches_unamalgamated():
     """Same solution through merged fronts (explicit zeros are factored
     like any entry; GESP semantics unchanged)."""

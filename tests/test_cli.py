@@ -33,6 +33,7 @@ def test_cli_trans_and_nrhs(mtx_file):
 
 @pytest.mark.skipif(not os.path.exists(f"{REF}/g20.rua"),
                     reason="no fixtures")
+@pytest.mark.slow
 def test_cli_reference_fixture(capsys):
     rc = main(["-f", f"{REF}/g20.rua", "--colperm", "MMD"])
     assert rc == 0

@@ -83,6 +83,7 @@ def test_partial_front_factor(m, w, u_real, w_real):
 @pytest.mark.parametrize("m,w", [(40, 16), (130, 120), (300, 144),
                                  (64, 31), (200, 137), (56, 56), (24, 9)])
 @pytest.mark.parametrize("dtype", [np.float64, np.complex128])
+@pytest.mark.slow
 def test_blocked_matches_recursive(m, w, dtype):
     """The compile-bounded blocked kernel (the unsharded default,
     _blocked_partial_factor) must agree with the recursive path on every

@@ -56,6 +56,7 @@ def test_diag_inv_through_driver():
     np.testing.assert_allclose(x2, x, rtol=1e-7, atol=1e-9)
 
 
+@pytest.mark.slow
 def test_device_solver_padded_buckets():
     # irregular sizes force fronts with padded widths/batches
     a = random_sparse(73, density=0.06, seed=3)
@@ -67,6 +68,7 @@ def test_device_solver_padded_buckets():
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_device_solver_through_driver_path():
     # full driver solve (permutations + scalings) with the device path
     # forced on the CPU backend
@@ -98,6 +100,7 @@ def test_device_solver_complex():
     np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_fused_and_streamed_solve_agree():
     """fused=True (one program per sweep) must equal the per-group
     dispatch path bit-for-bit."""
@@ -149,6 +152,7 @@ def test_trans_through_driver_device_path():
     assert r < 1e-8, r
 
 
+@pytest.mark.slow
 def test_trans_streamed_matches_fused():
     from superlu_dist_tpu.solve.trisolve import lu_solve_trans
     a = poisson2d(10)
@@ -161,6 +165,7 @@ def test_trans_streamed_matches_fused():
     np.testing.assert_allclose(got_f, want, rtol=1e-9, atol=1e-11)
 
 
+@pytest.mark.slow
 def test_wide_rhs_batch():
     """nrhs well past the bucket boundary (the reference sweeps nrhs and
     its solve batches Linv GEMMs for large nrhs — SURVEY.md §7 hard-part

@@ -200,12 +200,14 @@ def test_g20_rua():
 
 
 @pytest.mark.skipif(not os.path.exists(f"{REF}/cg20.cua"), reason="no fixtures")
+@pytest.mark.slow
 def test_cg20_cua_complex():
     a = read_harwell_boeing(f"{REF}/cg20.cua").tocsr()
     run_and_check(a)
 
 
 @pytest.mark.skipif(not os.path.exists(f"{REF}/big.rua"), reason="no fixtures")
+@pytest.mark.slow
 def test_big_rua():
     a = read_harwell_boeing(f"{REF}/big.rua").tocsr()
     x, xtrue, lu, stats = run_and_check(a)
@@ -213,6 +215,7 @@ def test_big_rua():
     assert err < 1e-6
 
 
+@pytest.mark.slow
 def test_bfloat16_factors_recover_f64_residual():
     """bf16 factorization (the MXU's native-rate mode) + f64 IR must still
     reach reference accuracy on a well-conditioned system — the GESP+IR
@@ -228,6 +231,7 @@ def test_bfloat16_factors_recover_f64_residual():
     assert stats.refine_steps > 2   # bf16 genuinely needs the IR
 
 
+@pytest.mark.slow
 def test_helmholtz_and_anisotropic_end_to_end():
     """Indefinite complex (Helmholtz) and anisotropic diffusion classes
     through the full pipeline — the model-family breadth the reference's
@@ -246,6 +250,7 @@ def test_helmholtz_and_anisotropic_end_to_end():
         assert r < 1e-12, (a.data.dtype, r)
 
 
+@pytest.mark.slow
 def test_int64_index_configuration():
     """SLU_TPU_INT64=1 switches every index to 64-bit (the reference's
     XSDK_INDEX_SIZE=64 build, superlu_defs.h:80-93) — verified in a

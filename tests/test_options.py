@@ -104,6 +104,7 @@ def test_awpm_rowperm():
     assert np.all(lu.r1 == 1) and np.all(lu.c1 == 1)
 
 
+@pytest.mark.slow
 def test_slu_single_refinement():
     """SLU_SINGLE refines with an f32 residual: converges to ~single eps,
     not double."""

@@ -140,6 +140,7 @@ def test_ata_adjacency_matches_brute_force():
         assert got == sorted(brute[j]), j
 
 
+@pytest.mark.slow
 def test_colamd_mmd_ata_end_to_end():
     import superlu_dist_tpu as slu
     from superlu_dist_tpu.utils.options import ColPerm

@@ -79,3 +79,7 @@ def test_pgsrfs_four_processes_matches_serial():
     # every rank converged to the same solution
     for rank, xr in others:
         np.testing.assert_allclose(xr, x, rtol=0, atol=1e-12)
+
+
+# slow tier: forks multi-process workers (mp fork under multithreaded jax)
+pytestmark = pytest.mark.slow
