@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Multi-process distributed-factors driver — the reference's canonical
+`mpiexec -n 2 pddrive -r 1 -c 2 g20.rua` flow (EXAMPLE/pddrive.c:29):
+every process owns a block of rows of A and b, the factorization and
+solves run SPMD over the mesh spanning all the processes' devices, and
+no process ever holds the whole factor (SRC/pddistribute.c:322).
+
+This launcher forks the worker below once per rank (the mpiexec role);
+each worker boots via parallel.mhboot (jax.distributed world + Gloo
+timeout + compile cache), attaches the shared-memory tree domain for
+the host-side analysis collectives, and calls `pgssvx(..., grid=...)`.
+
+    python examples/pddrive_grid.py [matrix.rua] [--nproc 2]
+"""
+
+import glob
+import os
+import socket
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_WORKER = r"""
+import sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+shm = sys.argv[4]; path = sys.argv[5]
+from superlu_dist_tpu.parallel.mhboot import boot, attach_tree
+boot(nproc, pid, port)
+import numpy as np
+from superlu_dist_tpu.parallel.grid import gridinit_multihost
+from superlu_dist_tpu.parallel.dist import distribute_rows
+from superlu_dist_tpu.parallel.pgssvx import pgssvx
+from superlu_dist_tpu.utils.options import Options
+
+grid = gridinit_multihost(1, nproc)
+if path == "@poisson2d":
+    from superlu_dist_tpu.models.gallery import poisson2d
+    a = poisson2d(20)
+else:
+    from superlu_dist_tpu.io import read_matrix
+    a = read_matrix(path).tocsr()
+n = a.n_rows
+tc = attach_tree(shm, nproc, pid, max_len=1 << 16)
+
+# this rank's block rows only (the NR_loc shape)
+parts = distribute_rows(a, nproc)
+mine = parts[pid]
+xt = np.random.default_rng(0).standard_normal(n)
+b = a.matvec(xt)
+out = {}
+x, info = pgssvx(tc, Options(), mine,
+                 b[mine.fst_row:mine.fst_row + mine.m_loc],
+                 grid=grid, lu_out=out)
+assert info == 0, info
+resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
+big_lp, _ = max(out["lu"].numeric.fronts, key=lambda p: p[0].size)
+assert len(big_lp.sharding.device_set) == nproc    # factors span ranks
+tc.close(unlink=pid == 0)
+print(f"rank {pid}: residual {resid:.2e}; largest front sharded over "
+      f"{len(big_lp.sharding.device_set)} process devices", flush=True)
+assert resid < 1e-10, resid
+"""
+
+_REF_FIXTURE = "/root/reference/EXAMPLE/g20.rua"
+
+
+def main():
+    # positional args minus flags AND their values (the _common.py
+    # discipline: `--backend cpu` etc. must not be mistaken for a path)
+    argv = sys.argv[1:]
+    args, skip = [], False
+    for i, a in enumerate(argv):
+        if skip:
+            skip = False
+            continue
+        if a.startswith("--"):
+            skip = a in ("--nproc", "--backend")   # flags taking a value
+            continue
+        args.append(a)
+    nproc = 2
+    if "--nproc" in argv:
+        nproc = int(argv[argv.index("--nproc") + 1])
+    if args:
+        path = args[0]
+    elif os.path.exists(_REF_FIXTURE):
+        path = _REF_FIXTURE
+    else:
+        path = "@poisson2d"        # generated fallback: always runs
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+    import tempfile
+    shm = f"/slu_exgrid_{os.getpid()}"
+    rc = 0
+    with tempfile.TemporaryDirectory() as td:
+        wf = os.path.join(td, "worker.py")
+        with open(wf, "w") as fh:
+            fh.write(_WORKER)
+        env = dict(os.environ, PYTHONPATH=os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".."))
+        env.pop("XLA_FLAGS", None)
+        procs = [subprocess.Popen(
+            [sys.executable, wf, str(i), str(nproc), str(port), shm, path],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            for i in range(nproc)]
+        try:
+            for i, p in enumerate(procs):
+                # stay under CI's outer 600 s budget so a wedged rank is
+                # reaped HERE (no orphaned grandchildren holding the shm)
+                out, _ = p.communicate(timeout=480)
+                txt = out.decode()
+                print(txt.strip().splitlines()[-1] if txt.strip() else
+                      f"rank {i}: (no output)")
+                rc |= p.returncode
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for leftover in glob.glob(f"/dev/shm/*{shm.strip('/')}*"):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+    assert rc == 0, "a rank failed"
+    print("pddrive_grid OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,58 @@
+"""Per-rank bootstrap for multi-process (multi-host-shaped) drivers.
+
+The role mpiexec + MPI_Init play for the reference's pddrive
+(EXAMPLE/pddrive.c:29): each OS process calls `boot(...)` FIRST —
+before importing jax anywhere else — to pin the CPU backend, raise the
+Gloo collective timeout, join the jax.distributed world, and enable the
+persistent compile cache; then `attach_tree(...)` joins the
+shared-memory tree domain for the host-side analysis collectives.
+Used by examples/pddrive_grid.py and the multihost tests.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+
+def boot(nproc: int, process_id: int, port: int | str,
+         coordinator: str = "localhost"):
+    """Initialize this rank's jax runtime for a multi-process mesh run.
+
+    Must run before the first `import jax` elsewhere in the process
+    (env vars are read at backend init).  Returns the jax module.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # a rank still compiling a big kernel must not kill a peer waiting
+    # in a Gloo collective (default send timeout 30 min; observed on a
+    # 1-core box where every rank compiles the same program serially)
+    if "collective_timeout" not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_cpu_collective_timeout_seconds=7200")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(
+        coordinator_address=f"{coordinator}:{port}",
+        num_processes=int(nproc), process_id=int(process_id))
+    # every rank compiles the same SPMD programs; the persistent cache
+    # makes rank k>0's compiles (and any rerun's) disk hits
+    from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
+    return jax
+
+
+def attach_tree(shm: str, nproc: int, rank: int, max_len: int = 4096,
+                retries: int = 600, delay: float = 0.1):
+    """Join the POSIX-shm tree domain; rank 0 creates, others retry
+    until the creator has it up (the MPI_Comm_dup moment)."""
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    if rank == 0:
+        return TreeComm(shm, nproc, 0, max_len=max_len, create=True)
+    for _ in range(retries):
+        try:
+            return TreeComm(shm, nproc, rank, max_len=max_len,
+                            create=False)
+        except OSError:
+            time.sleep(delay)
+    raise TimeoutError(f"treecomm attach timeout for {shm!r}")

@@ -17,13 +17,10 @@ import sys
 
 
 _WORKER = r"""
-import os, sys
+import sys
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(coordinator_address=f"localhost:{port}",
-                           num_processes=nproc, process_id=pid)
+from superlu_dist_tpu.parallel.mhboot import boot
+jax = boot(nproc, pid, port)
 import numpy as np, jax.numpy as jnp
 from superlu_dist_tpu.parallel.grid import gridinit_multihost
 from superlu_dist_tpu.models.gallery import poisson2d
@@ -64,24 +61,15 @@ def _free_port() -> int:
 
 
 _PGSSVX_WORKER = r"""
-import os, sys, time
+import sys, time
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 shm = sys.argv[4]; ngrid = int(sys.argv[5])
-os.environ["JAX_PLATFORMS"] = "cpu"
-import jax
-jax.config.update("jax_platforms", "cpu")
-jax.distributed.initialize(coordinator_address=f"localhost:{port}",
-                           num_processes=nproc, process_id=pid)
-# every rank compiles the same SPMD programs; the persistent cache makes
-# rank k>0's compiles (and any rerun's) disk hits instead of minutes of
-# duplicate work on this 1-core box
-from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
-enable_compile_cache()
+from superlu_dist_tpu.parallel.mhboot import boot, attach_tree
+boot(nproc, pid, port)
 import numpy as np
 from superlu_dist_tpu.models.gallery import poisson2d
 from superlu_dist_tpu.parallel.grid import gridinit_multihost
 from superlu_dist_tpu.parallel.dist import distribute_rows
-from superlu_dist_tpu.parallel.treecomm import TreeComm
 from superlu_dist_tpu.parallel.pgssvx import pgssvx
 from superlu_dist_tpu.utils.options import Options
 
@@ -107,18 +95,10 @@ xt = np.random.default_rng(3).standard_normal(n)
 b = a.matvec(xt)
 b_loc = b[mine.fst_row:mine.fst_row + mine.m_loc]
 
-# rank 0 creates the shm tree domain; the rest attach with retry
-if pid == 0:
-    tc = TreeComm(shm, nproc, 0, max_len=4096, create=True)
-else:
-    for _ in range(600):
-        try:
-            tc = TreeComm(shm, nproc, pid, max_len=4096, create=False)
-            break
-        except OSError:
-            time.sleep(0.1)
-    else:
-        raise SystemExit("treecomm attach timeout")
+# wide payload slots: n~1e5 vectors would otherwise chunk ~29x per
+# collective through the default 4096-length domain, and the IR loop is
+# dozens of spin-waiting collectives per iteration
+tc = attach_tree(shm, nproc, pid, max_len=1 << 18)
 
 note("inputs ready")
 out = {}
@@ -176,6 +156,96 @@ def test_pgssvx_mesh_two_processes_small(tmp_path):
     2-process mesh — factor sharded across processes, collective device
     solve, distributed IR, residual at reference accuracy."""
     _run_pgssvx_mesh(tmp_path, nproc=2, ngrid=24, timeout=600)
+
+
+_PGSSVX_SURFACE_WORKER = r"""
+import sys
+pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
+shm = sys.argv[4]
+from superlu_dist_tpu.parallel.mhboot import boot, attach_tree
+boot(nproc, pid, port)
+import numpy as np
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.sparse.formats import SparseCSR
+from superlu_dist_tpu.parallel.grid import gridinit_multihost
+from superlu_dist_tpu.parallel.dist import distribute_rows
+from superlu_dist_tpu.parallel.pgssvx import pgssvx
+from superlu_dist_tpu.utils.options import Options, Trans
+
+grid = gridinit_multihost(1, nproc)
+a = poisson2d(16)
+n = a.n_rows
+tc = attach_tree(shm, nproc, pid, max_len=1 << 16)
+
+rng = np.random.default_rng(7)
+parts = distribute_rows(a, nproc)
+mine = parts[pid]
+
+# (a) multiple right-hand sides
+xt = rng.standard_normal((n, 3))
+b = np.stack([a.matvec(xt[:, j]) for j in range(3)], axis=1)
+x, info = pgssvx(tc, Options(), mine,
+                 b[mine.fst_row:mine.fst_row + mine.m_loc], grid=grid)
+assert info == 0 and x.shape == (n, 3)
+for j in range(3):
+    r = np.linalg.norm(b[:, j] - a.matvec(x[:, j])) / np.linalg.norm(b[:, j])
+    assert r < 1e-10, (j, r)
+
+# (b) transpose solve through the same distributed pipeline — on a
+# NONSYMMETRIC operator (poisson2d is symmetric, which would make a
+# trans-ignoring implementation pass vacuously): scale the strictly
+# upper triangle so A != A^T
+rows = np.repeat(np.arange(n), np.diff(a.indptr))
+nd = a.data.copy()
+nd[a.indices > rows] *= 1.7
+ans = SparseCSR(n, n, a.indptr, a.indices, nd)
+nparts = distribute_rows(ans, nproc)
+bt = ans.transpose().matvec(xt[:, 0])
+xT, info = pgssvx(tc, Options(trans=Trans.TRANS), nparts[pid],
+                  bt[mine.fst_row:mine.fst_row + mine.m_loc], grid=grid)
+rT = (np.linalg.norm(bt - ans.transpose().matvec(xT))
+      / np.linalg.norm(bt))
+assert info == 0 and rT < 1e-10, rT
+
+# (c) complex (the pzgssvx twin): off-diagonals rotated into the plane
+cdata = a.data.astype(np.complex128)
+cdata[rows != a.indices] *= (0.8 + 0.6j)
+ac = SparseCSR(n, n, a.indptr, a.indices, cdata)
+cparts = distribute_rows(ac, nproc)
+bc = ac.matvec(xt[:, 1].astype(np.complex128))
+xc, info = pgssvx(tc, Options(), cparts[pid],
+                  bc[mine.fst_row:mine.fst_row + mine.m_loc], grid=grid)
+rc = np.linalg.norm(bc - ac.matvec(xc)) / np.linalg.norm(bc)
+assert info == 0 and rc < 1e-10, rc
+
+tc.close(unlink=pid == 0)
+print(f"proc {pid} surface ok nrhs={x.shape} rT={rT:.2e} rc={rc:.2e}",
+      flush=True)
+"""
+
+
+def test_pgssvx_mesh_driver_surface(tmp_path):
+    """The reference pdgssvx driver surface on the DISTRIBUTED-FACTORS
+    tier: nrhs>1, transpose solves, and the complex twin all ride the
+    mesh-sharded factorization + collective solve (2 processes)."""
+    port = _free_port()
+    script = tmp_path / "pgx_surface_worker.py"
+    script.write_text(_PGSSVX_SURFACE_WORKER)
+    env = dict(os.environ, PYTHONPATH=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env.pop("XLA_FLAGS", None)
+    shm = f"/slu_mhsurf_{os.getpid()}"
+    procs = [subprocess.Popen(
+        [sys.executable, str(script), str(i), "2", str(port), shm],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for i in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=1200)
+        outs.append(out.decode())
+    for i, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
+        assert f"proc {i} surface ok" in out
 
 
 def test_pgssvx_mesh_four_processes_n100k(tmp_path):
