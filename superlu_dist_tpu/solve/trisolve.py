@@ -18,6 +18,7 @@ iterative refinement's correction solves stable.
 from __future__ import annotations
 
 import numpy as np
+from scipy.linalg import solve_triangular
 
 from superlu_dist_tpu.numeric.factor import NumericFactorization
 
@@ -68,8 +69,10 @@ def lu_solve_trans(fact: NumericFactorization, rhs: np.ndarray,
     for s in range(ns):
         f11, l21, u12, w, u = blocks(s)
         cols = slice(int(first[s]), int(last[s]) + 1)
-        u11t = np.triu(f11).T
-        yj = np.linalg.solve(u11t, y[cols])
+        # triangular solve, not LAPACK getrf (np.linalg.solve): the
+        # per-supernode blocks make this loop the whole solve cost
+        yj = solve_triangular(f11, y[cols], trans=1, lower=False,
+                              check_finite=False)
         y[cols] = yj
         if u:
             y[sf.sn_rows[s]] -= u12.astype(yj.dtype).T @ yj
@@ -81,8 +84,8 @@ def lu_solve_trans(fact: NumericFactorization, rhs: np.ndarray,
         t = y[cols]
         if u:
             t = t - l21.astype(t.dtype).T @ y[sf.sn_rows[s]]
-        l11t = (np.tril(f11, -1) + np.eye(w, dtype=f11.dtype)).T
-        y[cols] = np.linalg.solve(l11t, t)
+        y[cols] = solve_triangular(f11, t, trans=1, lower=True,
+                                   unit_diagonal=True, check_finite=False)
 
     return y[:, 0] if squeeze else y
 
@@ -117,8 +120,8 @@ def lu_solve(fact: NumericFactorization, rhs: np.ndarray) -> np.ndarray:
     for s in range(ns):
         f11, l21, u12, w, u = blocks(s)
         cols = slice(int(first[s]), int(last[s]) + 1)
-        l11 = np.tril(f11, -1) + np.eye(w, dtype=f11.dtype)
-        yj = np.linalg.solve(l11, y[cols])
+        yj = solve_triangular(f11, y[cols], lower=True,
+                              unit_diagonal=True, check_finite=False)
         y[cols] = yj
         if u:
             y[sf.sn_rows[s]] -= l21.astype(yj.dtype) @ yj
@@ -130,7 +133,7 @@ def lu_solve(fact: NumericFactorization, rhs: np.ndarray) -> np.ndarray:
         t = y[cols]
         if u:
             t = t - u12.astype(t.dtype) @ y[sf.sn_rows[s]]
-        u11 = np.triu(f11)
-        y[cols] = np.linalg.solve(u11, t)
+        y[cols] = solve_triangular(f11, t, lower=False,
+                                   check_finite=False)
 
     return y[:, 0] if squeeze else y
