@@ -27,3 +27,31 @@ def cpu_session(n_devices: int = 1, x64: bool = True):
     from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
     return jax
+
+
+def raise_collective_timeouts():
+    """Raise the XLA:CPU in-process collective rendezvous timeouts (the
+    r3 rc=134 lesson: 8-thread all-gathers on big arrays legitimately
+    take minutes on one core).  Must run BEFORE cpu_session / backend
+    init — XLA snapshots XLA_FLAGS there."""
+    import os
+    if "collective_call_terminate_timeout" not in os.environ.get(
+            "XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
+            + " --xla_cpu_collective_call_terminate_timeout_seconds=14400")
+
+
+def parse_mesh_spec(spec: str):
+    """'1' -> (1, 1, 1); 'RxC' (R*C >= 2) -> (R, C, R*C); else SystemExit."""
+    import re
+    if spec == "1":
+        return 1, 1, 1
+    m = re.fullmatch(r"(\d+)x(\d+)", spec)
+    if m:
+        r, c = int(m.group(1)), int(m.group(2))
+        if r * c >= 2:
+            return r, c, r * c
+    raise SystemExit(f"mesh spec {spec!r}: expected '1' (single device) "
+                     "or 'RxC' with R*C >= 2 (e.g. '4x2')")

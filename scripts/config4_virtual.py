@@ -27,37 +27,22 @@ docs/config4_virtual_n{n}_complex64_1dev.json).
 
 import json
 import os
-import re
 import sys
 import time
 
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import REPO, cpu_session  # noqa: E402
+from _common import (REPO, cpu_session, parse_mesh_spec,  # noqa: E402
+                     raise_collective_timeouts)
 
 
 def main():
-    # the in-process CPU communicator's rendezvous hard-kills the process
-    # when a collective stalls past its terminate timeout — on this
-    # 1-core box an 8-thread all-gather of a ~22 GB pool legitimately
-    # takes minutes, so raise both dials before backend init
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=3600"
-        + " --xla_cpu_collective_call_terminate_timeout_seconds=14400")
+    raise_collective_timeouts()
     # parse + validate the mesh spec BEFORE anything expensive (and
     # before the device count is pinned)
     mesh_spec = os.environ.get("CONFIG4_MESH", "1")
-    if mesh_spec == "1":
-        n_dev = 1
-    else:
-        m = re.fullmatch(r"(\d+)x(\d+)", mesh_spec)
-        n_dev = int(m.group(1)) * int(m.group(2)) if m else 0
-        if n_dev < 2:
-            raise SystemExit(
-                f"CONFIG4_MESH={mesh_spec!r}: expected '1' (single "
-                "device) or 'RxC' with R*C >= 2 (e.g. '4x2')")
+    mesh_r, mesh_c, n_dev = parse_mesh_spec(mesh_spec)
     # x64: n=1M's Schur pool exceeds 2^31 entries — flat pool indices
     # need int64 (the reference's XSDK_INDEX_SIZE=64 build,
     # superlu_defs.h:85-88)
@@ -120,8 +105,7 @@ def main():
         share = plan.pool_size
         ex = StreamExecutor(plan, dtype, offload="none")
     else:
-        nprow, npcol = (int(v) for v in mesh_spec.split("x"))
-        grid = gridinit(nprow, npcol)
+        grid = gridinit(mesh_r, mesh_c)
         share = -(-plan.pool_size // grid.mesh.size)
         assert share < plan.pool_size, "pool must exceed one device share"
         ex = StreamExecutor(plan, dtype, mesh=grid.mesh,

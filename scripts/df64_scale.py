@@ -10,7 +10,11 @@ error â‰ˆ ÎºÂ·2â»Â²â´ â‰« 1e-3), while df64 factors (~2â»â´â¸) recover it â
 SURVEY Â§7 hard-part-1 story (f64-on-TPU) demonstrated beyond toy size.
 
 Writes docs/df64_scale_n{n}.json.  Env: DF64S_NX (default 16 â†’ n=4096),
-DF64S_KAPPA (default 1e10).
+DF64S_KAPPA (default 1e10), DF64S_MESH ("RxC", e.g. "4x2": run the df64
+factorization over an RÃ—C virtual mesh with the hi/lo Schur pools
+PARTITIONED across all its devices â€” the VERDICT-r3 missing-#4 path to
+the nâ‰ˆ1M class â€” and record the per-device pool share; artifact suffix
+_mesh{R}x{C}).
 """
 
 import json
@@ -21,7 +25,8 @@ import time
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-from _common import REPO, cpu_session  # noqa: E402
+from _common import (REPO, cpu_session, parse_mesh_spec,  # noqa: E402
+                     raise_collective_timeouts)
 
 
 def main():
@@ -31,14 +36,19 @@ def main():
     os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                                + " --xla_disable_hlo_passes="
                                  "fusion,cpu-instruction-fusion")
-    cpu_session()
+    raise_collective_timeouts()
+    mesh_spec = os.environ.get("DF64S_MESH", "1")
+    mesh_r, mesh_c, n_dev = parse_mesh_spec(mesh_spec)
+    cpu_session(n_devices=n_dev)
     import superlu_dist_tpu as slu
     import superlu_dist_tpu.sparse.formats as fmts
     from superlu_dist_tpu.models.gallery import poisson3d
     from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.parallel.grid import gridinit
 
     nx = int(os.environ.get("DF64S_NX", "16"))
     kappa = float(os.environ.get("DF64S_KAPPA", "1e10"))
+    grid = gridinit(mesh_r, mesh_c) if n_dev > 1 else None
 
     a0 = poisson3d(nx)
     n = a0.n_rows
@@ -63,7 +73,9 @@ def main():
           file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
-    xdf, _, _, idf = slu.gssvx(Options(factor_dtype="df64"), a, b)
+    xdf, ludf, _, idf = slu.gssvx(
+        Options(factor_dtype="df64", pool_partition=grid is not None),
+        a, b, grid=grid)
     tdf = time.perf_counter() - t0
     edf = float(np.linalg.norm(xdf - xt) / np.linalg.norm(xt))
     rdf = float(np.linalg.norm(b - a.matvec(xdf)) / np.linalg.norm(b))
@@ -77,7 +89,17 @@ def main():
            "df64_residual": rdf, "info": [i32, idf],
            "f32_seconds": round(t32, 1), "df64_seconds": round(tdf, 1),
            "backend": "cpu (1 core; timing not a perf claim)"}
-    with open(os.path.join(REPO, "docs", f"df64_scale_n{n}.json"),
+    suffix = ""
+    if grid is not None:
+        share = -(-ludf.plan.pool_size // grid.mesh.size)
+        assert share < ludf.plan.pool_size
+        rec["mesh"] = f"{mesh_spec} virtual-cpu"
+        rec["pool_partition"] = True
+        # TWO f32 pools (hi+lo words), each sharded 1-D over the mesh
+        rec["pool_entries_total_per_word"] = int(ludf.plan.pool_size)
+        rec["pool_share_per_device_per_word"] = int(share)
+        suffix = f"_mesh{mesh_spec}"
+    with open(os.path.join(REPO, "docs", f"df64_scale_n{n}{suffix}.json"),
               "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec), flush=True)
