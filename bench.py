@@ -138,8 +138,14 @@ def main():
         # docs/bench_cpu_nx48_r4.json).  The marker mirrors the TPU
         # cold-cache guard: without it a cold fused-program compile
         # could eat the child's deadline, so shrink to NX=32 (~2 min)
+        # warm markers are fingerprint-suffixed: they vouch for entries
+        # in the MACHINE-SCOPED cache dir (utils/jaxcache), so a marker
+        # from another box/toolchain must not steer this one into a
+        # cold-compile NX=48 run against an empty cache
+        from superlu_dist_tpu.utils.jaxcache import machine_fingerprint
         _cpu48 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              ".hw_done", "nx48_cpu")
+                              ".hw_done",
+                              f"nx48_cpu.{machine_fingerprint()}")
         cap = 48 if remaining >= 1000 and os.path.exists(_cpu48) else 32
         env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_NO_PROBE="1",
                    BENCH_DEADLINE_S=str(remaining - 30),
@@ -195,9 +201,19 @@ def main():
               "BENCH_GROWTH", "BENCH_AMALG", "BENCH_MATRIX",
               "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
               "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV")
-    _default_cfg = not any(k in os.environ for k in _KNOBS)
+    # BENCH_NX=48 is exactly the default size, so an explicit "48" (the
+    # hardware session's nx48_default config) still counts as the default
+    # kernel set — its successful run must warm the default marker
+    _knob_set = {k for k in _KNOBS if k in os.environ}
+    if os.environ.get("BENCH_NX") == "48":
+        _knob_set.discard("BENCH_NX")
+    _default_cfg = not _knob_set
+    # fingerprint-suffixed (see the CPU-fallback marker above): the
+    # warmth claim is per machine-scoped cache dir
+    from superlu_dist_tpu.utils.jaxcache import machine_fingerprint
     _marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           ".hw_done", "nx48_default")
+                           ".hw_done",
+                           f"nx48_default.{machine_fingerprint()}")
     if (_default_cfg and jax.default_backend() != "cpu"
             and DEADLINE - (time.perf_counter() - T0) < 2400
             and not os.path.exists(_marker)):
@@ -344,11 +360,15 @@ def main():
         # session writes)
         os.makedirs(os.path.dirname(_marker), exist_ok=True)
         open(_marker, "a").close()
-    if NX == 48 and backend == "cpu" and gran == "fused":
-        # the NX=48 CPU fused program is cached: the CPU fallback may
-        # keep the driver size from now on (see the fallback cap)
+    if _default_cfg and NX == 48 and backend == "cpu" and gran == "fused":
+        # the NX=48 CPU fused program (default blocking knobs — a custom
+        # BENCH_RELAX/AMALG program would not warm the default kernels)
+        # is cached: the CPU fallback may keep the driver size from now
+        # on (see the fallback cap)
+        from superlu_dist_tpu.utils.jaxcache import machine_fingerprint
         mk = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".hw_done", "nx48_cpu")
+                          ".hw_done",
+                          f"nx48_cpu.{machine_fingerprint()}")
         os.makedirs(os.path.dirname(mk), exist_ok=True)
         open(mk, "a").close()
 

@@ -66,23 +66,18 @@ _REF_FIXTURE = "/root/reference/EXAMPLE/g20.rua"
 
 
 def main():
-    # positional args minus flags AND their values (the _common.py
-    # discipline: `--backend cpu` etc. must not be mistaken for a path)
-    argv = sys.argv[1:]
-    args, skip = [], False
-    for i, a in enumerate(argv):
-        if skip:
-            skip = False
-            continue
-        if a.startswith("--"):
-            skip = a in ("--nproc", "--backend")   # flags taking a value
-            continue
-        args.append(a)
-    nproc = 2
-    if "--nproc" in argv:
-        nproc = int(argv[argv.index("--nproc") + 1])
-    if args:
-        path = args[0]
+    import argparse
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("matrix", nargs="?", default=None,
+                    help="matrix file (HB/RB/MM); defaults to the "
+                         "reference g20.rua fixture, else @poisson2d")
+    ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--backend", default=None,
+                    help="accepted for _common.py symmetry; unused here")
+    ns = ap.parse_args()          # rejects unknown --flags, supports '='
+    nproc = ns.nproc
+    if ns.matrix:
+        path = ns.matrix
     elif os.path.exists(_REF_FIXTURE):
         path = _REF_FIXTURE
     else:

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""A/B the distributed-factors tier's analysis distribution (VERDICT r4 #3).
+
+Round-4 behavior (replicated): EVERY rank assembles the global matrix and
+runs the identical EQUIL→ROWPERM→COLPERM→SYMBFACT→plan analysis —
+O(nnz(A)+nnz(L)) host memory and analysis work per process, the wall the
+reference's parallel symbolic was built to break (SRC/psymbfact.c:228-242).
+
+Round-5 behavior (root+bcast): rank 0 analyzes once and broadcasts the
+analyzed skeleton over the shared-memory tree (parallel/pgssvx.py
+_pgssvx_mesh) — non-root ranks never hold the global graph or do analysis
+work.
+
+Each mode runs in FRESH forked processes (VmHWM is a process-lifetime
+high-water mark), 4 ranks, poisson3d(MAS_NX) (default 48 → n=110,592;
+MAS_NX=100 → n=1e6).  Writes docs/mesh_analysis_4proc_n{n}.json with
+per-rank analysis wall time and peak host memory for both modes.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO  # noqa: E402
+
+sys.path.insert(0, REPO)
+
+
+def _mem_mb(key):
+    with open("/proc/self/status") as fh:
+        for line in fh:
+            if line.startswith(key):
+                return int(line.split()[1]) / 1024.0
+    return float("nan")
+
+
+def _rank_body(mode, name, nranks, rank, part, q):
+    from superlu_dist_tpu.drivers.gssvx import analyze
+    from superlu_dist_tpu.parallel.pgssvx import gather_distributed
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.options import Options
+
+    with TreeComm(name, nranks, rank, max_len=1 << 20,
+                  create=rank == 0) as tc:
+        # barrier-ish: everyone attached before timing starts
+        tc.allreduce_sum_any(np.ones(1))
+        base_mb = _mem_mb("VmRSS")     # interpreter+imports baseline —
+        t0 = time.perf_counter()       # the analysis delta is the signal
+        if mode == "replicated":
+            a_all = gather_distributed(tc, part, all_ranks=True)
+            lu, bvals, _ = analyze(Options(), a_all)
+        else:
+            a_root = gather_distributed(tc, part, root=0)
+            blob = None
+            if rank == 0:
+                lu, bvals, _ = analyze(Options(), a_root)
+                lu.a = None
+                blob = (lu, bvals)
+            lu, bvals = tc.bcast_obj(blob, root=0)
+        t = time.perf_counter() - t0
+        assert lu.plan is not None and len(bvals) > 0
+        q.put({"rank": rank, "mode": mode, "analysis_seconds": round(t, 3),
+               "vm_rss_mb": round(_mem_mb("VmRSS"), 1),
+               "vm_hwm_mb": round(_mem_mb("VmHWM"), 1),
+               "baseline_mb": round(base_mb, 1),
+               "analysis_hwm_delta_mb": round(_mem_mb("VmHWM") - base_mb, 1),
+               "n_groups": len(lu.plan.groups)})
+
+
+def _run_mode(mode, parts, nranks):
+    name = f"/slu_mas_{os.getpid()}_{mode}"
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_rank_body,
+                         args=(mode, name, nranks, r, parts[r], q))
+             for r in range(nranks)]
+    # rank 0 creates the segment; its constructor must win the race —
+    # start it first and give it a head start (TreeComm rendezvous
+    # contract)
+    procs[0].start()
+    time.sleep(1.0)
+    for p in procs[1:]:
+        p.start()
+    rows = []
+    try:
+        for _ in procs:
+            rows.append(q.get(timeout=3600))
+    finally:
+        for p in procs:
+            p.join(timeout=60)
+            if p.is_alive():
+                p.terminate()
+        import glob
+        for leftover in glob.glob(f"/dev/shm/*{name.strip('/')}*"):
+            try:
+                os.unlink(leftover)
+            except OSError:
+                pass
+    return sorted(rows, key=lambda r: r["rank"])
+
+
+def main():
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+
+    nx = int(os.environ.get("MAS_NX", "48"))
+    a = poisson3d(nx)
+    n = a.n_rows
+    nranks = 4
+    parts = distribute_rows(a, nranks)
+    del a
+
+    out = {"n": n, "nnz": int(sum(p.nnz_loc for p in parts)),
+           "nranks": nranks}
+    for mode in ("replicated", "root_bcast"):
+        t0 = time.perf_counter()
+        rows = _run_mode(mode, parts, nranks)
+        out[mode] = {"ranks": rows,
+                     "wall_seconds": round(time.perf_counter() - t0, 3)}
+        print(f"[{mode}] wall={out[mode]['wall_seconds']}s  " +
+              "  ".join(f"r{r['rank']}:{r['analysis_seconds']}s/"
+                        f"{r['vm_hwm_mb']:.0f}MB" for r in rows),
+              flush=True)
+
+    rep = out["replicated"]["ranks"]
+    bc = out["root_bcast"]["ranks"]
+    out["nonroot_time_ratio"] = round(
+        np.mean([r["analysis_seconds"] for r in rep[1:]])
+        / max(np.mean([r["analysis_seconds"] for r in bc[1:]]), 1e-9), 2)
+    out["nonroot_hwm_ratio"] = round(
+        np.mean([r["vm_hwm_mb"] for r in rep[1:]])
+        / np.mean([r["vm_hwm_mb"] for r in bc[1:]]), 2)
+    out["nonroot_hwm_delta_ratio"] = round(
+        np.mean([r["analysis_hwm_delta_mb"] for r in rep[1:]])
+        / max(np.mean([r["analysis_hwm_delta_mb"] for r in bc[1:]]),
+              1e-9), 2)
+    # the barrier wall time: in replicated mode 4 analyses contend for
+    # the core; in bcast mode one analysis + one O(nnz) transfer
+    out["wall_ratio"] = round(out["replicated"]["wall_seconds"]
+                              / out["root_bcast"]["wall_seconds"], 2)
+    path = os.path.join(REPO, "docs", f"mesh_analysis_4proc_n{n}.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print("wrote", path)
+    print(json.dumps({k: out[k] for k in
+                      ("nonroot_time_ratio", "nonroot_hwm_ratio",
+                       "wall_ratio")}))
+
+
+if __name__ == "__main__":
+    main()

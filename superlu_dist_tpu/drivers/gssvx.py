@@ -188,42 +188,40 @@ class LUFactorization:
                                     lambda: lu_solve(self.numeric, d))
 
 
-def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
-          lu: LUFactorization | None = None, stats: Stats | None = None,
-          grid=None):
-    """Solve A·X = B.  Returns (x, lu, stats, info).
+def analyze(options: Options, a: SparseCSR,
+            lu: LUFactorization | None = None,
+            stats: Stats | None = None):
+    """The host analysis phases only: EQUIL → ROWPERM → COLPERM →
+    SYMBFACT → DIST/plan (pdgssvx.c:647-1166 before pdgstrf).
 
-    info = 0 on success; > 0 mirrors the reference's singularity reporting
-    via tiny-pivot counts in stats (with ReplaceTinyPivot the factorization
-    always completes, pdgstrf2.c:218-232).
-
-    `grid` is a parallel.grid.ProcessGrid (the reference passes gridinfo_t
-    to pdgssvx): the numeric factorization and device solve then run
-    sharded over the grid's mesh.
+    Returns ``(lu, bvals, stats)``: `lu` is an LUFactorization skeleton
+    (numeric=None) carrying every transform plus the symbolic/plan, and
+    `bvals` the structurally-permuted matrix values ready for
+    factorize_numeric.  The split exists so the distributed-factors tier
+    can run the analysis ONCE (on root) and broadcast the skeleton —
+    O(nnz) transfer instead of O(nnz) redundant work and memory on every
+    rank, the wall the reference's symbfact_dist was built to break
+    (SRC/psymbfact.c:140,228-242).
     """
     if stats is None:
         stats = Stats()
-    if options.print_stat:
-        print(print_options(options))
     n = a.n_rows
     if a.n_cols != n:
         raise SuperLUError("A must be square")
-    b = np.asarray(b)
-    if b.shape[0] != n:
-        raise SuperLUError("B leading dimension must match A")
     fact = options.fact
-
-    if fact == Fact.FACTORED:
-        if lu is None or lu.numeric is None:
-            raise SuperLUError("Fact=FACTORED requires a prior factorization")
-        return _solve_and_refine(options, a, b, lu, stats)
 
     reuse_rowperm = fact == Fact.SamePattern_SameRowPerm and lu is not None
     reuse_colperm = fact in (Fact.SamePattern, Fact.SamePattern_SameRowPerm) \
         and lu is not None
-    # our symbolic runs on the row-permuted pattern, so the symbolic/plan can
-    # only be reused when the row permutation is reused too (the reference's
-    # SamePattern_SameRowPerm tier; plain SamePattern reuses the column order)
+    # Symbolic/plan reuse tiers.  Our symbolic runs on the row-permuted
+    # pattern, so reuse is sound iff the row permutation is unchanged:
+    # always true under SamePattern_SameRowPerm, and detected dynamically
+    # under plain SamePattern after the fresh matching below (the common
+    # time-stepping case — values drift, MC64 returns the same matching).
+    # The reference's own plain-SamePattern tier likewise re-runs symbfact
+    # (the pdgssvx.c:1034 gate skips it only for SamePattern_SameRowPerm)
+    # and reuses perm_c + etree; detecting the equal-row-perm case reuses
+    # strictly more than the reference whenever it fires.
     reuse_symbolic = reuse_rowperm
 
     # ---- EQUIL (pdgssvx.c:647-760) -----------------------------------------
@@ -260,6 +258,14 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
             row_order = np.arange(n, dtype=np.int64)
             r1 = c1 = np.ones(n)
             a2 = a1
+
+    if reuse_colperm and not reuse_symbolic and lu.sf is not None \
+            and np.array_equal(row_order, lu.row_order):
+        # plain SamePattern, and the fresh matching reproduced the prior
+        # row order: the permuted pattern is unchanged, so the symbolic
+        # and plan carry over (verified structurally by the DIST check
+        # below) — SYMBFACT+DIST drop to ~0 while ROWPERM re-ran
+        reuse_symbolic = True
 
     anorm = a2.norm_max()
     sym = symmetrize_pattern(a2)
@@ -304,28 +310,49 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
                 f"as the factorization being reused")
         bvals = sym.data[sf.value_perm]
 
-    # ---- FACT (pdgssvx.c:1176 → pdgstrf) -----------------------------------
+    lu = LUFactorization(n=n, options=options, equed=equed, dr=dr, dc=dc,
+                         r1=r1, c1=c1, row_order=row_order,
+                         col_order=col_order, sf=sf, plan=plan,
+                         numeric=None, anorm=anorm, a=a,
+                         a_sym_indptr=sym.indptr, a_sym_indices=sym.indices)
+    return lu, bvals, stats
+
+
+def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
+                      stats: Stats | None = None, grid=None) -> int:
+    """Numeric factorization (pdgssvx.c:1176 → pdgstrf, SRC/pdgstrf.c:243)
+    on an analyzed skeleton from `analyze`.
+
+    With `grid`, the factorization runs sharded over the grid's mesh —
+    when that mesh spans multiple processes this is an SPMD collective
+    every rank must enter with the SAME skeleton and values (the
+    distributed-factors tier broadcasts them first).  Fills lu.numeric in
+    place; returns info (0, or 1-based first zero-pivot column)."""
+    if stats is None:
+        stats = Stats()
+    options = lu.options
+    plan = lu.plan
     dtype = options.factor_dtype or default_factor_dtype()
-    if np.issubdtype(a.data.dtype, np.complexfloating):
+    if np.issubdtype(np.asarray(bvals).dtype, np.complexfloating):
         dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
     with stats.timer("FACT"):
         if str(dtype) == "df64":
             # emulated-double factorization for f32-only hardware (true
             # ~2^-48 factors; SURVEY.md §7 hard-part 1); host f64 factors
             # come back, so the standard solve path applies
-            if np.issubdtype(a.data.dtype, np.complexfloating):
+            if np.issubdtype(np.asarray(bvals).dtype, np.complexfloating):
                 raise SuperLUError("factor_dtype='df64' supports real "
                                    "matrices only (use complex128 on CPU)")
             from superlu_dist_tpu.numeric.df64_factor import (
                 df64_numeric_factorize)
             numeric = df64_numeric_factorize(
-                plan, bvals, anorm,
+                plan, bvals, lu.anorm,
                 replace_tiny=options.replace_tiny_pivot,
                 mesh=grid.mesh if grid is not None else None,
                 pool_partition=options.pool_partition)
         else:
             numeric = numeric_factorize(
-                plan, bvals, anorm, dtype=dtype,
+                plan, bvals, lu.anorm, dtype=dtype,
                 replace_tiny=options.replace_tiny_pivot,
                 mesh=grid.mesh if grid is not None else None,
                 pool_partition=options.pool_partition)
@@ -342,17 +369,52 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
     stats.for_lu_bytes = space["for_lu_bytes"]
     stats.pool_bytes = space["pool_bytes"]
 
-    lu = LUFactorization(n=n, options=options, equed=equed, dr=dr, dc=dc,
-                         r1=r1, c1=c1, row_order=row_order,
-                         col_order=col_order, sf=sf, plan=plan,
-                         numeric=numeric, anorm=anorm, a=a,
-                         a_sym_indptr=sym.indptr, a_sym_indices=sym.indices,
-                         mesh=grid.mesh if grid is not None else None)
+    lu.numeric = numeric
+    lu.mesh = grid.mesh if grid is not None else None
+    # invalidate solve-side caches from any prior factorization the
+    # skeleton was reused from
+    lu.dev_solver = None
     if not numeric.finite:
         # exactly singular U and no tiny-pivot replacement: info is the
         # 1-based first zero-pivot column, like the reference's Allreduce-MIN
         # of the first i with U(i,i)==0 (pdgstrf.c:1920-1924)
-        return None, lu, stats, numeric.info_col + 1
+        return numeric.info_col + 1
+    return 0
+
+
+def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
+          lu: LUFactorization | None = None, stats: Stats | None = None,
+          grid=None):
+    """Solve A·X = B.  Returns (x, lu, stats, info).
+
+    info = 0 on success; > 0 mirrors the reference's singularity reporting
+    via tiny-pivot counts in stats (with ReplaceTinyPivot the factorization
+    always completes, pdgstrf2.c:218-232).
+
+    `grid` is a parallel.grid.ProcessGrid (the reference passes gridinfo_t
+    to pdgssvx): the numeric factorization and device solve then run
+    sharded over the grid's mesh.
+    """
+    if stats is None:
+        stats = Stats()
+    if options.print_stat:
+        print(print_options(options))
+    n = a.n_rows
+    if a.n_cols != n:
+        raise SuperLUError("A must be square")
+    b = np.asarray(b)
+    if b.shape[0] != n:
+        raise SuperLUError("B leading dimension must match A")
+
+    if options.fact == Fact.FACTORED:
+        if lu is None or lu.numeric is None:
+            raise SuperLUError("Fact=FACTORED requires a prior factorization")
+        return _solve_and_refine(options, a, b, lu, stats)
+
+    lu, bvals, stats = analyze(options, a, lu=lu, stats=stats)
+    info = factorize_numeric(lu, bvals, stats, grid=grid)
+    if info != 0:
+        return None, lu, stats, info
     return _solve_and_refine(options, a, b, lu, stats)
 
 

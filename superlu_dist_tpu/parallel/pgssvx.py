@@ -81,7 +81,8 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
 
 
 def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
-           b_loc: np.ndarray, root: int = 0, grid=None, lu_out=None):
+           b_loc: np.ndarray, root: int = 0, grid=None, lu_out=None,
+           replicate_analysis: bool = False):
     """Collectively solve op(A)·X = B from block-row distributed input.
 
     b_loc: (m_loc,) or (m_loc, nrhs) — this rank's block rows of B.
@@ -92,14 +93,17 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
 
     `grid` (a parallel.grid.ProcessGrid whose mesh spans ALL the
     participating processes' devices, from gridinit_multihost) selects
-    the distributed-factors tier: every rank assembles the global
-    analysis input (O(nnz(A)) host memory), then all ranks run the SAME
-    mesh-sharded factorization and collective device solve — the factors
-    and the Schur pool live sharded across the processes' devices and NO
-    process ever materializes them (the reference's defining NR_loc-in,
+    the distributed-factors tier: rank 0 assembles the global analysis
+    input, runs the host analysis once, and broadcasts the analyzed
+    skeleton; then all ranks run the SAME mesh-sharded factorization and
+    collective device solve — the factors and the Schur pool live
+    sharded across the processes' devices and NO process ever
+    materializes them (the reference's defining NR_loc-in,
     distributed-factors-out property, SRC/pdgssvx.c:505 /
-    pddistribute.c:322).  Without `grid`, the single-host fallback
-    gathers to root and factors there (refinement stays distributed).
+    pddistribute.c:322), and no non-root process ever holds the global
+    graph (the psymbfact memory-wall property, SRC/psymbfact.c:228-242).
+    Without `grid`, the single-host fallback gathers to root and factors
+    there (refinement stays distributed).
 
     `lu_out`: optional dict; on return, lu_out["lu"] holds this rank's
     LUFactorization handle (the reference's caller-owned LUstruct — on
@@ -126,7 +130,8 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
 
     if grid is not None:
         return _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
-                            lu_out=lu_out)
+                            lu_out=lu_out,
+                            replicate_analysis=replicate_analysis)
 
     a_root = gather_distributed(tc, a_loc, root=root)
     b_full = np.zeros((n, nrhs), dtype=wdtype)
@@ -181,46 +186,69 @@ def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs):
 
 
 def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
-                 lu_out=None):
-    """Distributed-factors tier: every rank assembles the same global
-    analysis input, then all ranks run ONE mesh-sharded gssvx in
-    lockstep — the factorization, Schur pool, and triangular solves are
-    SPMD programs over the grid's (multi-process) mesh, so the factors
-    stay sharded across the processes' devices for their whole lifetime.
-    The collective correction solve also serves the distributed
-    refinement loop (every rank calls it — the pdgsrfs shape where
-    pdgstrs is itself parallel, SRC/pdgsrfs.c:205)."""
+                 lu_out=None, replicate_analysis=False):
+    """Distributed-factors tier: rank 0 assembles the global analysis
+    input and runs the host analysis ONCE, then broadcasts the analyzed
+    skeleton (symbolic + plan + transforms + permuted values) over the
+    tree — O(nnz) transfer instead of O(nnz) redundant analysis work and
+    graph memory on every rank, the wall the reference's distributed
+    symbolic was built to break (SRC/psymbfact.c:140,228-242,
+    get_perm_c_parmetis.c:104).  All ranks then run ONE mesh-sharded
+    numeric factorization in lockstep — the factors, Schur pool, and
+    triangular solves are SPMD programs over the grid's (multi-process)
+    mesh, so the factors stay sharded across the processes' devices for
+    their whole lifetime.  The collective correction solve also serves
+    the distributed refinement loop (every rank calls it — the pdgsrfs
+    shape where pdgstrs is itself parallel, SRC/pdgsrfs.c:205).
+
+    replicate_analysis=True restores the round-4 every-rank-analyzes
+    behavior (kept for A/B measurement, scripts/mesh_analysis_scale.py).
+    """
     import dataclasses
 
-    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
     from superlu_dist_tpu.utils.options import IterRefine, Trans
+    from superlu_dist_tpu.utils.stats import Stats
 
     n = a_loc.n
     nrhs = b2.shape[1]
-    a_all = gather_distributed(tc, a_loc, all_ranks=True)
     b_full = np.zeros((n, nrhs), dtype=wdtype)
     b_full[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = b2
     b_full = tc.allreduce_sum_any(b_full, root=0)
 
     # refinement runs distributed below (block rows stay with their
-    # owners); gssvx does analysis + mesh factorization + first solve
+    # owners), so the skeleton travels WITHOUT the global matrix: a
+    # non-root rank never materializes A, only the analysis products
     opts0 = dataclasses.replace(options, iter_refine=IterRefine.NOREFINE)
-    x_r, lu, stats, info_r = gssvx(
-        opts0, a_all, b_full if nrhs > 1 else b_full[:, 0], grid=grid)
+    stats = Stats()
+    if replicate_analysis:
+        a_all = gather_distributed(tc, a_loc, all_ranks=True)
+        lu, bvals, _ = analyze(opts0, a_all, stats=stats)
+        lu.a = None
+    else:
+        a_root = gather_distributed(tc, a_loc, root=0)
+        blob = None
+        if tc.rank == 0:
+            lu, bvals, _ = analyze(opts0, a_root, stats=stats)
+            lu.a = None            # O(nnz(A)) — stays on root
+            blob = (lu, bvals)
+        lu, bvals = tc.bcast_obj(blob, root=0)
+    info_r = factorize_numeric(lu, bvals, stats, grid=grid)
     if lu_out is not None:
         lu_out["lu"] = lu
         lu_out["stats"] = stats
     if info_r != 0:
         return None, int(info_r)
-    x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
-
     trans = getattr(options, "trans", Trans.NOTRANS)
     if trans == Trans.NOTRANS:
         solve_fn = lu.solve_factored
     else:
         solve_fn = (lambda r: lu.solve_factored_trans(
             r, conj=trans == Trans.CONJ))
+    with stats.timer("SOLVE"):
+        x_r = solve_fn(b_full if nrhs > 1 else b_full[:, 0])
+    x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
     if options.iter_refine == IterRefine.NOREFINE:
         x = x0
     else:

@@ -131,6 +131,37 @@ class TreeComm:
     def allreduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         return self._payload_op(arr, root, self.allreduce_sum)
 
+    # ---- byte / object layer -------------------------------------------
+    # The native bcast is a pure memcpy through the f64 slots, so raw
+    # bytes ride bit-exactly reinterpreted as float64 (no arithmetic ever
+    # touches them — reductions would, so only broadcast is offered).
+
+    def bcast_bytes(self, data: bytes | None, root: int = 0) -> bytes:
+        """Broadcast a byte string from root (non-root passes None)."""
+        if self.rank == root:
+            n = len(data)
+            payload = np.frombuffer(
+                data + b"\0" * (-n % 8), dtype=np.float64)
+        else:
+            n = 0
+            payload = None
+        n = int(self.bcast_any(np.array([n], dtype=np.int64),
+                               root=root)[0])
+        if self.rank != root:
+            payload = np.zeros((n + 7) // 8, dtype=np.float64)
+        out = self._f64_op(payload, root, self.bcast)
+        return out.tobytes()[:n]
+
+    def bcast_obj(self, obj=None, root: int = 0):
+        """Broadcast a picklable object from root (non-root passes None).
+        Carries the analysis artifacts of the distributed-factors tier —
+        the role the reference's MPI_Bcast of perm vectors plays
+        (pdgssvx.c:816-831), widened to whole symbolic/plan structures."""
+        import pickle
+        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL) \
+            if self.rank == root else None
+        return pickle.loads(self.bcast_bytes(blob, root=root))
+
     def close(self, unlink: bool | None = None):
         if self._h:
             if unlink is None:

@@ -227,6 +227,11 @@ class DeviceSolver:
         # later host solve on the same factorization to re-pull everything
         if (any(isinstance(lp, np.ndarray) for lp, _ in fact.fronts)
                 and not fact.on_host):
+            # stream.py disables host-share under a mesh; enforce that
+            # invariant HERE too — jnp.asarray would commit these fronts
+            # to one local device and break a multi-process SPMD solve
+            assert mesh is None, \
+                "host-share fronts cannot meet a multi-process mesh solve"
             self.fronts = [(jnp.asarray(lp), jnp.asarray(up))
                            for lp, up in fact.fronts]
         else:

@@ -1,29 +1,97 @@
 """Persistent XLA compile-cache policy, in one place.
 
-Every driver/bench/measurement entry point points jax at the repo-local
-cache (`.cache/jax`, gitignored) so kernels compile once per machine —
-through the remote-compile TPU tunnel a single kernel costs ~8-40 s, so
-cache reuse is the difference between a bench that finishes and one
-that hits its watchdog (BASELINE.md round-2/3 compile-wall history).
+Every driver/bench/measurement entry point points jax at a repo-local
+cache (gitignored) so kernels compile once per machine — through the
+remote-compile TPU tunnel a single kernel costs ~8-40 s, so cache reuse
+is the difference between a bench that finishes and one that hits its
+watchdog (BASELINE.md round-2/3 compile-wall history).
+
+The cache directory is scoped by a MACHINE FINGERPRINT: XLA:CPU AOT
+executables embed host ISA features, and loading an entry compiled on a
+different machine is at best a "machine features don't match ... SIGILL"
+warning and at worst a deterministic hang — a thread dies inside the
+loaded executable and the in-process collective rendezvous of a
+multi-device run sleeps forever (observed 6/6 on cross-machine entries
+vs 2/2 green cold compiles, round 4).  Scoping the directory by
+fingerprint makes every entry point immune to foreign entries while
+keeping same-machine warm starts: a different box simply reads a
+different directory.
 """
 
 import os
 
+_FP_CACHE = None
+
+
+def machine_fingerprint() -> str:
+    """Short stable tag for (machine ISA, jax toolchain).
+
+    Built from the CPU model + feature flags (the exact axis on which
+    the cpu_aot loader declares entries incompatible) and the jax/jaxlib
+    versions (serialization format axis).  Deterministic within a
+    machine+install, distinct across the machines that produced the
+    round-4 poisoned-cache hangs.
+    """
+    global _FP_CACHE
+    if _FP_CACHE is not None:
+        return _FP_CACHE
+    import hashlib
+    import platform
+
+    bits = [platform.machine()]
+    try:
+        import jax
+        import jaxlib
+        bits += [jax.__version__, jaxlib.__version__]
+    except Exception:
+        pass
+    try:
+        seen = set()
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                key = line.split(":", 1)[0].strip()
+                # one copy per key: these lines repeat per core
+                if key in ("model name", "flags", "Features") \
+                        and key not in seen:
+                    seen.add(key)
+                    bits.append(line.strip())
+    except OSError:
+        bits.append(platform.processor() or "unknown-cpu")
+    _FP_CACHE = hashlib.sha256("|".join(bits).encode()).hexdigest()[:10]
+    return _FP_CACHE
+
+
+def cache_dir_for_machine(base: str | None = None) -> str:
+    """The machine-scoped persistent cache directory
+    (`.cache/jax-mach-<fingerprint>` under the repo by default)."""
+    if base is None:
+        base = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))), ".cache")
+    return os.path.join(base, f"jax-mach-{machine_fingerprint()}")
+
 
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point jax at the persistent compile cache (default: the repo's
-    `.cache/jax`, resolved relative to this package).  Caches every
-    entry regardless of size/compile time.  Never raises — the cache is
-    an optimization, not a failure reason.  Call any time before (or
+    machine-scoped `.cache/jax-mach-<fp>`).  Caches every entry
+    regardless of size/compile time.  Never raises — the cache is an
+    optimization, not a failure reason.  Call any time before (or
     after) backend init; only subsequent compiles are affected."""
     import jax
     if cache_dir is None:
-        cache_dir = os.path.join(
-            os.path.dirname(os.path.dirname(os.path.dirname(
-                os.path.abspath(__file__)))), ".cache", "jax")
+        cache_dir = cache_dir_for_machine()
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
+
+
+def current_cache_dir() -> str | None:
+    """The cache dir jax is currently configured with (None if unset)."""
+    import jax
+    try:
+        return jax.config.read("jax_compilation_cache_dir")
+    except Exception:
+        return None

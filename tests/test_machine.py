@@ -59,3 +59,46 @@ def test_stats_timer_accumulates():
     with s.timer("FACT"):
         time.sleep(0.01)
     assert s.utime["FACT"] >= 0.009
+
+
+# ---- compile-cache machine scoping (round-4 poisoned-cache class) --------
+
+def test_machine_fingerprint_stable_and_scoped(tmp_path, monkeypatch):
+    """The persistent compile cache must be keyed by a machine/toolchain
+    fingerprint: XLA:CPU AOT entries written on a different machine hang
+    multi-device runs (cpu_aot 'machine features don't match' / SIGILL
+    class).  Scoping the directory makes foreign entries unreachable by
+    construction — a foreign box's entries live under a different
+    fingerprint and are never opened here."""
+    import superlu_dist_tpu.utils.jaxcache as jc
+
+    fp = jc.machine_fingerprint()
+    assert fp == jc.machine_fingerprint()          # memoized + stable
+    assert len(fp) == 10 and all(c in "0123456789abcdef" for c in fp)
+
+    d = jc.cache_dir_for_machine(str(tmp_path))
+    assert d == str(tmp_path / f"jax-mach-{fp}")
+
+    # simulated foreign-entry injection: entries under another machine's
+    # fingerprint directory must not be visible from this machine's dir
+    foreign = tmp_path / "jax-mach-deadbeef00"
+    foreign.mkdir()
+    (foreign / "xla_aot_entry").write_bytes(b"\x90" * 64)
+    import os
+    assert not os.path.exists(d) or "xla_aot_entry" not in os.listdir(d)
+
+    # the fingerprint reacts to the inputs it hashes (cpuinfo flags):
+    # recompute with the memo cleared and a faked cpuinfo
+    monkeypatch.setattr(jc, "_FP_CACHE", None)
+    real_open = open
+
+    def fake_open(path, *a, **k):
+        if path == "/proc/cpuinfo":
+            import io
+            return io.StringIO("model name: other-cpu\nflags: none\n")
+        return real_open(path, *a, **k)
+
+    monkeypatch.setattr("builtins.open", fake_open)
+    fp2 = jc.machine_fingerprint()
+    monkeypatch.setattr(jc, "_FP_CACHE", None)
+    assert fp2 != fp

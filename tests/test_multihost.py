@@ -60,6 +60,29 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+def _communicate_all(procs, timeout, shm=None):
+    """Collect every rank's output; on ANY failure (timeout, crash) kill
+    the survivors and unlink shm leftovers so no orphan rank keeps the
+    /dev/shm tree segment alive (the pddrive_grid.py discipline)."""
+    import glob
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            outs.append(out.decode())
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if shm is not None:
+            for leftover in glob.glob(f"/dev/shm/*{shm.strip('/')}*"):
+                try:
+                    os.unlink(leftover)
+                except OSError:
+                    pass
+    return outs
+
+
 _PGSSVX_WORKER = r"""
 import sys, time
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
@@ -142,10 +165,7 @@ def _run_pgssvx_mesh(tmp_path, nproc, ngrid, timeout):
          shm, str(ngrid)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for i in range(nproc)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=timeout)
-        outs.append(out.decode())
+    outs = _communicate_all(procs, timeout, shm=shm)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"proc {i} pgssvx-mesh ok" in out
@@ -239,10 +259,7 @@ def test_pgssvx_mesh_driver_surface(tmp_path):
         [sys.executable, str(script), str(i), "2", str(port), shm],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for i in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=1200)
-        outs.append(out.decode())
+    outs = _communicate_all(procs, 1200, shm=shm)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"proc {i} surface ok" in out
@@ -272,10 +289,7 @@ def test_multihost_factorization_two_processes(tmp_path):
         [sys.executable, str(script), str(i), "2", str(port)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
         for i in range(2)]
-    outs = []
-    for p in procs:
-        out, _ = p.communicate(timeout=540)
-        outs.append(out.decode())
+    outs = _communicate_all(procs, 540)
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out}"
         assert f"proc {i} ok" in out

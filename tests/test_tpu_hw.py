@@ -49,9 +49,8 @@ def _run_on_hw(code: str, timeout: float = 7200.0):
 
 _PRELUDE = """
 import jax
-jax.config.update("jax_compilation_cache_dir", ".cache/jax")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+enable_compile_cache()
 import numpy as np
 import superlu_dist_tpu as slu
 assert jax.default_backend() != "cpu", jax.default_backend()

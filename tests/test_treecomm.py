@@ -90,6 +90,61 @@ def test_binary_tree_nonzero_root():
     _run(10, root=7)
 
 
+def _obj_payload():
+    return {
+        "blob": b"\x00\xff analysis \x01" * 7,        # odd length, NULs
+        "big_ints": np.array([2**62 + 3, -(2**55) - 1], dtype=np.int64),
+        "nan_bits": np.array([np.nan, -0.0, np.inf]),
+        "sf_like": {"sn_rows": [np.arange(5), np.arange(3) * 7]},
+    }
+
+
+def _obj_worker(name, n_ranks, rank, root, q):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    with TreeComm(name, n_ranks, rank, max_len=16, create=False) as tc:
+        got = tc.bcast_obj(_obj_payload() if rank == root else None,
+                           root=root)
+        ref = _obj_payload()
+        ok = (got["blob"] == ref["blob"]
+              and np.array_equal(got["big_ints"], ref["big_ints"])
+              and np.array_equal(got["nan_bits"], ref["nan_bits"],
+                                 equal_nan=True)
+              and all(np.array_equal(a, b) for a, b in
+                      zip(got["sf_like"]["sn_rows"],
+                          ref["sf_like"]["sn_rows"])))
+        q.put((rank, ok))
+
+
+def test_bcast_obj_bit_exact_chunked():
+    """Pickled-object broadcast (the mesh tier's analysis transport):
+    bytes ride the f64 slots bit-exactly — int64 beyond 2^53 and NaN
+    payloads must survive, which the mantissa ride could not carry —
+    and max_len=16 forces the chunked streaming path."""
+    name = f"/slu_tree_obj_{os.getpid()}"
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    n_ranks, root = 4, 1
+    owner = TreeComm(name, n_ranks, 0, max_len=16, create=True)
+    try:
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_obj_worker,
+                             args=(name, n_ranks, r, root, q))
+                 for r in range(1, n_ranks)]
+        for p in procs:
+            p.start()
+        got = owner.bcast_obj(None, root=root)
+        assert got["blob"] == _obj_payload()["blob"]
+        assert np.array_equal(got["big_ints"], _obj_payload()["big_ints"])
+        for _ in procs:
+            rank, ok = q.get(timeout=60)
+            assert ok, f"rank {rank} payload mismatch"
+        for p in procs:
+            p.join(timeout=60)
+            assert p.exitcode == 0
+    finally:
+        owner.close(unlink=True)
+
+
 def test_single_rank_noop():
     from superlu_dist_tpu.parallel.treecomm import TreeComm
     name = f"/slu_tree_solo_{os.getpid()}"
