@@ -88,8 +88,21 @@ def _run_mode(mode, parts, nranks):
         p.start()
     rows = []
     try:
-        for _ in procs:
-            rows.append(q.get(timeout=3600))
+        import queue
+        deadline = time.monotonic() + 3600
+        while len(rows) < nranks:
+            try:
+                rows.append(q.get(timeout=5))
+                continue
+            except queue.Empty:
+                pass
+            dead = [p.pid for p in procs if p.exitcode not in (None, 0)]
+            if dead:
+                raise RuntimeError(
+                    f"rank process(es) {dead} died before reporting")
+            if time.monotonic() > deadline:
+                raise TimeoutError("measurement ranks still running at "
+                                   "the 3600 s deadline")
     finally:
         for p in procs:
             p.join(timeout=60)

@@ -338,11 +338,10 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     with stats.timer("FACT"):
         if str(dtype) == "df64":
             # emulated-double factorization for f32-only hardware (true
-            # ~2^-48 factors; SURVEY.md §7 hard-part 1); host f64 factors
-            # come back, so the standard solve path applies
-            if np.issubdtype(np.asarray(bvals).dtype, np.complexfloating):
-                raise SuperLUError("factor_dtype='df64' supports real "
-                                   "matrices only (use complex128 on CPU)")
+            # ~2^-48 factors; SURVEY.md §7 hard-part 1), real AND complex
+            # (zdf64, the pzgstrf twin — SRC/pzgstrf.c:243); host
+            # f64/c128 factors come back, so the standard solve path
+            # applies
             from superlu_dist_tpu.numeric.df64_factor import (
                 df64_numeric_factorize)
             numeric = df64_numeric_factorize(

@@ -100,10 +100,13 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     sharded across the processes' devices and NO process ever
     materializes them (the reference's defining NR_loc-in,
     distributed-factors-out property, SRC/pdgssvx.c:505 /
-    pddistribute.c:322), and no non-root process ever holds the global
-    graph (the psymbfact memory-wall property, SRC/psymbfact.c:228-242).
-    Without `grid`, the single-host fallback gathers to root and factors
-    there (refinement stays distributed).
+    pddistribute.c:322).  No non-root process assembles the global
+    matrix or runs the analysis — it receives only the analysis products
+    (plan/symbolic index maps + permuted values, O(nnz) data, measured
+    ~2x lower peak host memory and wall time at n=110,592:
+    docs/mesh_analysis_4proc_n110592.json; the psymbfact direction,
+    SRC/psymbfact.c:228-242).  Without `grid`, the single-host fallback
+    gathers to root and factors there (refinement stays distributed).
 
     `lu_out`: optional dict; on return, lu_out["lu"] holds this rank's
     LUFactorization handle (the reference's caller-owned LUstruct — on
@@ -229,11 +232,21 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
     else:
         a_root = gather_distributed(tc, a_loc, root=0)
         blob = None
+        sym_keep = None
         if tc.rank == 0:
             lu, bvals, _ = analyze(opts0, a_root, stats=stats)
-            lu.a = None            # O(nnz(A)) — stays on root
+            # the global matrix and the symmetrized-pattern copies stay
+            # on root (the pattern arrays only serve future SamePattern
+            # reuse checks there); non-root receives the analysis
+            # PRODUCTS — plan/symbolic index maps + permuted values,
+            # O(nnz) data but no global CSR and no analysis work
+            lu.a = None
+            sym_keep = (lu.a_sym_indptr, lu.a_sym_indices)
+            lu.a_sym_indptr = lu.a_sym_indices = None
             blob = (lu, bvals)
         lu, bvals = tc.bcast_obj(blob, root=0)
+        if tc.rank == 0:
+            lu.a_sym_indptr, lu.a_sym_indices = sym_keep
     info_r = factorize_numeric(lu, bvals, stats, grid=grid)
     if lu_out is not None:
         lu_out["lu"] = lu

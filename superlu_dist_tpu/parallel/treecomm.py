@@ -156,11 +156,14 @@ class TreeComm:
         """Broadcast a picklable object from root (non-root passes None).
         Carries the analysis artifacts of the distributed-factors tier —
         the role the reference's MPI_Bcast of perm vectors plays
-        (pdgssvx.c:816-831), widened to whole symbolic/plan structures."""
+        (pdgssvx.c:816-831), widened to whole symbolic/plan structures.
+        The root gets its ORIGINAL object back (no redundant second copy
+        through pickle on the rank whose memory matters most)."""
         import pickle
         blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL) \
             if self.rank == root else None
-        return pickle.loads(self.bcast_bytes(blob, root=root))
+        data = self.bcast_bytes(blob, root=root)
+        return obj if self.rank == root else pickle.loads(data)
 
     def close(self, unlink: bool | None = None):
         if self._h:
