@@ -59,11 +59,21 @@ def main():
     rows = np.repeat(np.arange(n), np.diff(a0.indptr))
     vals = a0.data.copy()
     vals[rows == a0.indices] -= sigma
+    rng = np.random.default_rng(0)
+    is_complex = os.environ.get("DF64S_COMPLEX", "0") == "1"
+    if is_complex:
+        # unitary diagonal similarity D A D* (D = diag(e^{iθ})): the
+        # spectrum — hence κ — is exactly preserved while every entry
+        # becomes genuinely complex; the zdf64 twin of the experiment
+        # (pzgstrf twin discipline, SRC/pzgstrf.c:243)
+        d = np.exp(1j * rng.uniform(0.0, 2 * np.pi, n))
+        vals = vals * d[rows] * np.conj(d[a0.indices])
     a = fmts.SparseCSR(n, n, a0.indptr, a0.indices, vals)
-    xt = np.random.default_rng(0).standard_normal(n)
+    xt = rng.standard_normal(n) + (1j * rng.standard_normal(n)
+                                   if is_complex else 0.0)
     b = a.matvec(xt)
-    print(f"[df64s] n={n} sigma={sigma:.6f} target kappa={kappa:.1e}",
-          file=sys.stderr, flush=True)
+    print(f"[df64s] n={n} sigma={sigma:.6f} target kappa={kappa:.1e} "
+          f"complex={is_complex}", file=sys.stderr, flush=True)
 
     t0 = time.perf_counter()
     x32, _, _, i32 = slu.gssvx(Options(factor_dtype="float32"), a, b)
@@ -82,8 +92,10 @@ def main():
     print(f"[df64s] df64 {tdf:.1f}s forward_err={edf:.2e} resid={rdf:.2e}",
           file=sys.stderr, flush=True)
 
-    rec = {"experiment": "df64-vs-f32IR at kappa",
-           "matrix": f"poisson3d nx={nx} shifted near lambda_min",
+    rec = {"experiment": ("zdf64-vs-c64IR at kappa" if is_complex
+                          else "df64-vs-f32IR at kappa"),
+           "matrix": f"poisson3d nx={nx} shifted near lambda_min"
+                     + (" (unitary-rotated complex)" if is_complex else ""),
            "n": n, "kappa_target": kappa,
            "f32_ir_forward_error": e32, "df64_forward_error": edf,
            "df64_residual": rdf, "info": [i32, idf],
@@ -99,6 +111,8 @@ def main():
         rec["pool_entries_total_per_word"] = int(ludf.plan.pool_size)
         rec["pool_share_per_device_per_word"] = int(share)
         suffix = f"_mesh{mesh_spec}"
+    if is_complex:
+        suffix += "_z"
     with open(os.path.join(REPO, "docs", f"df64_scale_n{n}{suffix}.json"),
               "w") as f:
         json.dump(rec, f, indent=1)

@@ -1,5 +1,5 @@
 """Double-float (df64) numeric factorization — true ~2^-48 factors on
-hardware without an f64 MXU.
+hardware without an f64 MXU, real AND complex.
 
 This closes SURVEY.md §7 hard-part 1 for the systems the default
 mixed-precision path cannot handle: with f32 factors, iterative
@@ -16,9 +16,16 @@ pivot columns of the WHOLE front — each step is a full-front exact
 rank-1 update, so after w steps the trailing block IS the Schur
 complement (no separate triangular solves needed; this trades ~3x
 flops for having exactly one df64 kernel).  Factored panels are pulled
-to host and recombined into exact float64 arrays (hi + lo), so every
+to host and recombined into exact float64/complex128 arrays, so every
 downstream consumer — host triangular solves, transpose solves,
-refinement, GetDiagU — runs the standard f64 path unchanged.
+refinement, GetDiagU — runs the standard f64/c128 path unchanged.
+
+Precision scheme: ONE generic kernel over a small "component algebra" —
+real df64 values are (hi, lo) f32 pairs, complex zdf64 values are
+(re_hi, re_lo, im_hi, im_lo) quadruples (ops/df64.py zdf64_*).  This is
+the templating-by-dtype answer to the reference's hand-expanded d/z twin
+files (pdgstrf.c / pzgstrf.c:243): the scatter/assembly machinery is
+component-blind, only the scalar arithmetic dispatches.
 
 Accuracy caveat (see ops/df64.py header): XLA:CPU's instruction fusion
 breaks the error-free transforms; on the CPU backend run with
@@ -37,86 +44,166 @@ import jax.numpy as jnp
 from superlu_dist_tpu.numeric.factor import NumericFactorization
 from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.ops.df64 import (df64_add, df64_div, df64_from_f64,
-                                       df64_mul, df64_neg, df64_sub)
+                                       df64_mul, df64_sub, df64_to_f64,
+                                       zdf64_add, zdf64_div,
+                                       zdf64_from_c128, zdf64_mul,
+                                       zdf64_sub, zdf64_to_c128)
 
 
-def _fix_pivot_df64(piv, thresh):
-    """GESP tiny-pivot replacement on the df64 pivot (magnitude test and
-    replacement value act on the hi word — the reference's thresh
-    semantics, pdgstrf2.c:218-232)."""
-    ph, pl = piv
-    ap = jnp.abs(ph)
-    safe = jnp.where(ap == 0, jnp.ones_like(ph), ap)
-    unit = jnp.where(ap == 0, jnp.ones_like(ph), ph / safe)
+class _RealDf64:
+    """Real df64 algebra: components (hi, lo)."""
+
+    name = "df64"
+    ncomp = 2
+    out_dtype = np.float64
+    add = staticmethod(lambda x, y: df64_add((x[0], x[1]), (y[0], y[1])))
+    sub = staticmethod(lambda x, y: df64_sub((x[0], x[1]), (y[0], y[1])))
+    mul = staticmethod(lambda x, y: df64_mul((x[0], x[1]), (y[0], y[1])))
+    div = staticmethod(lambda x, y: df64_div((x[0], x[1]), (y[0], y[1])))
+
+    @staticmethod
+    def mag_hi(x):
+        """Pivot magnitude from the hi word(s) — the GESP threshold test
+        semantics (pdgstrf2.c:218-232)."""
+        return jnp.abs(x[0])
+
+    @staticmethod
+    def unit_hi(x, safe):
+        """Unit direction (phase) with zero lo words; |x|==0 -> 1."""
+        return (jnp.where(safe == 0, jnp.ones_like(x[0]), x[0] / safe),
+                jnp.zeros_like(x[1]))
+
+    @staticmethod
+    def split(values):
+        return df64_from_f64(np.asarray(values, np.float64))
+
+    @staticmethod
+    def join(comps):
+        return df64_to_f64(comps)
+
+
+class _ComplexDf64:
+    """Complex zdf64 algebra: components (re_hi, re_lo, im_hi, im_lo) —
+    the pzgstrf twin discipline without twin files."""
+
+    name = "zdf64"
+    ncomp = 4
+    out_dtype = np.complex128
+    add = staticmethod(zdf64_add)
+    sub = staticmethod(zdf64_sub)
+    mul = staticmethod(zdf64_mul)
+    div = staticmethod(zdf64_div)
+
+    @staticmethod
+    def mag_hi(x):
+        return jnp.sqrt(x[0] * x[0] + x[2] * x[2])
+
+    @staticmethod
+    def unit_hi(x, safe):
+        s = jnp.where(safe == 0, jnp.ones_like(safe), safe)
+        return (jnp.where(safe == 0, jnp.ones_like(x[0]), x[0] / s),
+                jnp.zeros_like(x[1]),
+                jnp.where(safe == 0, jnp.zeros_like(x[2]), x[2] / s),
+                jnp.zeros_like(x[3]))
+
+    @staticmethod
+    def split(values):
+        return zdf64_from_c128(values)
+
+    @staticmethod
+    def join(comps):
+        return zdf64_to_c128(comps)
+
+
+_ALGEBRAS = {"df64": _RealDf64, "zdf64": _ComplexDf64}
+
+
+def _fix_pivot_df64(piv, thresh, alg=_RealDf64):
+    """GESP tiny-pivot replacement on the df64 pivot: magnitude test on
+    the hi word(s), replacement phase(piv)·thresh with zeroed lo words
+    (the reference's thresh semantics, pdgstrf2.c:218-232)."""
+    ap = alg.mag_hi(piv)
+    safe = jnp.where(ap == 0, jnp.ones_like(ap), ap)
+    unit = alg.unit_hi(piv, jnp.where(ap == 0, jnp.zeros_like(ap), safe))
     tiny = ap < thresh
-    return ((jnp.where(tiny, unit * thresh, ph),
-             jnp.where(tiny, jnp.zeros_like(pl), pl)),
-            tiny.astype(jnp.int32))
+    out = tuple(jnp.where(tiny, u * thresh, p)
+                for u, p in zip(unit, piv))
+    return out, tiny.astype(jnp.int32)
 
 
-def df64_partial_front_factor(fh, fl, thresh, w):
+def df64_partial_front_factor(*args):
     """Masked partial LU of one (m, m) df64 front over its first w pivot
     columns.  Full-front rank-1 updates: after the loop the leading w
     rows/cols hold packed L\\U, L21, U12 and the trailing block holds
-    the Schur complement.  Returns ((fh, fl), tiny_flags (w,))."""
-    m = fh.shape[0]
+    the Schur complement.
+
+    Signatures: (fh, fl, thresh, w) for real (back-compat), or
+    (comps_tuple, thresh, w, alg) generically; returns (comps, tiny
+    flags (w,))."""
+    if len(args) == 4 and not isinstance(args[0], tuple):
+        fh, fl, thresh, w = args
+        return _partial_front_factor((fh, fl), thresh, w, _RealDf64)
+    return _partial_front_factor(*args)
+
+
+def _partial_front_factor(comps, thresh, w, alg):
+    m = comps[0].shape[0]
     idx = jnp.arange(m)
 
     def step(i, carry):
-        (ah, al), flags = carry
+        cs, flags = carry
         sel = idx == i
-        e = sel.astype(ah.dtype)
+        e = sel.astype(cs[0].dtype)
         # single-element masks: the sums select exactly one entry, so
         # they are exact in f32 (every other term is a true zero)
-        row = (jnp.sum(ah * e[:, None], axis=0),
-               jnp.sum(al * e[:, None], axis=0))
-        col = (jnp.sum(ah * e[None, :], axis=1),
-               jnp.sum(al * e[None, :], axis=1))
-        piv = (jnp.sum(row[0] * e), jnp.sum(row[1] * e))
-        piv, tiny = _fix_pivot_df64(piv, thresh)
+        row = tuple(jnp.sum(c * e[:, None], axis=0) for c in cs)
+        col = tuple(jnp.sum(c * e[None, :], axis=1) for c in cs)
+        piv = tuple(jnp.sum(r * e) for r in row)
+        piv, tiny = _fix_pivot_df64(piv, thresh, alg)
         below = idx > i
-        l = df64_div(col, (piv[0][None], piv[1][None]))
-        l = (jnp.where(below, l[0], 0.0), jnp.where(below, l[1], 0.0))
-        u = (jnp.where(below, row[0], 0.0), jnp.where(below, row[1], 0.0))
-        upd = df64_mul((l[0][:, None], l[1][:, None]),
-                       (u[0][None, :], u[1][None, :]))
-        ah, al = df64_sub((ah, al), upd)
+        l = alg.div(col, tuple(p[None] for p in piv))
+        l = tuple(jnp.where(below, c, 0.0) for c in l)
+        u = tuple(jnp.where(below, r, 0.0) for r in row)
+        upd = alg.mul(tuple(c[:, None] for c in l),
+                      tuple(r[None, :] for r in u))
+        cs = alg.sub(cs, upd)
         # write multipliers + fixed pivot into column i by EXACT masked
         # select (0/1 products and disjoint-support sums round nothing;
         # the f32 path's delta-add trick would round the df64 low word
         # at the f32 ulp and collapse the factorization to f32 accuracy)
         above = idx < i
-        new_col = (jnp.where(below, l[0], 0.0)
-                   + jnp.where(above, col[0], 0.0) + piv[0] * e,
-                   jnp.where(below, l[1], 0.0)
-                   + jnp.where(above, col[1], 0.0) + piv[1] * e)
+        new_col = tuple(jnp.where(below, lc, 0.0)
+                        + jnp.where(above, cc, 0.0) + pv * e
+                        for lc, cc, pv in zip(l, col, piv))
         keep = (1.0 - e)[None, :]
-        ah = ah * keep + new_col[0][:, None] * e[None, :]
-        al = al * keep + new_col[1][:, None] * e[None, :]
-        return (ah, al), flags + tiny * sel.astype(jnp.int32)
+        cs = tuple(c * keep + nc[:, None] * e[None, :]
+                   for c, nc in zip(cs, new_col))
+        return cs, flags + tiny * sel.astype(jnp.int32)
 
-    (fh, fl), flags = jax.lax.fori_loop(
-        0, w, step, ((fh, fl), jnp.zeros(m, jnp.int32)))
-    return (fh, fl), flags[:w]
+    comps, flags = jax.lax.fori_loop(
+        0, w, step, (comps, jnp.zeros(m, jnp.int32)))
+    return comps, flags[:w]
 
 
 @functools.lru_cache(maxsize=None)
 def _df64_group_kernel(dims, child_shapes, pool_size, mesh=None,
-                       pool_partition=False):
-    """One (level, bucket) group in df64: assemble (hi, lo), factor,
-    scatter the Schur block into the (hi, lo) pools.
+                       pool_partition=False, alg_name="df64"):
+    """One (level, bucket) group in df64/zdf64: assemble the component
+    arrays, factor, scatter the Schur block into the component pools.
 
     With a mesh, the batch dimension shards over "snode" (the vmapped
     elimination is per-front independent, so sharding cannot perturb the
     error-free transforms).  The "panel" axis is idle here — splitting
     the masked elimination's minor dims would turn every per-step
     row/column reduction into a collective.  pool_partition shards the
-    hi/lo Schur pools 1-D across ALL mesh devices (same layout as the
-    f32 path, factor.pool_spec): per-chip pool memory divides by the
+    component Schur pools 1-D across ALL mesh devices (same layout as
+    the f32 path, factor.pool_spec): per-chip pool memory divides by the
     device count, so the df64 tier reaches the same n≈1M class as f32.
     Sharding a scatter/gather cannot perturb the error-free transforms
     either — each pool entry still receives exactly the same summands in
     the same order."""
+    alg = _ALGEBRAS[alg_name]
+    nc = alg.ncomp
     batch, m, w, u = dims
     front_sharding = pool_sharding = None
     if mesh is not None:
@@ -125,19 +212,17 @@ def _df64_group_kernel(dims, child_shapes, pool_size, mesh=None,
         front_sharding = NamedSharding(mesh, P("snode", None, None))
         pool_sharding = pool_spec(mesh, pool_partition)
 
-    def step(avals_h, avals_l, pool_h, pool_l, thresh,
-             a_slot, a_flat, a_src, ws, off, *child_arr):
+    def step(avals, pools, thresh, a_slot, a_flat, a_src, ws, off,
+             *child_arr):
         k = jnp.arange(m)
         diag = ((k[None, :] >= ws[:, None]) & (k[None, :] < w)).astype(
             jnp.float32)
-        fh = jnp.zeros((batch, m * m), jnp.float32)
-        fh = fh.at[:, k * m + k].add(diag)         # identity padding (hi)
-        fl = jnp.zeros((batch, m * m), jnp.float32)
+        fs = [jnp.zeros((batch, m * m), jnp.float32) for _ in range(nc)]
+        fs[0] = fs[0].at[:, k * m + k].add(diag)   # identity padding
         if a_src.shape[0]:
-            vh = avals_h.at[a_src].get(mode="fill", fill_value=0)
-            vl = avals_l.at[a_src].get(mode="fill", fill_value=0)
-            fh = fh.at[(a_slot, a_flat)].add(vh, mode="drop")
-            fl = fl.at[(a_slot, a_flat)].add(vl, mode="drop")
+            for c in range(nc):
+                v = avals[c].at[a_src].get(mode="fill", fill_value=0)
+                fs[c] = fs[c].at[(a_slot, a_flat)].add(v, mode="drop")
         children = [(ub, child_arr[3 * i], child_arr[3 * i + 1],
                      child_arr[3 * i + 2])
                     for i, (ub, _) in enumerate(child_shapes)]
@@ -146,53 +231,52 @@ def _df64_group_kernel(dims, child_shapes, pool_size, mesh=None,
         # factorization at f32 accuracy.  The caller pre-partitions the
         # children into passes with at most ONE child per batch slot
         # (child_shapes carries one entry per collision-free pass), so
-        # each pass scatters into a fresh zero pair and is folded into
-        # the front with an exact df64_add.
+        # each pass scatters into fresh zero components and is folded
+        # into the front with an exact df64 add.
         for (ub, child_off, child_slot, rel) in children:
             src = child_off[:, None] + jnp.arange(ub * ub)
-            vh = pool_h.at[src].get(mode="fill", fill_value=0)
-            vl = pool_l.at[src].get(mode="fill", fill_value=0)
             ri, rj = rel[:, :, None], rel[:, None, :]
             dst = jnp.where((ri >= m) | (rj >= m), m * m,
                             ri * m + rj).reshape(-1, ub * ub)
-            ph = jnp.zeros((batch, m * m), jnp.float32)
-            pl = jnp.zeros((batch, m * m), jnp.float32)
-            ph = ph.at[(child_slot[:, None], dst)].add(vh, mode="drop")
-            pl = pl.at[(child_slot[:, None], dst)].add(vl, mode="drop")
-            fh, fl = df64_add((fh, fl), (ph, pl))
-        fh = fh.reshape(batch, m, m)
-        fl = fl.reshape(batch, m, m)
+            ps = []
+            for c in range(nc):
+                v = pools[c].at[src].get(mode="fill", fill_value=0)
+                p = jnp.zeros((batch, m * m), jnp.float32)
+                ps.append(p.at[(child_slot[:, None], dst)].add(
+                    v, mode="drop"))
+            fs = list(alg.add(tuple(fs), tuple(ps)))
+        fs = [f.reshape(batch, m, m) for f in fs]
         if front_sharding is not None:
-            fh = jax.lax.with_sharding_constraint(fh, front_sharding)
-            fl = jax.lax.with_sharding_constraint(fl, front_sharding)
-            pool_h = jax.lax.with_sharding_constraint(pool_h, pool_sharding)
-            pool_l = jax.lax.with_sharding_constraint(pool_l, pool_sharding)
-        (fh, fl), counts = jax.vmap(
-            lambda h, lo: df64_partial_front_factor(h, lo, thresh, w))(fh, fl)
+            fs = [jax.lax.with_sharding_constraint(f, front_sharding)
+                  for f in fs]
+            pools = tuple(jax.lax.with_sharding_constraint(p, pool_sharding)
+                          for p in pools)
+        fs, counts = jax.vmap(
+            lambda *cs: _partial_front_factor(cs, thresh, w, alg))(*fs)
         tiny = jnp.sum(jnp.where(jnp.arange(w)[None, :] < ws[:, None],
                                  counts, 0))
         if u > 0:
-            sh = fh[:, w:, w:].reshape(batch, u * u)
-            sl = fl[:, w:, w:].reshape(batch, u * u)
             dst = off[:, None] + jnp.arange(u * u)
-            pool_h = pool_h.at[dst].set(sh, mode="drop")
-            pool_l = pool_l.at[dst].set(sl, mode="drop")
-        lp = (fh[:, :, :w], fl[:, :, :w])
-        up = (fh[:, :w, w:], fl[:, :w, w:])
+            pools = tuple(
+                p.at[dst].set(f[:, w:, w:].reshape(batch, u * u),
+                              mode="drop")
+                for p, f in zip(pools, fs))
+        lp = tuple(f[:, :, :w] for f in fs)
+        up = tuple(f[:, :w, w:] for f in fs)
         if pool_sharding is not None:
             # pin the linearly-threaded pools replicated on OUTPUT too, so
             # sharding propagation from the snode-sharded fronts cannot
             # hand the next group a resharded pool (per-group transfers /
             # jit cache misses)
-            pool_h = jax.lax.with_sharding_constraint(pool_h, pool_sharding)
-            pool_l = jax.lax.with_sharding_constraint(pool_l, pool_sharding)
-        return lp, up, pool_h, pool_l, tiny
+            pools = tuple(jax.lax.with_sharding_constraint(p, pool_sharding)
+                          for p in pools)
+        return lp, up, pools, tiny
 
-    return jax.jit(step, donate_argnums=(2, 3))
+    return jax.jit(step, donate_argnums=(1,))
 
 
 class Df64Executor:
-    """Cached df64 executor for a plan (the SamePattern reuse tier).
+    """Cached df64/zdf64 executor for a plan (the SamePattern reuse tier).
 
     Mirrors stream.StreamExecutor's discipline: all host-side index prep
     (bucket padding, collision-free child-pass partitioning) runs ONCE in
@@ -203,12 +287,13 @@ class Df64Executor:
     LUstruct across SamePattern calls, SRC/pdgssvx.c:1132-1166)."""
 
     def __init__(self, plan: FactorPlan, mesh=None,
-                 pool_partition: bool = False):
+                 pool_partition: bool = False, alg=_RealDf64):
         from superlu_dist_tpu.numeric.stream import _bucket_len, _pad_to
 
         plan.check_index_width()
         self.plan = plan
         self.mesh = mesh
+        self.alg = alg
         self.pool_partition = bool(pool_partition and mesh is not None)
         self.n_avals = len(plan.pattern_indices)
         self._groups = []     # (grp, a-arrays, child_arrs, kernel)
@@ -226,7 +311,7 @@ class Df64Executor:
                 # partition this child group into passes with at most one
                 # child per batch slot, so each pass's scatter is
                 # collision-free and the pass results combine by exact
-                # df64_add (see _df64_group_kernel)
+                # df64 add (see _df64_group_kernel)
                 passes = []          # list of lists of child indices
                 for j, slot in enumerate(np.asarray(cs.child_slot)):
                     for p in passes:
@@ -250,48 +335,49 @@ class Df64Executor:
                     child_shapes.append((cs.ub, c))
             kern = _df64_group_kernel((b, grp.m, grp.w, grp.u),
                                       tuple(child_shapes), plan.pool_size,
-                                      mesh, self.pool_partition)
+                                      mesh, self.pool_partition, alg.name)
             self._groups.append((grp, a, child_arrs, kern))
 
-    def __call__(self, avals_h, avals_l, thresh):
-        """Run the factorization; returns (fronts [host f64], tiny)."""
-        pool_h = jnp.zeros(self.plan.pool_size, jnp.float32)
-        pool_l = jnp.zeros(self.plan.pool_size, jnp.float32)
+    def __call__(self, avals, thresh):
+        """Run the factorization on component-split values; returns
+        (fronts [host f64/c128], tiny).  `avals` is the alg.ncomp tuple
+        from alg.split()."""
+        alg = self.alg
+        pools = tuple(jnp.zeros(self.plan.pool_size, jnp.float32)
+                      for _ in range(alg.ncomp))
         if self.mesh is not None:
             # commit the pools to their mesh layout up front (partitioned
             # or replicated) so the first kernel starts from the right
             # sharding instead of inserting a reshard
             from superlu_dist_tpu.numeric.factor import pool_spec
             psh = pool_spec(self.mesh, self.pool_partition)
-            pool_h = jax.device_put(pool_h, psh)
-            pool_l = jax.device_put(pool_l, psh)
+            pools = tuple(jax.device_put(p, psh) for p in pools)
         fronts = []
         tiny = 0
         for grp, a, child_arrs, kern in self._groups:
-            lp, up, pool_h, pool_l, t = kern(avals_h, avals_l, pool_h,
-                                             pool_l, thresh, *a, *child_arrs)
+            lp, up, pools, t = kern(avals, pools, thresh, *a, *child_arrs)
             tiny += int(t)
-            # recombine on host to exact f64; trim batch padding
-            lp64 = (np.asarray(lp[0], np.float64)
-                    + np.asarray(lp[1], np.float64))[:grp.batch]
-            up64 = (np.asarray(up[0], np.float64)
-                    + np.asarray(up[1], np.float64))[:grp.batch]
-            fronts.append((lp64, up64))
+            # recombine on host to exact f64/c128; trim batch padding
+            fronts.append((alg.join(lp)[:grp.batch],
+                           alg.join(up)[:grp.batch]))
         return fronts, tiny
 
 
 def get_df64_executor(plan: FactorPlan, mesh=None,
-                      pool_partition: bool = False) -> Df64Executor:
+                      pool_partition: bool = False,
+                      alg=_RealDf64) -> Df64Executor:
     """Df64Executor cached on the plan (same cache dict as
     factor.get_executor, keyed distinctly)."""
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
-    key = ("df64", "df64", mesh, bool(pool_partition and mesh is not None))
+    key = (alg.name, alg.name, mesh,
+           bool(pool_partition and mesh is not None))
     ex = cache.get(key)
     if ex is None:
         ex = cache[key] = Df64Executor(plan, mesh=mesh,
-                                       pool_partition=pool_partition)
+                                       pool_partition=pool_partition,
+                                       alg=alg)
     return ex
 
 
@@ -301,24 +387,30 @@ def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                            mesh=None,
                            pool_partition: bool = False
                            ) -> NumericFactorization:
-    """Factor with ~f64 accuracy on f32-only hardware.
+    """Factor with ~f64 accuracy on f32-only hardware (real or complex).
 
-    values must be float64 (split exactly into df64 pairs host-side).
-    The GESP threshold uses the f64 epsilon — these factors genuinely
-    carry ~48-bit significands.  Output fronts are host float64 arrays
-    (hi + lo recombined), so the standard host solve/refine path runs
-    unchanged; `on_host` is True by construction.
+    Real float64 values split exactly into df64 pairs host-side; complex
+    values into zdf64 quadruples (the pzgstrf z-twin capability,
+    SRC/pzgstrf.c:243).  The GESP threshold uses the f64 epsilon — these
+    factors genuinely carry ~48-bit significands.  Output fronts are
+    host float64/complex128 arrays (components recombined), so the
+    standard host solve/refine path runs unchanged; `on_host` is True by
+    construction.
     """
-    avals_h, avals_l = df64_from_f64(np.asarray(pattern_values, np.float64))
+    vals = np.asarray(pattern_values)
+    alg = (_ComplexDf64 if np.issubdtype(vals.dtype, np.complexfloating)
+           else _RealDf64)
+    avals = alg.split(vals)
     eps64 = float(np.finfo(np.float64).eps)
     thresh = jnp.asarray(np.sqrt(eps64) * max(float(anorm), 1e-300)
                          if replace_tiny else 0.0, jnp.float32)
-    ex = get_df64_executor(plan, mesh=mesh, pool_partition=pool_partition)
-    fronts, tiny = ex(avals_h, avals_l, thresh)
+    ex = get_df64_executor(plan, mesh=mesh, pool_partition=pool_partition,
+                           alg=alg)
+    fronts, tiny = ex(avals, thresh)
     finite, info_col = (True, -1)
     if not replace_tiny:
         from superlu_dist_tpu.numeric.factor import localize_singularity
         finite, info_col = localize_singularity(plan, fronts)
     return NumericFactorization(plan=plan, fronts=fronts, tiny_pivots=tiny,
-                                dtype=np.dtype(np.float64),
+                                dtype=np.dtype(alg.out_dtype),
                                 finite=finite, info_col=info_col)

@@ -145,6 +145,81 @@ def df64_to_f64(x):
     return np.asarray(hi, dtype=np.float64) + np.asarray(lo, np.float64)
 
 
+# ---- complex double-float ("zdf64"): re/im each an (hi, lo) pair ---------
+# The z-twin discipline of the reference (pzgstrf.c:243 et al.) without
+# twin files: a complex value is the 4-tuple (re_hi, re_lo, im_hi, im_lo)
+# and the arithmetic is composed from the real error-free transforms.
+
+def zdf64_add(x, y):
+    r = df64_add((x[0], x[1]), (y[0], y[1]))
+    i = df64_add((x[2], x[3]), (y[2], y[3]))
+    return (*r, *i)
+
+
+def zdf64_sub(x, y):
+    r = df64_sub((x[0], x[1]), (y[0], y[1]))
+    i = df64_sub((x[2], x[3]), (y[2], y[3]))
+    return (*r, *i)
+
+
+def zdf64_neg(x):
+    return (-x[0], -x[1], -x[2], -x[3])
+
+
+def zdf64_mul(x, y):
+    """(a+bi)(c+di) = (ac - bd) + (ad + bc)i, every product/sum in df64."""
+    a, b = (x[0], x[1]), (x[2], x[3])
+    c, d = (y[0], y[1]), (y[2], y[3])
+    re = df64_sub(df64_mul(a, c), df64_mul(b, d))
+    im = df64_add(df64_mul(a, d), df64_mul(b, c))
+    return (*re, *im)
+
+
+def zdf64_div(x, y):
+    """Scaled complex division — Smith's algorithm in df64 components.
+
+    The naive x·conj(y)/|y|² squares the denominator magnitude and
+    overflows/underflows the f32 hi words at ~1.9e19 / ~1e-19, silently
+    halving the usable exponent range; Smith's form keeps every
+    intermediate within a constant factor of the operands (the
+    reference's scaled slud_z_div discipline, SRC/dcomplex_dist.c).
+    Branchless: operands are component-swapped so the larger-magnitude
+    denominator part leads, and the imaginary part's sign is fixed up.
+    """
+    swap = jnp.abs(y[2]) > jnp.abs(y[0])
+
+    def sel(p, q):
+        return tuple(jnp.where(swap, pi, qi) for pi, qi in zip(p, q))
+
+    c = sel((y[2], y[3]), (y[0], y[1]))     # larger |.| denominator part
+    d = sel((y[0], y[1]), (y[2], y[3]))
+    a = sel((x[2], x[3]), (x[0], x[1]))
+    b = sel((x[0], x[1]), (x[2], x[3]))
+    t = df64_div(d, c)                      # |t| <= 1 by construction
+    den = df64_add(c, df64_mul(d, t))
+    re = df64_div(df64_add(a, df64_mul(b, t)), den)
+    im = df64_div(df64_sub(b, df64_mul(a, t)), den)
+    im = tuple(jnp.where(swap, -i, i) for i in im)
+    return (*re, *im)
+
+
+def zdf64_from_c128(a):
+    """Split a complex128 array into the (re_hi, re_lo, im_hi, im_lo)
+    f32 quadruple (exact host-side splits, see df64_from_f64)."""
+    import numpy as np
+    a = np.asarray(a, dtype=np.complex128)
+    rh, rl = df64_from_f64(a.real)
+    ih, il = df64_from_f64(a.imag)
+    return rh, rl, ih, il
+
+
+def zdf64_to_c128(x):
+    """Recombine to host complex128 (exact)."""
+    import numpy as np
+    return (df64_to_f64((x[0], x[1]))
+            + 1j * df64_to_f64((x[2], x[3]))).astype(np.complex128)
+
+
 def df64_matmul(ah, al, bh, bl):
     """df64 GEMM: (m,k) x (k,n) pairs -> (m,n) pair, ~2^-48 accurate.
 

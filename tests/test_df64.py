@@ -440,3 +440,79 @@ print(f"HIKAPPA OK f32_err={e32:.2e} df64_err={edf:.2e} df64_resid={rdf:.2e}")
                          capture_output=True, text=True)
     assert res.returncode == 0, (res.stdout, res.stderr)
     assert "HIKAPPA OK" in res.stdout
+
+
+def test_zdf64_ops_eager_accuracy():
+    """Complex df64 algebra (zdf64_*): mul/div/add reach ~2^-48 relative
+    accuracy in eager mode (exact EFTs), far beyond c64's 2^-24."""
+    import numpy as np
+    from superlu_dist_tpu.ops.df64 import (zdf64_add, zdf64_div, zdf64_mul,
+                                           zdf64_from_c128, zdf64_to_c128)
+    rng = np.random.default_rng(11)
+    a = (rng.standard_normal(256) + 1j * rng.standard_normal(256)) \
+        * np.exp(rng.uniform(-8, 8, 256))
+    b = (rng.standard_normal(256) + 1j * rng.standard_normal(256)) \
+        * np.exp(rng.uniform(-8, 8, 256))
+    za, zb = zdf64_from_c128(a), zdf64_from_c128(b)
+    # split roundtrip: ~2^-48 relative (the lo word is itself rounded
+    # to f32, so the pair carries ~48 significant bits, not all 53)
+    rel0 = np.abs(zdf64_to_c128(za) - a) / np.abs(a)
+    assert rel0.max() < 1e-13, rel0.max()
+    for op, ref in ((zdf64_add, a + b), (zdf64_mul, a * b),
+                    (zdf64_div, a / b)):
+        got = zdf64_to_c128(op(za, zb))
+        rel = np.abs(got - ref) / np.maximum(np.abs(ref), 1e-300)
+        assert rel.max() < 1e-13, (op.__name__, rel.max())
+
+
+def test_zdf64_complex_factorization_end_to_end():
+    """factor_dtype="df64" with COMPLEX input — the zdf64 twin of the
+    reference's pzgstrf (SRC/pzgstrf.c:243), via the component-algebra
+    template instead of twin files.  Ill-conditioned complex system
+    (geometric row scaling, kappa ~ 1e7), no equilibration, no
+    refinement, x64 OFF: the c64 factors bottom out ~1e-8 while zdf64
+    reaches f64-class residuals.  Subprocess with XLA:CPU fusion passes
+    disabled (ops/df64.py caveat)."""
+    import os
+    import subprocess
+    import sys
+    code = """
+import os
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_disable_hlo_passes=fusion,cpu-instruction-fusion"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import poisson2d
+import superlu_dist_tpu.sparse.formats as fmts
+from superlu_dist_tpu.utils.options import Options, IterRefine
+
+a0 = poisson2d(8)
+n = a0.n_rows
+s = np.logspace(0, 7, n)
+rows = np.repeat(np.arange(n), np.diff(a0.indptr))
+theta = np.random.default_rng(3).uniform(0, 2 * np.pi, a0.nnz)
+vals = a0.data * s[rows] * np.exp(1j * theta)
+a = fmts.SparseCSR(n, n, a0.indptr, a0.indices, vals)
+rng = np.random.default_rng(0)
+xt = rng.standard_normal(n) + 1j * rng.standard_normal(n)
+b = a.matvec(xt)
+opt = dict(equil=False, iter_refine=IterRefine.NOREFINE)
+x32, _, _, i32 = slu.gssvx(Options(factor_dtype="float32", **opt), a, b)
+r32 = np.linalg.norm(b - a.matvec(x32)) / np.linalg.norm(b)
+xdf, ludf, _, idf = slu.gssvx(Options(factor_dtype="df64", **opt), a, b)
+rdf = np.linalg.norm(b - a.matvec(xdf)) / np.linalg.norm(b)
+assert i32 == 0 and idf == 0, (i32, idf)
+assert ludf.numeric.on_host and ludf.numeric.dtype == np.complex128
+assert rdf < 1e-11, rdf
+assert rdf < r32 / 1e3, (rdf, r32)
+print(f"ZDF64 FACTOR OK c64={r32:.2e} zdf64={rdf:.2e}")
+"""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))) + os.pathsep + env.get("PYTHONPATH", ""))
+    res = subprocess.run([sys.executable, "-c", code], env=env, timeout=900,
+                         capture_output=True, text=True)
+    assert res.returncode == 0, (res.stdout, res.stderr)
+    assert "ZDF64 FACTOR OK" in res.stdout
