@@ -1,0 +1,277 @@
+"""Implementation of the slu_tpu C API (slu_tpu.h / slu_tpu_capi.c).
+
+The C shim embeds a Python interpreter, imports this module, and calls
+these functions with raw pointers (as int64) into the caller's buffers —
+the role the reference's handle-based wrapper layer plays for its
+Fortran interface (FORTRAN/superlu_c2f_dwrap.c:51-327): a registry of
+live factorizations plus option and statistics marshalling.
+
+Surface map to the reference wrapper:
+  opt_create/opt_set/opt_get/opt_free   <-> f_create_options /
+      f_set_default_options / set_superlu_options (c2f_dwrap options block)
+  factor_opts / refactor                <-> f_pdgssvx with Fact=DOFACT /
+      SamePattern / SamePattern_SameRowPerm (fact_t tiers,
+      superlu_defs.h:489-510)
+  solve_factored_opts                   <-> f_pdgssvx with Fact=FACTORED
+      (trans/refine ride the options handle)
+  stat_get                              <-> f_PStatPrint-class observability
+      (per-phase seconds, flops, tiny pivots, memory; SRC/util.c:484-534)
+
+B/X are column-major (ldb/ldx leading dimensions, n x nrhs) as a Fortran
+caller lays them out (the reference pdgssvx's ldb contract).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import dataclasses
+import math
+
+import numpy as np
+
+import superlu_dist_tpu as _slu
+from superlu_dist_tpu.sparse.formats import SparseCSR as _CSR
+
+_handles: dict[int, dict] = {}
+_options: dict[int, object] = {}
+_next = [1]
+
+_BAD_HANDLE = -3
+_BAD_KEY = -5
+_BAD_VALUE = -6
+
+# reference-style option names (superlu_dist_options_t fields,
+# superlu_defs.h:628-657) -> Options dataclass fields; native field
+# names are accepted too
+_KEY_ALIAS = {
+    "Fact": "fact", "Equil": "equil", "ColPerm": "col_perm",
+    "RowPerm": "row_perm", "ReplaceTinyPivot": "replace_tiny_pivot",
+    "IterRefine": "iter_refine", "Trans": "trans", "DiagInv": "diag_inv",
+    "PrintStat": "print_stat",
+}
+_ENUM_FIELDS = {
+    "fact": _slu.Fact, "col_perm": _slu.ColPerm, "row_perm": _slu.RowPerm,
+    "iter_refine": _slu.IterRefine, "trans": _slu.Trans,
+}
+
+
+def _as(ptr, n, ct):
+    return np.ctypeslib.as_array(
+        ctypes.cast(int(ptr), ctypes.POINTER(ct)), (int(n),))
+
+
+def _mat(n, nnz, ip, ix, vp):
+    indptr = _as(ip, n + 1, ctypes.c_int64).copy()
+    indices = _as(ix, nnz, ctypes.c_int64).copy()
+    values = _as(vp, nnz, ctypes.c_double).copy()
+    return _CSR(n, n, indptr, indices, values)
+
+
+def _rhs(bp, n, nrhs, ldb=None):
+    ldb = n if ldb in (None, 0) else ldb
+    if ldb < n:
+        return None
+    b = _as(bp, ldb * nrhs, ctypes.c_double).copy() \
+        .reshape(ldb, nrhs, order="F")[:n]
+    return b[:, 0] if nrhs == 1 else b
+
+
+def _writeback(xp, x, n, nrhs, ldx=None):
+    ldx = n if ldx in (None, 0) else ldx
+    out = _as(xp, ldx * nrhs, ctypes.c_double).reshape(ldx, nrhs, order="F")
+    out[:n] = np.asarray(x).reshape(n, nrhs)
+
+
+def _opts_for(opt_handle):
+    """Options instance for a handle (0 = fresh defaults; None if bad)."""
+    if opt_handle == 0:
+        return _slu.Options()
+    return _options.get(opt_handle)
+
+
+# ---- options registry -------------------------------------------------------
+
+def opt_create():
+    h = _next[0]
+    _next[0] += 1
+    _options[h] = _slu.Options()
+    return h
+
+
+def opt_free(h):
+    return 0 if _options.pop(h, None) is not None else _BAD_HANDLE
+
+
+def _coerce(field_type, cur, val):
+    """Parse the C caller's string value for an Options field."""
+    if field_type is not None:            # enum field
+        if val.lstrip("-").isdigit():
+            return field_type(int(val))
+        for m in field_type:
+            if m.name.upper() == val.upper():
+                return m
+        raise ValueError(val)
+    if isinstance(cur, bool):
+        u = val.strip().upper()
+        if u in ("YES", "TRUE", "1", "ON"):
+            return True
+        if u in ("NO", "FALSE", "0", "OFF"):
+            return False
+        raise ValueError(val)
+    if isinstance(cur, int):
+        return int(val)
+    if isinstance(cur, float):
+        return float(val)
+    return val                            # str field (factor_dtype, ...)
+
+
+def opt_set(h, key, val):
+    opts = _options.get(h)
+    if opts is None:
+        return _BAD_HANDLE
+    name = _KEY_ALIAS.get(key, key)
+    if not hasattr(opts, name):
+        return _BAD_KEY
+    try:
+        setattr(opts, name, _coerce(_ENUM_FIELDS.get(name),
+                                    getattr(opts, name), val))
+    except (ValueError, TypeError):
+        return _BAD_VALUE
+    return 0
+
+
+def opt_get(h, key):
+    """Value string, or an int error code (-3 bad handle / -5 bad key —
+    the C shim distinguishes PyLong from PyUnicode returns)."""
+    opts = _options.get(h)
+    if opts is None:
+        return _BAD_HANDLE
+    name = _KEY_ALIAS.get(key, key)
+    if not hasattr(opts, name):
+        return _BAD_KEY
+    v = getattr(opts, name)
+    return v.name if hasattr(v, "name") else \
+        ("YES" if v is True else "NO" if v is False else str(v))
+
+
+# ---- solve / factor ---------------------------------------------------------
+
+def solve_opts(opt, n, nnz, ip, ix, vp, bp, ldb, xp, ldx, nrhs):
+    opts = _opts_for(opt)
+    b = _rhs(bp, n, nrhs, ldb)
+    if opts is None or b is None or (ldx not in (None, 0) and ldx < n):
+        return _BAD_HANDLE if opts is None else _BAD_VALUE
+    a = _mat(n, nnz, ip, ix, vp)
+    x, lu, stats, info = _slu.gssvx(opts, a, b)
+    if info == 0:
+        _writeback(xp, x, n, nrhs, ldx)
+    return int(info)
+
+
+def factor_opts(opt, n, nnz, ip, ix, vp):
+    from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric
+    opts = _opts_for(opt)
+    if opts is None:
+        return (_BAD_HANDLE, 0)
+    a = _mat(n, nnz, ip, ix, vp)
+    # factor WITHOUT a solve (the analyze + factorize_numeric split):
+    # no wasted zero-RHS triangular solve, and on an accelerator no
+    # device-solve program is compiled before a solve is requested
+    lu, bvals, stats = analyze(opts, a)
+    info = factorize_numeric(lu, bvals, stats)
+    if info != 0:
+        return (int(info), 0)
+    h = _next[0]
+    _next[0] += 1
+    _handles[h] = {"a": a, "lu": lu, "stats": stats, "opts": opts}
+    return (0, h)
+
+
+def refactor(h, nnz, vp, tier):
+    """Refactor with NEW numeric values on the SAME pattern, through the
+    reference's reuse tiers: tier 1 = SamePattern (column order +
+    detected-equal row perms reuse the symbolic/plan), tier 2 =
+    SamePattern_SameRowPerm (scalings + row perm + symbolic + plan all
+    reused; numeric factorization only)."""
+    ent = _handles.get(h)
+    if ent is None:
+        return _BAD_HANDLE
+    a0 = ent["a"]
+    if nnz != a0.nnz:
+        return _BAD_VALUE
+    fact = {1: _slu.Fact.SamePattern,
+            2: _slu.Fact.SamePattern_SameRowPerm}.get(tier)
+    if fact is None:
+        return _BAD_VALUE
+    a = _CSR(a0.n_rows, a0.n_cols, a0.indptr, a0.indices,
+             _as(vp, nnz, ctypes.c_double).copy())
+    from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric
+    opts = dataclasses.replace(ent["opts"], fact=fact)
+    lu, bvals, stats = analyze(opts, a, lu=ent["lu"], stats=ent["stats"])
+    info = factorize_numeric(lu, bvals, stats)
+    if info != 0:
+        return int(info)
+    ent.update(a=a, lu=lu, stats=stats)
+    return 0
+
+
+def solve_factored_opts(h, opt, n, bp, ldb, xp, ldx, nrhs):
+    ent = _handles.get(h)
+    if ent is None:
+        return _BAD_HANDLE
+    opts = ent["opts"] if opt == 0 else _opts_for(opt)
+    b = _rhs(bp, n, nrhs, ldb)
+    if opts is None or b is None or (ldx not in (None, 0) and ldx < n):
+        return _BAD_HANDLE if opts is None else _BAD_VALUE
+    opts = dataclasses.replace(opts, fact=_slu.Fact.FACTORED)
+    x, lu, stats, info = _slu.gssvx(opts, ent["a"], b, lu=ent["lu"],
+                                    stats=ent["stats"])
+    if info == 0:
+        _writeback(xp, x, n, nrhs, ldx)
+    return int(info)
+
+
+def free(h):
+    return 0 if _handles.pop(h, None) is not None else _BAD_HANDLE
+
+
+# ---- statistics (PStatPrint-class observability) ----------------------------
+
+def stat_get(h, name):
+    """A named statistic of a factorization handle as float, or an int
+    error code (-3 bad handle; unknown names yield NaN, which the C shim
+    maps to -5)."""
+    ent = _handles.get(h)
+    if ent is None:
+        return _BAD_HANDLE
+    st = ent["stats"]
+    lu = ent["lu"]
+    name_u = name.upper()
+    if name_u in st.utime:
+        return float(st.utime[name_u])
+    special = {
+        "TINY_PIVOTS": float(st.tiny_pivots),
+        "REFINE_STEPS": float(st.refine_steps),
+        "FACT_FLOPS": float(st.ops.get("FACT", 0.0)),
+        "FACT_GFLOPS": float(st.gflops("FACT")),
+        "LU_BYTES": float(st.for_lu_bytes),
+        "TOTAL_BYTES": float(st.peak_memory_bytes),
+        "BERR": float(max(lu.berrs)) if lu.berrs else 0.0,
+        "NNZ_L": float(lu.sf.nnz_L) if lu.sf is not None else math.nan,
+        "NNZ_U": float(lu.sf.nnz_U) if lu.sf is not None else math.nan,
+    }
+    return special.get(name_u, math.nan)
+
+
+# ---- legacy narrow surface (kept ABI-stable) --------------------------------
+
+def solve(n, nnz, ip, ix, vp, bp, xp, nrhs):
+    return solve_opts(0, n, nnz, ip, ix, vp, bp, n, xp, n, nrhs)
+
+
+def factor(n, nnz, ip, ix, vp):
+    return factor_opts(0, n, nnz, ip, ix, vp)
+
+
+def solve_factored(h, n, bp, xp, nrhs):
+    return solve_factored_opts(h, 0, n, bp, n, xp, n, nrhs)

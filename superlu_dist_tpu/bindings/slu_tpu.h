@@ -53,6 +53,53 @@ int slu_tpu_solve_factored(int64_t handle, int64_t n, const double* b,
 /* Release a factorization handle. */
 int slu_tpu_free_handle(int64_t handle);
 
+/* ---- full-surface API (the superlu_c2f_dwrap.c:51-327 analog) ---------
+ * Option handles carry the reference's superlu_dist_options_t surface.
+ * Keys accept reference names ("Fact", "Equil", "ColPerm", "RowPerm",
+ * "ReplaceTinyPivot", "IterRefine", "Trans", "DiagInv", "PrintStat") or
+ * native field names (e.g. "relax", "max_supernode", "factor_dtype").
+ * Values are strings: enum member names ("METIS_AT_PLUS_A", "NOTRANS",
+ * "SamePattern", ...), "YES"/"NO" for flags, or numbers.
+ * Errors: -3 bad handle, -5 unknown key/stat, -6 bad value. */
+
+int slu_tpu_options_create(int64_t* opt);
+int slu_tpu_options_set(int64_t opt, const char* key, const char* value);
+int slu_tpu_options_get(int64_t opt, const char* key, char* buf,
+                        int64_t buflen);
+int slu_tpu_options_free(int64_t opt);
+
+/* One-shot expert solve under an options handle (0 = defaults), with
+ * column-major B/X of leading dimensions ldb/ldx >= n (the reference
+ * pdgssvx ldb contract; 0 means ldb = n). */
+int slu_tpu_solve_opts(int64_t opt, int64_t n, int64_t nnz,
+                       const int64_t* indptr, const int64_t* indices,
+                       const double* values, const double* b, int64_t ldb,
+                       double* x, int64_t ldx, int64_t nrhs);
+
+/* Factor under an options handle; keeps the options with the handle. */
+int slu_tpu_factor_opts(int64_t opt, int64_t n, int64_t nnz,
+                        const int64_t* indptr, const int64_t* indices,
+                        const double* values, int64_t* handle);
+
+/* Refactor the handle with NEW values on the SAME pattern through the
+ * reference reuse tiers: tier 1 = SamePattern, 2 = SamePattern_SameRowPerm
+ * (fact_t, superlu_defs.h:489-510). */
+int slu_tpu_refactor(int64_t handle, int64_t nnz, const double* values,
+                     int64_t tier);
+
+/* Re-solve through a factorization (Fact=FACTORED) under an options
+ * handle (0 = the handle's own options); trans/refine ride the options. */
+int slu_tpu_solve_factored_opts(int64_t handle, int64_t opt, int64_t n,
+                                const double* b, int64_t ldb, double* x,
+                                int64_t ldx, int64_t nrhs);
+
+/* Named statistic of a factorization (PStatPrint analog, SRC/util.c:
+ * 484-534): per-phase seconds ("FACT", "SOLVE", "REFINE", "EQUIL",
+ * "ROWPERM", "COLPERM", "SYMBFACT", "DIST", ...), "FACT_FLOPS",
+ * "FACT_GFLOPS", "TINY_PIVOTS", "REFINE_STEPS", "BERR", "LU_BYTES",
+ * "TOTAL_BYTES", "NNZ_L", "NNZ_U". */
+int slu_tpu_stat_get(int64_t handle, const char* name, double* value);
+
 /* Shut the runtime down.  TERMINAL for the process: CPython extension
  * modules do not survive re-initialization, so any API call after this
  * returns -4.  Only call when done with the solver for good. */

@@ -2,70 +2,26 @@
  * Embedded-Python implementation of the slu_tpu C API (see slu_tpu.h).
  *
  * Architecture: like the reference's Fortran wrapper layer
- * (FORTRAN/superlu_c2f_dwrap.c), this file is a thin marshalling shim over
- * the real solver — there the C library, here the Python package driving
- * JAX/XLA.  The interpreter is initialized once; a bootstrap defines
- * _slu_capi_* helpers that view the caller's buffers through ctypes
- * (zero-copy in, one copy out into the caller's x) and keep a handle
- * registry of live factorizations (the reference's factors[] handle array,
- * superlu_c2f_dwrap.c:51).
+ * (FORTRAN/superlu_c2f_dwrap.c:51-327), this file is a thin marshalling
+ * shim over the real solver — there the C library, here the Python package
+ * driving JAX/XLA.  The interpreter is initialized once; the bootstrap
+ * imports superlu_dist_tpu.bindings.capi_impl, which views the caller's
+ * buffers through ctypes (zero-copy in, one copy out into the caller's x)
+ * and keeps handle registries of live factorizations and option structs
+ * (the reference's factors[] handle array).
  */
 
 #include "slu_tpu.h"
 
 #include <Python.h>
 #include <stdio.h>
+#include <string.h>
 
 static int g_ready = 0;
 static int g_finalized = 0;
 
 static const char* kBootstrap =
-    "import ctypes\n"
-    "import numpy as _np\n"
-    "import superlu_dist_tpu as _slu\n"
-    "from superlu_dist_tpu.sparse.formats import SparseCSR as _CSR\n"
-    "_slu_handles = {}\n"
-    "_slu_next = [1]\n"
-    "def _as(ptr, n, ct):\n"
-    "    return _np.ctypeslib.as_array(ctypes.cast(ptr, ctypes.POINTER(ct)), (n,))\n"
-    "def _mat(n, nnz, ip, ix, vp):\n"
-    "    indptr = _as(ip, n + 1, ctypes.c_int64).copy()\n"
-    "    indices = _as(ix, nnz, ctypes.c_int64).copy()\n"
-    "    values = _as(vp, nnz, ctypes.c_double).copy()\n"
-    "    return _CSR(n, n, indptr, indices, values)\n"
-    "def _writeback(xp, x, n, nrhs):\n"
-    "    out = _as(xp, n * nrhs, ctypes.c_double)\n"
-    "    out[:] = _np.asarray(x).reshape(n, nrhs, order='A').ravel(order='F')\n"
-    "def _rhs(bp, n, nrhs):\n"
-    "    b = _as(bp, n * nrhs, ctypes.c_double).copy().reshape(n, nrhs, order='F')\n"
-    "    return b[:, 0] if nrhs == 1 else b\n"
-    "def _slu_capi_solve(n, nnz, ip, ix, vp, bp, xp, nrhs):\n"
-    "    a = _mat(n, nnz, ip, ix, vp)\n"
-    "    x, lu, stats, info = _slu.gssvx(_slu.Options(), a, _rhs(bp, n, nrhs))\n"
-    "    if info == 0:\n"
-    "        _writeback(xp, x, n, nrhs)\n"
-    "    return int(info)\n"
-    "def _slu_capi_factor(n, nnz, ip, ix, vp):\n"
-    "    a = _mat(n, nnz, ip, ix, vp)\n"
-    "    b0 = _np.zeros(n)\n"
-    "    x, lu, stats, info = _slu.gssvx(\n"
-    "        _slu.Options(iter_refine=_slu.IterRefine.NOREFINE), a, b0)\n"
-    "    if info != 0:\n"
-    "        return (int(info), 0)\n"
-    "    h = _slu_next[0]; _slu_next[0] += 1\n"
-    "    _slu_handles[h] = (a, lu)\n"
-    "    return (0, h)\n"
-    "def _slu_capi_solve_factored(h, n, bp, xp, nrhs):\n"
-    "    if h not in _slu_handles:\n"
-    "        return -3\n"
-    "    a, lu = _slu_handles[h]\n"
-    "    x, lu, stats, info = _slu.gssvx(\n"
-    "        _slu.Options(fact=_slu.Fact.FACTORED), a, _rhs(bp, n, nrhs), lu=lu)\n"
-    "    if info == 0:\n"
-    "        _writeback(xp, x, n, nrhs)\n"
-    "    return int(info)\n"
-    "def _slu_capi_free(h):\n"
-    "    return 0 if _slu_handles.pop(h, None) is not None else -3\n";
+    "import superlu_dist_tpu.bindings.capi_impl as _slu_impl\n";
 
 int slu_tpu_init(const char* backend) {
   if (g_ready) return 0;
@@ -87,83 +43,208 @@ int slu_tpu_init(const char* backend) {
   return 0;
 }
 
-static PyObject* get_fn(const char* name) {
-  PyObject* main_mod = PyImport_AddModule("__main__"); /* borrowed */
-  if (!main_mod) return NULL;
-  return PyObject_GetAttrString(main_mod, name);
+static int ensure_ready(void) {
+  if (g_ready) return 0;
+  int rc = slu_tpu_init(NULL);
+  return rc == 0 ? 0 : (rc < 0 ? rc : -2);
 }
 
-static int call_int(const char* name, const char* fmt, ...) {
-  if (!g_ready) {
-    int rc = slu_tpu_init(NULL);
-    if (rc != 0) return rc < 0 ? rc : -2;
+static PyObject* get_fn(const char* name) {
+  PyObject* mod = PyImport_ImportModule("superlu_dist_tpu.bindings.capi_impl");
+  if (!mod) {
+    PyErr_Print();
+    return NULL;
   }
+  PyObject* fn = PyObject_GetAttrString(mod, name);
+  Py_DECREF(mod);
+  return fn;
+}
+
+/* Call an impl function returning a PyObject*, or NULL on failure. */
+static PyObject* call_obj(const char* name, const char* fmt, va_list ap) {
   PyObject* fn = get_fn(name);
-  if (!fn) return -2;
-  va_list ap;
-  va_start(ap, fmt);
+  if (!fn) return NULL;
   PyObject* args = Py_VaBuildValue(fmt, ap);
-  va_end(ap);
   if (!args) {
     Py_DECREF(fn);
-    return -2;
+    return NULL;
   }
   PyObject* res = PyObject_CallObject(fn, args);
   Py_DECREF(args);
   Py_DECREF(fn);
-  if (!res) {
-    PyErr_Print();
-    return -2;
-  }
-  long rc = PyLong_AsLong(res);
-  Py_DECREF(res);
-  return (int)rc;
+  if (!res) PyErr_Print();
+  return res;
 }
+
+static int call_int(const char* name, const char* fmt, ...) {
+  int rc = ensure_ready();
+  if (rc != 0) return rc;
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* res = call_obj(name, fmt, ap);
+  va_end(ap);
+  if (!res) return -2;
+  long v = PyLong_AsLong(res);
+  Py_DECREF(res);
+  return (int)v;
+}
+
+/* int status + int64 out-handle, for (info, handle) tuple returns */
+static int call_int_handle(const char* name, int64_t* out, const char* fmt,
+                           ...) {
+  int rc = ensure_ready();
+  if (rc != 0) return rc;
+  va_list ap;
+  va_start(ap, fmt);
+  PyObject* res = call_obj(name, fmt, ap);
+  va_end(ap);
+  if (!res) return -2;
+  int info = -2;
+  long long h = 0;
+  if (PyArg_ParseTuple(res, "iL", &info, &h)) *out = (int64_t)h;
+  Py_DECREF(res);
+  return info;
+}
+
+/* ---- narrow legacy surface (ABI-stable since round 3) ------------------- */
 
 int slu_tpu_solve(int64_t n, int64_t nnz, const int64_t* indptr,
                   const int64_t* indices, const double* values,
                   const double* b, double* x, int64_t nrhs) {
-  return call_int("_slu_capi_solve", "(LLLLLLLL)", (long long)n,
-                  (long long)nnz, (long long)(intptr_t)indptr,
-                  (long long)(intptr_t)indices, (long long)(intptr_t)values,
-                  (long long)(intptr_t)b, (long long)(intptr_t)x,
-                  (long long)nrhs);
+  return call_int("solve", "(LLLLLLLL)", (long long)n, (long long)nnz,
+                  (long long)(intptr_t)indptr, (long long)(intptr_t)indices,
+                  (long long)(intptr_t)values, (long long)(intptr_t)b,
+                  (long long)(intptr_t)x, (long long)nrhs);
 }
 
 int slu_tpu_factor(int64_t n, int64_t nnz, const int64_t* indptr,
                    const int64_t* indices, const double* values,
                    int64_t* handle) {
-  if (!g_ready) {
-    int rc = slu_tpu_init(NULL);
-    if (rc != 0) return rc < 0 ? rc : -2;
-  }
-  PyObject* fn = get_fn("_slu_capi_factor");
-  if (!fn) return -2;
-  PyObject* res = PyObject_CallFunction(
-      fn, "(LLLLL)", (long long)n, (long long)nnz,
-      (long long)(intptr_t)indptr, (long long)(intptr_t)indices,
-      (long long)(intptr_t)values);
-  Py_DECREF(fn);
-  if (!res) {
-    PyErr_Print();
-    return -2;
-  }
-  int info = -2;
-  long long h = 0;
-  if (PyArg_ParseTuple(res, "iL", &info, &h)) *handle = (int64_t)h;
-  Py_DECREF(res);
-  return info;
+  return call_int_handle("factor", handle, "(LLLLL)", (long long)n,
+                         (long long)nnz, (long long)(intptr_t)indptr,
+                         (long long)(intptr_t)indices,
+                         (long long)(intptr_t)values);
 }
 
 int slu_tpu_solve_factored(int64_t handle, int64_t n, const double* b,
                            double* x, int64_t nrhs) {
-  return call_int("_slu_capi_solve_factored", "(LLLLL)", (long long)handle,
+  return call_int("solve_factored", "(LLLLL)", (long long)handle,
                   (long long)n, (long long)(intptr_t)b,
                   (long long)(intptr_t)x, (long long)nrhs);
 }
 
 int slu_tpu_free_handle(int64_t handle) {
-  return call_int("_slu_capi_free", "(L)", (long long)handle);
+  return call_int("free", "(L)", (long long)handle);
+}
+
+/* ---- options registry (superlu_c2f_dwrap options block analog) ---------- */
+
+int slu_tpu_options_create(int64_t* opt) {
+  int rc = ensure_ready();
+  if (rc != 0) return rc;
+  int h = call_int("opt_create", "()");
+  if (h <= 0) return h < 0 ? h : -2;
+  *opt = h;
+  return 0;
+}
+
+int slu_tpu_options_set(int64_t opt, const char* key, const char* value) {
+  return call_int("opt_set", "(Lss)", (long long)opt, key, value);
+}
+
+int slu_tpu_options_get(int64_t opt, const char* key, char* buf,
+                        int64_t buflen) {
+  int rc = ensure_ready();
+  if (rc != 0) return rc;
+  PyObject* fn = get_fn("opt_get");
+  if (!fn) return -2;
+  PyObject* res = PyObject_CallFunction(fn, "(Ls)", (long long)opt, key);
+  Py_DECREF(fn);
+  if (!res) {
+    PyErr_Print();
+    return -2;
+  }
+  if (PyLong_Check(res)) {       /* int error code: -3 bad handle,
+                                  * -5 unknown key */
+    int rc2 = (int)PyLong_AsLong(res);
+    Py_DECREF(res);
+    return rc2;
+  }
+  const char* s = PyUnicode_AsUTF8(res);
+  if (!s || (int64_t)strlen(s) + 1 > buflen) {
+    Py_DECREF(res);
+    return -6;
+  }
+  strcpy(buf, s);
+  Py_DECREF(res);
+  return 0;
+}
+
+int slu_tpu_options_free(int64_t opt) {
+  return call_int("opt_free", "(L)", (long long)opt);
+}
+
+/* ---- full-surface solve/factor ------------------------------------------ */
+
+int slu_tpu_solve_opts(int64_t opt, int64_t n, int64_t nnz,
+                       const int64_t* indptr, const int64_t* indices,
+                       const double* values, const double* b, int64_t ldb,
+                       double* x, int64_t ldx, int64_t nrhs) {
+  return call_int("solve_opts", "(LLLLLLLLLLL)", (long long)opt,
+                  (long long)n, (long long)nnz, (long long)(intptr_t)indptr,
+                  (long long)(intptr_t)indices, (long long)(intptr_t)values,
+                  (long long)(intptr_t)b, (long long)ldb,
+                  (long long)(intptr_t)x, (long long)ldx, (long long)nrhs);
+}
+
+int slu_tpu_factor_opts(int64_t opt, int64_t n, int64_t nnz,
+                        const int64_t* indptr, const int64_t* indices,
+                        const double* values, int64_t* handle) {
+  return call_int_handle("factor_opts", handle, "(LLLLLL)", (long long)opt,
+                         (long long)n, (long long)nnz,
+                         (long long)(intptr_t)indptr,
+                         (long long)(intptr_t)indices,
+                         (long long)(intptr_t)values);
+}
+
+int slu_tpu_refactor(int64_t handle, int64_t nnz, const double* values,
+                     int64_t tier) {
+  return call_int("refactor", "(LLLL)", (long long)handle, (long long)nnz,
+                  (long long)(intptr_t)values, (long long)tier);
+}
+
+int slu_tpu_solve_factored_opts(int64_t handle, int64_t opt, int64_t n,
+                                const double* b, int64_t ldb, double* x,
+                                int64_t ldx, int64_t nrhs) {
+  return call_int("solve_factored_opts", "(LLLLLLLL)", (long long)handle,
+                  (long long)opt, (long long)n, (long long)(intptr_t)b,
+                  (long long)ldb, (long long)(intptr_t)x, (long long)ldx,
+                  (long long)nrhs);
+}
+
+/* ---- statistics (PStatPrint-class observability, SRC/util.c:484-534) ---- */
+
+int slu_tpu_stat_get(int64_t handle, const char* name, double* value) {
+  int rc = ensure_ready();
+  if (rc != 0) return rc;
+  PyObject* fn = get_fn("stat_get");
+  if (!fn) return -2;
+  PyObject* res = PyObject_CallFunction(fn, "(Ls)", (long long)handle, name);
+  Py_DECREF(fn);
+  if (!res) {
+    PyErr_Print();
+    return -2;
+  }
+  if (PyLong_Check(res)) {       /* int error code: -3 bad handle */
+    int rc2 = (int)PyLong_AsLong(res);
+    Py_DECREF(res);
+    return rc2;
+  }
+  double v = PyFloat_AsDouble(res);
+  Py_DECREF(res);
+  if (v != v) return -5;         /* NaN: unknown stat name */
+  *value = v;
+  return 0;
 }
 
 void slu_tpu_finalize(void) {

@@ -53,6 +53,69 @@ int main(void) {
   if (slu_tpu_free_handle(h) != 0) { printf("free FAIL\n"); return 1; }
   if (slu_tpu_free_handle(h) != -3) { printf("double-free FAIL\n"); return 1; }
 
-  printf("C API PASS (err one-shot + factored <= 1e-10)\n");
+  /* ---- full-surface: options + trans + strided nrhs + refactor + stats */
+  int64_t opt = 0;
+  if (slu_tpu_options_create(&opt) != 0) { printf("optc FAIL\n"); return 1; }
+  if (slu_tpu_options_set(opt, "ColPerm", "MMD_AT_PLUS_A") != 0 ||
+      slu_tpu_options_set(opt, "Trans", "TRANS") != 0 ||
+      slu_tpu_options_set(opt, "IterRefine", "SLU_DOUBLE") != 0) {
+    printf("optset FAIL\n"); return 1;
+  }
+  if (slu_tpu_options_set(opt, "NoSuchKey", "1") != -5) {
+    printf("optset bad-key FAIL\n"); return 1;
+  }
+  char buf[32];
+  if (slu_tpu_options_get(opt, "Trans", buf, sizeof buf) != 0 ||
+      buf[0] != 'T') { printf("optget FAIL\n"); return 1; }
+
+  /* A is symmetric here, so the TRANS solve must reproduce xt; use a
+   * strided (ldb=n+3) 2-RHS layout to exercise the ld contract */
+  const int64_t ld = n + 3;
+  double* b2 = calloc(ld * 2, sizeof(double));
+  double* x2 = calloc(ld * 2, sizeof(double));
+  for (int64_t i = 0; i < n; ++i) {      /* b columns: b, 3b (b was 2x) */
+    b2[i] = b[i] / 2.0;
+    b2[ld + i] = 3.0 * b[i] / 2.0;
+  }
+  int64_t h2 = 0;
+  info = slu_tpu_factor_opts(opt, n, nnz, indptr, indices, values, &h2);
+  if (info != 0) { printf("factor_opts info=%d FAIL\n", info); return 1; }
+  info = slu_tpu_solve_factored_opts(h2, opt, n, b2, ld, x2, ld, 2);
+  if (info != 0) { printf("sfo info=%d FAIL\n", info); return 1; }
+  err = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    err = fmax(err, fabs(x2[i] - xt[i]));
+    err = fmax(err, fabs(x2[ld + i] - 3.0 * xt[i]));
+  }
+  if (err > 1e-10) { printf("strided trans err=%g FAIL\n", err); return 1; }
+
+  /* refactor with scaled values (SamePattern tier), re-solve */
+  double* v2 = malloc(nnz * sizeof(double));
+  for (int64_t k = 0; k < nnz; ++k) v2[k] = 4.0 * values[k];
+  if (slu_tpu_refactor(h2, nnz, v2, 1) != 0) {
+    printf("refactor FAIL\n"); return 1;
+  }
+  info = slu_tpu_solve_factored_opts(h2, opt, n, b2, ld, x2, ld, 2);
+  if (info != 0) { printf("post-refactor info=%d FAIL\n", info); return 1; }
+  err = 0.0;
+  for (int64_t i = 0; i < n; ++i)
+    err = fmax(err, fabs(x2[i] - 0.25 * xt[i]));
+  if (err > 1e-10) { printf("refactor err=%g FAIL\n", err); return 1; }
+
+  double sv = -1.0;
+  if (slu_tpu_stat_get(h2, "FACT", &sv) != 0 || sv < 0.0) {
+    printf("stat FACT FAIL\n"); return 1;
+  }
+  if (slu_tpu_stat_get(h2, "NNZ_L", &sv) != 0 || sv < (double)n) {
+    printf("stat NNZ_L FAIL\n"); return 1;
+  }
+  if (slu_tpu_stat_get(h2, "NoSuchStat", &sv) != -5) {
+    printf("stat bad-name FAIL\n"); return 1;
+  }
+  if (slu_tpu_free_handle(h2) != 0 || slu_tpu_options_free(opt) != 0) {
+    printf("free2 FAIL\n"); return 1;
+  }
+
+  printf("C API PASS (err one-shot + factored + full-surface <= 1e-10)\n");
   return 0;
 }
