@@ -1,0 +1,320 @@
+#!/usr/bin/env python
+"""pdtest — the reference's sweep-test harness as a first-class runner.
+
+Capability analog of TEST/pdtest.c + TEST/CMakeLists.txt:9-52 +
+.travis_tests.sh:13-28: cross grid shapes × nrhs × Fact-reuse tiers ×
+equilibration × row-perm over the reference's own fixtures (g20.rua,
+big.rua, cg20.cua — read from /root/reference/EXAMPLE when present,
+gallery fallbacks otherwise), check every solve against the reference's
+residual test
+
+    resid = ||b − A·x||∞ / (||A||∞ · ||x||∞ · ε · m)  <  THRESH = 20
+    (TEST/pdcompute_resid.c:18, TEST/pdtest.c:40)
+
+and print a PrintSumm-style per-driver summary (TEST/pdtest.c:84).
+Writes docs/pdtest_summary.json.
+
+Usage:
+  python scripts/pdtest.py                 # full sweep + travis-15 list
+  python scripts/pdtest.py --quick         # g20-only smoke sweep
+  python scripts/pdtest.py --backend tpu   # run on the session backend
+  python scripts/pdtest.py -f MTX --grids 1x1,2x2 --nrhs 1,3 -x 8 -m 20
+
+Grid shapes map to virtual device meshes (the factorization runs
+mesh-sharded over r×c of the backend's devices — the single-box
+oversubscription strategy of the reference's CI, SURVEY.md §4); the
+multi-PROCESS tier is exercised separately by tests/test_multihost.py
+and examples/pddrive_grid.py.
+"""
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _common import REPO  # noqa: E402
+
+sys.path.insert(0, REPO)
+
+THRESH = 20.0                    # TEST/pdtest.c:40
+_REF_EX = "/root/reference/EXAMPLE"
+
+
+def _load_fixture(name):
+    from superlu_dist_tpu.io import read_matrix
+    from superlu_dist_tpu.models.gallery import poisson2d
+    path = os.path.join(_REF_EX, name)
+    if os.path.exists(path):
+        return read_matrix(path).tocsr(), name
+    # gallery stand-ins with the fixtures' sizes/kind
+    n = {"g20.rua": 20, "big.rua": 70, "cg20.cua": 20}.get(name, 20)
+    a = poisson2d(n)
+    if name.endswith(".cua"):
+        import superlu_dist_tpu.sparse.formats as fmts
+        rng = np.random.default_rng(1)
+        a = fmts.SparseCSR(a.n_rows, a.n_cols, a.indptr, a.indices,
+                           a.data * np.exp(1j * rng.uniform(
+                               0, 2 * np.pi, a.nnz)))
+    return a, f"@poisson2d({n}){'c' if name.endswith('.cua') else ''}"
+
+
+def _resid(a, x, b):
+    """pdcompute_resid analog (TEST/pdcompute_resid.c:18)."""
+    r = b - a.matvec(x)
+    anorm = a.norm_max()
+    xnorm = np.max(np.abs(x))
+    eps = np.finfo(np.float64).eps
+    denom = max(anorm * xnorm * eps * a.n_rows, 1e-300)
+    return float(np.max(np.abs(r)) / denom)
+
+
+def _one_config(a, grid, nrhs, relax, maxsuper, equil, rowperm, rows):
+    """The pdtest.c inner loop: DOFACT → FACTORED → SamePattern →
+    SamePattern_SameRowPerm through one configuration, each solve
+    residual-checked.  Returns (nrun, nfail)."""
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.sparse.formats import SparseCSR
+    from superlu_dist_tpu.utils.options import (Fact, Options, RowPerm)
+
+    n = a.n_rows
+    rng = np.random.default_rng(0)
+    if np.issubdtype(a.data.dtype, np.complexfloating):
+        xt = rng.standard_normal((n, nrhs)) + 1j * rng.standard_normal(
+            (n, nrhs))
+    else:
+        xt = rng.standard_normal((n, nrhs))
+    if nrhs == 1:
+        xt = xt[:, 0]
+    b = (np.stack([a.matvec(xt[:, j]) for j in range(nrhs)], axis=1)
+         if nrhs > 1 else a.matvec(xt))
+
+    base = Options(relax=relax, max_supernode=maxsuper, equil=equil,
+                   row_perm=RowPerm.LargeDiag_MC64 if rowperm else
+                   RowPerm.NOROWPERM)
+    nrun = nfail = 0
+
+    def check(tag, x, aa, bb):
+        nonlocal nrun, nfail
+        nrun += 1
+        rr = (max(_resid(aa, x[:, j], bb[:, j]) for j in range(nrhs))
+              if nrhs > 1 else _resid(aa, x, bb))
+        ok = rr < THRESH
+        if not ok:
+            nfail += 1
+        rows.append({"tag": tag, "resid_ratio": round(rr, 3), "pass": ok})
+        return ok
+
+    def failed(tag, info):
+        """A tier that errored (info != 0) is a counted failure — it must
+        reach nrun/nfail (and thus PrintSumm + the exit code), not just
+        the JSON rows."""
+        nonlocal nrun, nfail
+        nrun += 1
+        nfail += 1
+        rows.append({"tag": tag, "info": int(info), "pass": False})
+
+    # DOFACT
+    x, lu, stats, info = slu.gssvx(base, a, b, grid=grid)
+    if info != 0:
+        failed("DOFACT", info)
+        return nrun, nfail
+    check("DOFACT", x, a, b)
+
+    # FACTORED: same factors, new b
+    b2 = 2.0 * b
+    x, _, _, info = slu.gssvx(
+        dataclasses.replace(base, fact=Fact.FACTORED), a, b2, lu=lu)
+    check("FACTORED", x, a, b2) if info == 0 else failed("FACTORED", info)
+
+    # SamePattern: new values, same pattern (fresh row perm computed)
+    a2 = SparseCSR(n, n, a.indptr, a.indices, a.data * 1.5)
+    x, lu2, _, info = slu.gssvx(
+        dataclasses.replace(base, fact=Fact.SamePattern), a2, b, lu=lu,
+        grid=grid)
+    check("SamePattern", x, a2, b) if info == 0 else failed(
+        "SamePattern", info)
+
+    # SamePattern_SameRowPerm: scalings + perms + symbolic all reused
+    a3 = SparseCSR(n, n, a.indptr, a.indices, a.data * 0.75)
+    x, _, _, info = slu.gssvx(
+        dataclasses.replace(base, fact=Fact.SamePattern_SameRowPerm),
+        a3, b, lu=lu2 if lu2 is not None else lu, grid=grid)
+    check("SameRowPerm", x, a3, b) if info == 0 else failed(
+        "SameRowPerm", info)
+    return nrun, nfail
+
+
+def print_summ(typ, nfail, nrun, nerrs):
+    """PrintSumm analog (TEST/pdtest.c:84)."""
+    if nfail > 0:
+        print(f"{typ:>3s} driver: {nfail} out of {nrun} tests failed "
+              "to pass the threshold")
+    else:
+        print(f"All tests for {typ:>3s} driver passed the threshold "
+              f"({nrun:6d} tests run)")
+    if nerrs > 0:
+        print(f"{nerrs:6d} error messages recorded")
+
+
+def main():
+    ap = argparse.ArgumentParser(
+        description="pdtest-style sweep harness (TEST/pdtest.c analog)")
+    ap.add_argument("-f", "--file", action="append", default=None,
+                    help="matrix file(s); default: the travis fixtures")
+    ap.add_argument("--grids", default="1x1,1x3,2x1,2x3",
+                    help="comma list of RxC virtual grid shapes "
+                         "(travis pdtest set by default)")
+    ap.add_argument("--nrhs", default="1,3")
+    ap.add_argument("-x", "--relax", type=int, default=8)
+    ap.add_argument("-m", "--maxsuper", type=int, default=20)
+    ap.add_argument("-b", "--fill", type=int, default=2,
+                    help="accepted for pdtest CLI parity; fill is "
+                         "estimated dynamically here")
+    ap.add_argument("--quick", action="store_true",
+                    help="g20-only, 1x1 + 2x2 grids")
+    ap.add_argument("--travis", action="store_true",
+                    help="also run the example-driver configs 9-15 of "
+                         ".travis_tests.sh (pddrive1/2/3 on big.rua, "
+                         "pzdrive reuse tiers on cg20.cua, ABglobal)")
+    ap.add_argument("--backend", default="cpu",
+                    help="cpu (default; 8 virtual devices) or the "
+                         "session accelerator backend")
+    ns = ap.parse_args()
+
+    if ns.backend == "cpu":
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+    else:
+        import jax
+        jax.config.update("jax_enable_x64", True)
+    from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
+    enable_compile_cache()
+    from superlu_dist_tpu.parallel.grid import gridinit
+    import jax
+
+    ndev = len(jax.devices())
+    grids = []
+    for spec in ns.grids.split(","):
+        r, c = (int(v) for v in spec.strip().split("x"))
+        if r * c <= ndev:
+            grids.append((r, c))
+        else:
+            print(f"[pdtest] skip grid {spec}: needs {r * c} devices, "
+                  f"have {ndev}")
+    if ns.quick:
+        grids = [(1, 1), (2, 2)] if ndev >= 4 else [(1, 1)]
+    nrhss = [int(s) for s in ns.nrhs.split(",")]
+
+    if ns.file:
+        fixtures = [(name, _load_fixture(os.path.basename(name))
+                     if not os.path.exists(name) else
+                     (_read_path(name), name)) for name in ns.file]
+        fixtures = [v for _, v in fixtures]
+    else:
+        names = ["g20.rua"] if ns.quick else ["g20.rua", "big.rua",
+                                              "cg20.cua"]
+        fixtures = [_load_fixture(n) for n in names]
+
+    t0 = time.perf_counter()
+    all_rows = []
+    summary = {}
+    for a, name in fixtures:
+        typ = ("ZGS" if np.issubdtype(a.data.dtype, np.complexfloating)
+               else "DGS")
+        nrun = nfail = 0
+        for (r, c) in grids:
+            grid = gridinit(r, c) if r * c > 1 else None
+            for nrhs in nrhss:
+                for equil, rowperm in ((True, True), (False, True),
+                                       (True, False)):
+                    rows = []
+                    n1, f1 = _one_config(a, grid, nrhs, ns.relax,
+                                         ns.maxsuper, equil, rowperm,
+                                         rows)
+                    nrun += n1
+                    nfail += f1
+                    for row in rows:
+                        row.update(matrix=name, grid=f"{r}x{c}",
+                                   nrhs=nrhs, equil=equil,
+                                   rowperm=rowperm)
+                    all_rows.extend(rows)
+                    mark = "ok" if f1 == 0 else f"FAIL({f1})"
+                    print(f"[pdtest] {name} {r}x{c} s={nrhs} "
+                          f"equil={int(equil)} rowperm={int(rowperm)} "
+                          f"x={ns.relax} m={ns.maxsuper}: {n1} runs "
+                          f"{mark}", flush=True)
+        prev = summary.get(typ, (0, 0))
+        summary[typ] = (prev[0] + nfail, prev[1] + nrun)
+
+    examples = []
+    if ns.travis:
+        # .travis_tests.sh configs 9-15: the example drivers double as
+        # integration tests of the Fact-reuse tiers (SURVEY.md §4)
+        import subprocess
+        ex_dir = os.path.join(REPO, "examples")
+        big = os.path.join(_REF_EX, "big.rua")
+        cua = os.path.join(_REF_EX, "cg20.cua")
+        cfgs = [("pddrive1.py", big), ("pddrive2.py", big),
+                ("pddrive3.py", big), ("pzdrive.py", cua),
+                ("pddrive_ABglobal.py", big)]
+        env = dict(os.environ, PYTHONPATH=REPO + os.pathsep
+                   + os.environ.get("PYTHONPATH", ""))
+        for script, mtx in cfgs:
+            args = [sys.executable, os.path.join(ex_dir, script)]
+            if os.path.exists(mtx):
+                args.append(mtx)
+            args += ["--backend", "cpu"] if ns.backend == "cpu" else []
+            t1 = time.perf_counter()
+            r = subprocess.run(args, env=env, capture_output=True,
+                               text=True, timeout=1200)
+            ok = r.returncode == 0
+            examples.append({"example": script, "matrix":
+                             os.path.basename(mtx), "pass": ok,
+                             "seconds": round(time.perf_counter() - t1, 1)})
+            print(f"[pdtest] example {script}: "
+                  f"{'ok' if ok else 'FAIL'}", flush=True)
+            if not ok:
+                print(r.stdout[-1500:] + r.stderr[-1500:])
+                typ = "ZGS" if script.startswith("pz") else "DGS"
+                f0, r0 = summary.get(typ, (0, 0))
+                summary[typ] = (f0 + 1, r0 + 1)
+            else:
+                typ = "ZGS" if script.startswith("pz") else "DGS"
+                f0, r0 = summary.get(typ, (0, 0))
+                summary[typ] = (f0, r0 + 1)
+
+    print()
+    for typ, (nfail, nrun) in sorted(summary.items()):
+        print_summ(typ, nfail, nrun, 0)
+
+    out = {"thresh": THRESH, "relax": ns.relax, "maxsuper": ns.maxsuper,
+           "grids": [f"{r}x{c}" for r, c in grids], "nrhs": nrhss,
+           "backend": ns.backend, "seconds": round(
+               time.perf_counter() - t0, 1),
+           "summary": {t: {"nfail": f, "nrun": r}
+                       for t, (f, r) in summary.items()},
+           "examples": examples, "rows": all_rows}
+    path = os.path.join(REPO, "docs", "pdtest_summary.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(f"\nwrote {path} ({out['seconds']}s)")
+    return 1 if any(f for f, _ in summary.values()) else 0
+
+
+def _read_path(p):
+    from superlu_dist_tpu.io import read_matrix
+    return read_matrix(p).tocsr()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
