@@ -138,14 +138,13 @@ def main():
         # docs/bench_cpu_nx48_r4.json).  The marker mirrors the TPU
         # cold-cache guard: without it a cold fused-program compile
         # could eat the child's deadline, so shrink to NX=32 (~2 min)
-        # warm markers are fingerprint-suffixed: they vouch for entries
-        # in the MACHINE-SCOPED cache dir (utils/jaxcache), so a marker
-        # from another box/toolchain must not steer this one into a
-        # cold-compile NX=48 run against an empty cache
-        from superlu_dist_tpu.utils.jaxcache import machine_fingerprint
-        _cpu48 = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              ".hw_done",
-                              f"nx48_cpu.{machine_fingerprint()}")
+        # warm markers are fingerprint-suffixed (utils/jaxcache
+        # warm_marker_path): they vouch for entries in the MACHINE-SCOPED
+        # cache dir, so a marker from another box/toolchain must not
+        # steer this one into a cold-compile NX=48 run
+        from superlu_dist_tpu.utils.jaxcache import warm_marker_path
+        _cpu48 = warm_marker_path(
+            "nx48_cpu", os.path.dirname(os.path.abspath(__file__)))
         cap = 48 if remaining >= 1000 and os.path.exists(_cpu48) else 32
         env = dict(os.environ, BENCH_FORCE_CPU="1", BENCH_NO_PROBE="1",
                    BENCH_DEADLINE_S=str(remaining - 30),
@@ -210,10 +209,9 @@ def main():
     _default_cfg = not _knob_set
     # fingerprint-suffixed (see the CPU-fallback marker above): the
     # warmth claim is per machine-scoped cache dir
-    from superlu_dist_tpu.utils.jaxcache import machine_fingerprint
-    _marker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           ".hw_done",
-                           f"nx48_default.{machine_fingerprint()}")
+    from superlu_dist_tpu.utils.jaxcache import warm_marker_path
+    _marker = warm_marker_path(
+        "nx48_default", os.path.dirname(os.path.abspath(__file__)))
     if (_default_cfg and jax.default_backend() != "cpu"
             and DEADLINE - (time.perf_counter() - T0) < 2400
             and not os.path.exists(_marker)):
@@ -365,10 +363,9 @@ def main():
         # BENCH_RELAX/AMALG program would not warm the default kernels)
         # is cached: the CPU fallback may keep the driver size from now
         # on (see the fallback cap)
-        from superlu_dist_tpu.utils.jaxcache import machine_fingerprint
-        mk = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                          ".hw_done",
-                          f"nx48_cpu.{machine_fingerprint()}")
+        from superlu_dist_tpu.utils.jaxcache import warm_marker_path
+        mk = warm_marker_path(
+            "nx48_cpu", os.path.dirname(os.path.abspath(__file__)))
         os.makedirs(os.path.dirname(mk), exist_ok=True)
         open(mk, "a").close()
 

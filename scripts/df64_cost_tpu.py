@@ -65,12 +65,12 @@ def main():
 
         exd = get_df64_executor(plan)
         ah, al = df64_from_f64(jnp.asarray(avals64))
-        outd = exd(ah, al, jnp.asarray(thresh, jnp.float32))
+        outd = exd((ah, al), jnp.asarray(thresh, jnp.float32))
         jax.block_until_ready(outd[0])
         reps = []
         for _ in range(3):
             t0 = time.perf_counter()
-            outd = exd(ah, al, jnp.asarray(thresh, jnp.float32))
+            outd = exd((ah, al), jnp.asarray(thresh, jnp.float32))
             jax.block_until_ready(outd[0])
             reps.append(time.perf_counter() - t0)
         df64_s = min(reps)

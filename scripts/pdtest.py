@@ -216,10 +216,12 @@ def main():
     nrhss = [int(s) for s in ns.nrhs.split(",")]
 
     if ns.file:
-        fixtures = [(name, _load_fixture(os.path.basename(name))
-                     if not os.path.exists(name) else
-                     (_read_path(name), name)) for name in ns.file]
-        fixtures = [v for _, v in fixtures]
+        # explicit paths must exist — a typo silently swept a gallery
+        # stand-in instead of the user's matrix otherwise
+        missing = [p for p in ns.file if not os.path.exists(p)]
+        if missing:
+            ap.error(f"matrix file(s) not found: {', '.join(missing)}")
+        fixtures = [(_read_path(p), p) for p in ns.file]
     else:
         names = ["g20.rua"] if ns.quick else ["g20.rua", "big.rua",
                                               "cg20.cua"]

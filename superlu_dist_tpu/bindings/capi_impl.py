@@ -183,7 +183,11 @@ def factor_opts(opt, n, nnz, ip, ix, vp):
         return (int(info), 0)
     h = _next[0]
     _next[0] += 1
-    _handles[h] = {"a": a, "lu": lu, "stats": stats, "opts": opts}
+    # snapshot the options: later opt_set calls on the caller's options
+    # handle must not retroactively change this factorization's stored
+    # solve/refactor semantics ("the handle's own options", slu_tpu.h)
+    _handles[h] = {"a": a, "lu": lu, "stats": stats,
+                   "opts": dataclasses.replace(opts)}
     return (0, h)
 
 

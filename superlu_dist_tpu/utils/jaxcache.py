@@ -71,6 +71,16 @@ def cache_dir_for_machine(base: str | None = None) -> str:
     return os.path.join(base, f"jax-mach-{machine_fingerprint()}")
 
 
+def warm_marker_path(name: str, base_dir: str) -> str:
+    """Path of a fingerprint-suffixed warm-cache marker under
+    `<base_dir>/.hw_done/`.  One constructor for every reader/writer:
+    the marker vouches for entries in THIS machine's cache dir, so its
+    name carries the same fingerprint (a marker from another box or
+    toolchain never matches)."""
+    return os.path.join(base_dir, ".hw_done",
+                        f"{name}.{machine_fingerprint()}")
+
+
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point jax at the persistent compile cache (default: the repo's
     machine-scoped `.cache/jax-mach-<fp>`).  Caches every entry

@@ -1,0 +1,122 @@
+"""Python-level tests of the C-API implementation layer (capi_impl.py).
+
+The C client (test_capi.c, slow tier) exercises the same surface through
+the embedded interpreter; this fast-tier twin drives the marshalling and
+registry logic directly — options keys/values, the reuse tiers, strided
+column-major RHS buffers, statistics, and the error-code contract
+(-3 bad handle / -5 unknown key / -6 bad value; slu_tpu.h)."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.bindings import capi_impl as ci
+
+
+def _tridiag(n=40):
+    indptr = [0]
+    indices = []
+    values = []
+    for i in range(n):
+        if i > 0:
+            indices.append(i - 1)
+            values.append(-1.0)
+        indices.append(i)
+        values.append(4.0)
+        if i < n - 1:
+            indices.append(i + 1)
+            values.append(-1.0)
+        indptr.append(len(indices))
+    return (np.asarray(indptr, np.int64), np.asarray(indices, np.int64),
+            np.asarray(values, np.float64))
+
+
+def _ptr(a):
+    return a.ctypes.data
+
+
+def test_options_registry_contract():
+    h = ci.opt_create()
+    assert ci.opt_set(h, "ColPerm", "COLAMD") == 0
+    assert ci.opt_get(h, "ColPerm") == "COLAMD"
+    assert ci.opt_set(h, "Trans", "TRANS") == 0
+    assert ci.opt_set(h, "Equil", "NO") == 0
+    assert ci.opt_get(h, "Equil") == "NO"
+    assert ci.opt_set(h, "relax", "12") == 0
+    assert ci.opt_get(h, "relax") == "12"
+    assert ci.opt_set(h, "NoSuchKey", "1") == ci._BAD_KEY
+    assert ci.opt_set(h, "ColPerm", "NOT_AN_ORDERING") == ci._BAD_VALUE
+    assert ci.opt_set(999_999, "Equil", "NO") == ci._BAD_HANDLE
+    assert ci.opt_get(999_999, "Equil") == ci._BAD_HANDLE
+    assert ci.opt_get(h, "NoSuchKey") == ci._BAD_KEY
+    assert ci.opt_free(h) == 0
+    assert ci.opt_free(h) == ci._BAD_HANDLE
+
+
+def test_factor_refactor_solve_stats_strided():
+    n = 40
+    indptr, indices, values = _tridiag(n)
+    xt = 1.0 + 0.01 * np.arange(n)
+    b = np.zeros(n)
+    for i in range(n):
+        for k in range(indptr[i], indptr[i + 1]):
+            b[i] += values[k] * xt[indices[k]]
+
+    info, h = ci.factor_opts(0, n, len(values), _ptr(indptr),
+                             _ptr(indices), _ptr(values))
+    assert info == 0 and h > 0
+
+    # strided 2-RHS column-major buffers (ld > n)
+    ld = n + 5
+    b2 = np.zeros((ld, 2), order="F")
+    x2 = np.zeros((ld, 2), order="F")
+    b2[:n, 0] = b
+    b2[:n, 1] = 3.0 * b
+    rc = ci.solve_factored_opts(h, 0, n, _ptr(b2), ld, _ptr(x2), ld, 2)
+    assert rc == 0
+    assert np.max(np.abs(x2[:n, 0] - xt)) < 1e-10
+    assert np.max(np.abs(x2[:n, 1] - 3.0 * xt)) < 1e-10
+    assert np.all(x2[n:] == 0.0)          # padding rows untouched
+    # undersized ldx is rejected BEFORE solving
+    assert ci.solve_factored_opts(h, 0, n, _ptr(b2), ld, _ptr(x2),
+                                  n - 1, 2) == ci._BAD_VALUE
+
+    # SamePattern refactor with scaled values
+    v2 = 2.0 * values
+    assert ci.refactor(h, len(v2), _ptr(v2), 1) == 0
+    rc = ci.solve_factored_opts(h, 0, n, _ptr(b2), ld, _ptr(x2), ld, 2)
+    assert rc == 0
+    assert np.max(np.abs(x2[:n, 0] - 0.5 * xt)) < 1e-10
+    # wrong nnz / bad tier
+    assert ci.refactor(h, len(v2) - 1, _ptr(v2), 1) == ci._BAD_VALUE
+    assert ci.refactor(h, len(v2), _ptr(v2), 7) == ci._BAD_VALUE
+    assert ci.refactor(12345, len(v2), _ptr(v2), 1) == ci._BAD_HANDLE
+
+    # statistics
+    assert ci.stat_get(h, "FACT") >= 0.0
+    assert ci.stat_get(h, "NNZ_L") >= n
+    assert np.isnan(ci.stat_get(h, "NoSuchStat"))
+    assert ci.stat_get(4242, "FACT") == ci._BAD_HANDLE
+
+    assert ci.free(h) == 0
+    assert ci.free(h) == ci._BAD_HANDLE
+
+
+def test_one_shot_solve_with_options():
+    n = 40
+    indptr, indices, values = _tridiag(n)
+    b = np.ones(n)
+    x = np.zeros(n)
+    h = ci.opt_create()
+    assert ci.opt_set(h, "IterRefine", "SLU_DOUBLE") == 0
+    rc = ci.solve_opts(h, n, len(values), _ptr(indptr), _ptr(indices),
+                       _ptr(values), _ptr(b), n, _ptr(x), n, 1)
+    assert rc == 0
+    # residual check
+    r = b.copy()
+    for i in range(n):
+        for k in range(indptr[i], indptr[i + 1]):
+            r[i] -= values[k] * x[indices[k]]
+    assert np.max(np.abs(r)) < 1e-12
+    assert ci.opt_free(h) == 0
