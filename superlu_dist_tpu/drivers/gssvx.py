@@ -213,6 +213,13 @@ def analyze(options: Options, a: SparseCSR,
     reuse_rowperm = fact == Fact.SamePattern_SameRowPerm and lu is not None
     reuse_colperm = fact in (Fact.SamePattern, Fact.SamePattern_SameRowPerm) \
         and lu is not None
+    if reuse_colperm and lu.sf is not None and lu.sf.value_perm is None:
+        # a panalyze (ParSymbFact) skeleton assembles values directly and
+        # records no value-gather map; the reuse tiers need one
+        raise SuperLUError(
+            "Fact reuse tiers require a serial-analysis skeleton; this one "
+            "came from the distributed analysis (parallel/panalysis.py) — "
+            "re-analyze with Fact=DOFACT")
     # Symbolic/plan reuse tiers.  Our symbolic runs on the row-permuted
     # pattern, so reuse is sound iff the row permutation is unchanged:
     # always true under SamePattern_SameRowPerm, and detected dynamically

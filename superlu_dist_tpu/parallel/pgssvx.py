@@ -229,6 +229,12 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
         a_all = gather_distributed(tc, a_loc, all_ranks=True)
         lu, bvals, _ = analyze(opts0, a_all, stats=stats)
         lu.a = None
+    elif getattr(opts0, "par_symb_fact", False):
+        # ParSymbFact tier: ordering + symbolic partition across the
+        # ranks themselves (parallel/panalysis.py — the ParMETIS +
+        # psymbfact shape); root only assembles and plans
+        from superlu_dist_tpu.parallel.panalysis import panalyze
+        lu, bvals = panalyze(tc, opts0, a_loc, stats=stats)
     else:
         a_root = gather_distributed(tc, a_loc, root=0)
         blob = None

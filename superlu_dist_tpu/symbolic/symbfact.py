@@ -131,8 +131,34 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
                      sn_parent, sn_level, us, indptr, indices, value_perm)
         return _amalg_if(sf, amalg_tol, max_supernode)
 
+    # ---- pure-python fallback (shared with the bordered caller) ------------
+    sn_start, col_to_sn, sn_rows, sn_parent = build_supernodes_py(
+        n, indptr, indices, parent, relax, max_supernode)
+    sn_level = np.zeros(len(sn_rows), dtype=np.int64)
+    for s in range(len(sn_rows)):
+        p = sn_parent[s]
+        if p >= 0:
+            sn_level[p] = max(sn_level[p], sn_level[s] + 1)
+    us = np.array([len(r) for r in sn_rows], dtype=np.int64)
+    sf = _finish(n, perm, parent, sn_start, col_to_sn, sn_rows, sn_parent,
+                 sn_level, us, indptr, indices, value_perm)
+    return _amalg_if(sf, amalg_tol, max_supernode)
+
+
+def build_supernodes_py(n, indptr, indices, parent, relax, max_supernode,
+                        strict: bool = True):
+    """Relaxed-leaf supernode partition + bottom-up row structures +
+    zero-fill chain merging — the pure-python twin of the native
+    symbolic_impl (native/slu_host.cpp:139).  Returns (sn_start,
+    col_to_sn, sn_rows, sn_parent); sn_parent is -1 for roots (columns
+    whose structure is empty or leaves the n-column range).
+
+    strict asserts relaxed-subtree contiguity, which postordered labels
+    guarantee; the bordered caller (parallel/panalysis.py) passes
+    strict=False because its trailing boundary columns are only
+    partially ordered — their non-contiguous subtrees then degrade to
+    singleton starts, exactly like the native walk does."""
     # ---- relaxed leaf supernodes (relax_snode analog) ----------------------
-    # postordered labels => every subtree is a contiguous column range
     cnt = np.ones(n, dtype=np.int64)
     for j in range(n):
         p = parent[j]
@@ -151,8 +177,13 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
             j = int(next_root) + 1
             next_root = next(root_iter, None)
         else:
-            assert next_root is None or j < next_root - cnt[next_root] + 1, \
-                "relaxed subtrees must be contiguous and disjoint"
+            if strict:
+                assert (next_root is None
+                        or j < next_root - cnt[next_root] + 1), \
+                    "relaxed subtrees must be contiguous and disjoint"
+            elif next_root is not None and j >= next_root:
+                next_root = next(root_iter, None)
+                continue
             j += 1
     starts.append(n)
     first = np.array(starts[:-1], dtype=np.int64)
@@ -192,7 +223,7 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
             del by_last[int(last[c])]
             alive[c] = False
             first[s] = first[c]
-        if len(rows):
+        if len(rows) and rows[0] < n:
             kids[int(col_to_sn0[rows[0]])].append(s)
 
     # ---- compact to live supernodes ----------------------------------------
@@ -204,21 +235,10 @@ def symbolic_factorize(sym_pattern: SparseCSR, order: np.ndarray,
     sn_rows = [rows_of[s] for s in live]
     sn_parent = np.full(ns, -1, dtype=np.int64)
     for s in range(ns):
-        if len(sn_rows[s]):
+        if len(sn_rows[s]) and sn_rows[s][0] < n:
             sn_parent[s] = col_to_sn[sn_rows[s][0]]
         assert sn_parent[s] > s or sn_parent[s] == -1
-
-    # ---- levels over the supernode tree (the batch schedule) ---------------
-    sn_level = np.zeros(ns, dtype=np.int64)
-    for s in range(ns):
-        p = sn_parent[s]
-        if p >= 0:
-            sn_level[p] = max(sn_level[p], sn_level[s] + 1)
-
-    us = np.array([len(r) for r in sn_rows], dtype=np.int64)
-    sf = _finish(n, perm, parent, sn_start, col_to_sn, sn_rows, sn_parent,
-                 sn_level, us, indptr, indices, value_perm)
-    return _amalg_if(sf, amalg_tol, max_supernode)
+    return sn_start, col_to_sn, sn_rows, sn_parent
 
 
 def _amalg_if(sf: SymbolicFact, tol, max_width: int) -> SymbolicFact:

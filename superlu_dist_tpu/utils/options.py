@@ -139,6 +139,12 @@ class Options:
     # memory path; only meaningful with a grid) — SLU_TPU_POOL_PARTITION=1
     pool_partition: bool = dataclasses.field(
         default_factory=lambda: bool(_env_int("SLU_TPU_POOL_PARTITION", 0)))
+    # distributed analysis for the multi-process tier (the reference's
+    # options->ParSymbFact: ParMETIS ordering + psymbfact): ordering and
+    # symbolic work/memory partition across the ranks instead of running
+    # on root (parallel/panalysis.py) — SLU_TPU_PAR_SYMB_FACT=1
+    par_symb_fact: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SLU_TPU_PAR_SYMB_FACT", 0)))
     # user-supplied permutations for MY_PERMC / MY_PERMR (real dataclass
     # fields so Options(user_perm_c=...) works — the reference reads these
     # from ScalePermstruct->perm_c/perm_r when ColPerm/RowPerm say MY_*).

@@ -152,13 +152,14 @@ print(f"proc {pid} pgssvx-mesh ok n={n} resid={resid:.2e}", flush=True)
 """
 
 
-def _run_pgssvx_mesh(tmp_path, nproc, ngrid, timeout):
+def _run_pgssvx_mesh(tmp_path, nproc, ngrid, timeout, extra_env=None):
     port = _free_port()
     script = tmp_path / "pgx_mesh_worker.py"
     script.write_text(_PGSSVX_WORKER)
     env = dict(os.environ, PYTHONPATH=os.path.dirname(
         os.path.dirname(os.path.abspath(__file__))))
     env.pop("XLA_FLAGS", None)
+    env.update(extra_env or {})
     shm = f"/slu_mhpgx_{os.getpid()}"
     procs = [subprocess.Popen(
         [sys.executable, str(script), str(i), str(nproc), str(port),
@@ -169,6 +170,15 @@ def _run_pgssvx_mesh(tmp_path, nproc, ngrid, timeout):
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {i} failed:\n{out[-4000:]}"
         assert f"proc {i} pgssvx-mesh ok" in out
+
+
+def test_pgssvx_mesh_par_symb_fact(tmp_path):
+    """Distributed-factors tier WITH distributed analysis (ParSymbFact):
+    ordering + symbolic partition across the 4 ranks (panalysis.py, the
+    get_perm_c_parmetis + psymbfact shape) and the factors still come
+    out sharded, solve to 1e-10, through the same driver surface."""
+    _run_pgssvx_mesh(tmp_path, nproc=4, ngrid=24, timeout=900,
+                     extra_env={"SLU_TPU_PAR_SYMB_FACT": "1"})
 
 
 def test_pgssvx_mesh_two_processes_small(tmp_path):

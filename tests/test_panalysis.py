@@ -1,0 +1,201 @@
+"""Distributed analysis (ParSymbFact tier, parallel/panalysis.py).
+
+The reference validates its parallel symbolic by factoring the same
+systems through both analysis paths (psymbfact vs symbfact) and
+checking the solves; we do the same — the skeleton a 4-process
+panalyze produces must factor and solve to the same residual class as
+the serial analysis.  Unit tests pin the two core invariants the
+psymbfact shape rests on: projected coarse separators really separate
+(no cross-part edge survives), and the bordered symbolic with an empty
+border reproduces the serial supernodal fill.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native library unavailable")
+
+
+# ---------------------------------------------------------------------------
+# unit: coarse bisection produces a true vertex separation
+# ---------------------------------------------------------------------------
+
+def test_coarse_bisect_separates():
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.parallel.panalysis import _coarse_bisect
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+
+    a = symmetrize_pattern(poisson2d(20))
+    n = a.n_rows
+    for nparts in (2, 4, 3):
+        labels, nsep = _coarse_bisect(
+            n, a.indptr, a.indices, np.ones(n), nparts)
+        assert labels.min() >= -nsep and labels.max() < nparts
+        # every vertex labeled; no edge joins two different parts
+        rows = np.repeat(np.arange(n), np.diff(a.indptr))
+        lr, lc = labels[rows], labels[a.indices]
+        cross = (lr >= 0) & (lc >= 0) & (lr != lc)
+        assert not cross.any(), "separator failed to separate parts"
+        # parts are reasonably balanced (weighted bisection)
+        sizes = [(labels == p).sum() for p in range(nparts)]
+        assert sum(sizes) + (labels < 0).sum() == n
+
+
+# ---------------------------------------------------------------------------
+# unit: bordered symbolic, empty border == serial supernodal fill
+# ---------------------------------------------------------------------------
+
+def test_bordered_symbolic_matches_serial():
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.parallel.panalysis import _bordered_symbolic
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+
+    a = symmetrize_pattern(poisson2d(12))
+    n = a.n_rows
+    order = np.arange(n)
+    sf = symbolic_factorize(a, order, relax=8, max_supernode=64,
+                            amalg_tol=0)
+    post, sn_start, sn_rows, sn_parent, parent_cols = _bordered_symbolic(
+        n, n, a.indptr, a.indices, relax=8, max_supernode=64)
+    widths = np.diff(sn_start)
+    us = np.array([len(r) for r in sn_rows])
+    nnz = int(np.sum(widths * (widths + 1) // 2) + np.sum(widths * us))
+    assert nnz == sf.nnz_L, (nnz, sf.nnz_L)
+    assert len(post) == n and sn_start[-1] == n
+
+
+def test_python_builder_matches_native():
+    """The shared pure-python supernode builder (the non-native path of
+    _bordered_symbolic) agrees with the native twin on fill size."""
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.ordering.etree import etree_symmetric
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import build_supernodes_py
+
+    a = symmetrize_pattern(poisson2d(10))
+    n = a.n_rows
+    parent = native.etree(n, a.indptr, a.indices)
+    if parent is None:
+        parent = etree_symmetric(n, a.indptr, a.indices)
+    # natural order need not postorder this etree (subtrees may be
+    # non-contiguous) — strict=False must survive it, like the bordered
+    # caller's partially-ordered boundary regime
+    sn_start, c2s, sn_rows, sn_parent = build_supernodes_py(
+        n, a.indptr, a.indices, parent, 8, 64, strict=False)
+    w = np.diff(sn_start)
+    us = np.array([len(r) for r in sn_rows])
+    nnz = int(np.sum(w * (w + 1) // 2) + np.sum(w * us))
+    nat = native.symbolic(n, a.indptr, a.indices, parent, 8, 64)
+    if nat is not None:
+        nw = np.diff(nat[0])
+        nus = np.diff(nat[4])
+        nat_nnz = int(np.sum(nw * (nw + 1) // 2) + np.sum(nw * nus))
+        assert nnz == nat_nnz, (nnz, nat_nnz)
+
+
+# ---------------------------------------------------------------------------
+# integration: 4 OS processes, skeleton factors + solves correctly
+# ---------------------------------------------------------------------------
+
+def _worker(name, n_ranks, rank, build, opts_kw, q):
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+    from superlu_dist_tpu.parallel.panalysis import panalyze
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.options import Options
+    a = build()
+    parts = distribute_rows(a, n_ranks)
+    with TreeComm(name, n_ranks, rank, max_len=1 << 16,
+                  create=False) as tc:
+        lu, bvals = panalyze(tc, Options(**opts_kw), parts[rank])
+    q.put((rank, lu is not None and bvals is not None))
+
+
+def _run_panalyze(build, opts_kw, n_ranks=4):
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+    from superlu_dist_tpu.parallel.panalysis import panalyze
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.options import Options
+
+    name = f"/slu_panl_{os.getpid()}"
+    a = build()
+    parts = distribute_rows(a, n_ranks)
+    owner = TreeComm(name, n_ranks, 0, max_len=1 << 16, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_worker,
+                         args=(name, n_ranks, r, build, opts_kw, q))
+             for r in range(1, n_ranks)]
+    try:
+        for p in procs:
+            p.start()
+        lu, bvals = panalyze(owner, Options(**opts_kw), parts[0])
+        for _ in procs:
+            rank, ok = q.get(timeout=120)
+            assert ok, f"rank {rank} returned no skeleton"
+        for p in procs:
+            p.join(timeout=60)
+        return a, lu, bvals
+    finally:
+        for p in procs:
+            if p.is_alive():
+                p.kill()
+        owner.close()
+
+
+def _check_solves(a, lu, bvals, tol=1e-8):
+    from superlu_dist_tpu.drivers.gssvx import factorize_numeric
+    n = a.n_rows
+    info = factorize_numeric(lu, bvals)
+    assert info == 0
+    rng = np.random.default_rng(7)
+    xt = rng.standard_normal(n).astype(np.asarray(a.data).dtype)
+    b = a.matvec(xt)
+    x = lu.solve_factored(b)
+    resid = np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b)
+    assert resid < tol, resid
+    # the skeleton must also report a sane structure
+    assert lu.sf.nnz_L >= a.nnz
+    assert lu.plan is not None
+
+
+def _build_poisson():
+    from superlu_dist_tpu.models.gallery import poisson2d
+    return poisson2d(24)
+
+
+def _build_convdiff():
+    from superlu_dist_tpu.models.gallery import convection_diffusion_2d
+    return convection_diffusion_2d(20)
+
+
+def _build_helmholtz():
+    from superlu_dist_tpu.models.gallery import helmholtz_2d
+    return helmholtz_2d(18)
+
+
+@pytest.mark.slow
+def test_panalyze_poisson_norowperm():
+    from superlu_dist_tpu.utils.options import RowPerm
+    a, lu, bvals = _run_panalyze(
+        _build_poisson, dict(row_perm=RowPerm.NOROWPERM))
+    _check_solves(a, lu, bvals)
+
+
+@pytest.mark.slow
+def test_panalyze_convdiff_mc64():
+    # unsymmetric pattern + the serial-on-root MC64 matching branch
+    a, lu, bvals = _run_panalyze(_build_convdiff, {})
+    _check_solves(a, lu, bvals)
+
+
+@pytest.mark.slow
+def test_panalyze_complex():
+    a, lu, bvals = _run_panalyze(_build_helmholtz, {})
+    _check_solves(a, lu, bvals, tol=1e-6)
