@@ -54,14 +54,17 @@ def _rank_body(mode, name, nranks, rank, part, q):
         if mode == "replicated":
             a_all = gather_distributed(tc, part, all_ranks=True)
             lu, bvals, _ = analyze(Options(), a_all)
+        elif mode == "parsymb":
+            # the ParSymbFact tier: ordering + symbolic work partition
+            # across the ranks themselves (parallel/panalysis.py)
+            from superlu_dist_tpu.parallel.panalysis import panalyze
+            lu, bvals = panalyze(tc, Options(), part)
         else:
-            a_root = gather_distributed(tc, part, root=0)
-            blob = None
-            if rank == 0:
-                lu, bvals, _ = analyze(Options(), a_root)
-                lu.a = None
-                blob = (lu, bvals)
-            lu, bvals = tc.bcast_obj(blob, root=0)
+            # the production tier-1 path itself (one implementation)
+            from superlu_dist_tpu.parallel.pgssvx import (
+                root_analyze_bcast)
+            from superlu_dist_tpu.utils.stats import Stats
+            lu, bvals = root_analyze_bcast(tc, Options(), part, Stats())
         t = time.perf_counter() - t0
         assert lu.plan is not None and len(bvals) > 0
         q.put({"rank": rank, "mode": mode, "analysis_seconds": round(t, 3),
@@ -133,7 +136,9 @@ def main():
 
     out = {"n": n, "nnz": int(sum(p.nnz_loc for p in parts)),
            "nranks": nranks}
-    for mode in ("replicated", "root_bcast"):
+    modes = tuple(os.environ.get(
+        "MAS_MODES", "replicated,root_bcast,parsymb").split(","))
+    for mode in modes:
         t0 = time.perf_counter()
         rows = _run_mode(mode, parts, nranks)
         out[mode] = {"ranks": rows,
@@ -143,6 +148,23 @@ def main():
                         f"{r['vm_hwm_mb']:.0f}MB" for r in rows),
               flush=True)
 
+    if "parsymb" in out and "root_bcast" in out:
+        # what the distributed analysis buys OVER the root+bcast tier:
+        # the root stops doing the whole ordering+symbolic itself
+        ps = out["parsymb"]["ranks"]
+        bc0 = out["root_bcast"]["ranks"]
+        out["parsymb_root_time_ratio"] = round(
+            bc0[0]["analysis_seconds"]
+            / max(ps[0]["analysis_seconds"], 1e-9), 2)
+        out["parsymb_root_hwm_delta_ratio"] = round(
+            bc0[0].get("analysis_hwm_delta_mb", float("nan"))
+            / max(ps[0].get("analysis_hwm_delta_mb", 1e-9), 1e-9), 2)
+    if "replicated" not in out or "root_bcast" not in out:
+        path = os.path.join(REPO, "docs", f"mesh_analysis_4proc_n{n}.json")
+        with open(path, "w") as fh:
+            json.dump(out, fh, indent=1)
+        print("wrote", path)
+        return
     rep = out["replicated"]["ranks"]
     bc = out["root_bcast"]["ranks"]
     out["nonroot_time_ratio"] = round(

@@ -90,6 +90,16 @@ class FactorPlan:
     def n_levels(self) -> int:
         return int(self.sf.sn_level.max()) + 1 if len(self.sf.sn_level) else 0
 
+    def __getstate__(self):
+        """Drop the volatile executor cache (factor.make_factor_fn hangs
+        compiled closures on the plan — `_factor_fns`).  A plan that has
+        already factored once would otherwise be unpicklable, which the
+        distributed tier's skeleton broadcast hits on every Fact-reuse
+        refactorization (the root's plan is warm by then)."""
+        state = dict(self.__dict__)
+        state.pop("_factor_fns", None)
+        return state
+
     def check_index_width(self):
         """Flat pool offsets must fit the active jax integer width.
         Beyond 2^31 entries (n≳600k at f32) the int64 index maps need

@@ -35,10 +35,19 @@ def boot(nproc: int, process_id: int, port: int | str,
     jax.distributed.initialize(
         coordinator_address=f"{coordinator}:{port}",
         num_processes=int(nproc), process_id=int(process_id))
-    # every rank compiles the same SPMD programs; the persistent cache
-    # makes rank k>0's compiles (and any rerun's) disk hits
-    from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
-    enable_compile_cache()
+    # Deliberately NO persistent compile cache here: XLA:CPU AOT
+    # entries for programs containing CROSS-PROCESS COLLECTIVES are
+    # broken on disk-reload on this image (the loader flags the
+    # embedded +prefer-no-scatter/+prefer-no-gather codegen prefs as
+    # unsupported "machine features" and the reloaded executable's
+    # collective schedule diverges — observed as gloo
+    # "preamble.length <= op.nbytes" SIGABRTs).  Reloads are also
+    # RACY: a rank that finds a peer's just-written entry loads it
+    # while the peer runs its in-memory build, so identical runs
+    # pass or die by timing.  Reproduced A/B in round 5: cold run
+    # green with zero cpu_aot warnings, warm rerun of the very same
+    # test dies in 19 s loading its own entries.  Serial CPU and TPU
+    # entries reload fine — only the multi-process tier opts out.
     return jax
 
 

@@ -51,6 +51,16 @@ TPU-native redesign, same two properties, different machinery:
    skeleton is still replicated, exactly as the non-ParSymbFact path
    replicates it after pddistribute in the reference.
 
+Measured honestly (docs/mesh_analysis_4proc_n110592.json): non-root
+ranks keep the root+bcast tier's ~2-3x time/peak wins, and the
+ordering+symbolic WORK is genuinely O(part) per rank — but the root's
+wall time is currently at parity with the root-analysis tier and its
+transient peak is HIGHER, because the critical path at this scale is
+the root-side assembly + plan build (the pddistribute-analog), which
+this tier does not distribute.  The tier's value today is the
+distributed ordering/symbolic machinery itself (the psymbfact
+capability) and the non-root properties, not a root-side speedup.
+
 Equilibration is computed distributed (the pdgsequ analog: local row
 maxima, tree-allreduced column maxima).  LargeDiag_MC64/AWPM row
 matchings are serial on rank 0 over a TRANSIENT gather of the scaled
@@ -80,16 +90,20 @@ from superlu_dist_tpu.utils.errors import SuperLUError
 # collective helpers over the (sum/bcast-only) tree
 # ---------------------------------------------------------------------------
 
-def _stack_allreduce(tc: TreeComm, vec: np.ndarray) -> np.ndarray:
-    """Every rank's `vec` stacked to (n_ranks, len) on all ranks — the
-    building block for max/min reductions the sum-typed tree lacks."""
-    buf = np.zeros((tc.n_ranks, len(vec)))
-    buf[tc.rank] = vec
-    return tc.allreduce_sum_any(buf)
-
-
-def _allreduce_max(tc: TreeComm, vec: np.ndarray) -> np.ndarray:
-    return _stack_allreduce(tc, vec).max(axis=0)
+def _allreduce_max(tc: TreeComm, vec: np.ndarray,
+                   chunk: int = 1 << 16) -> np.ndarray:
+    """Elementwise max across ranks over the sum-typed tree: ranks
+    stack CHUNKS into disjoint slots and reduce, so the transient
+    buffer is O(P·chunk), never O(P·n) — the module's O(part)-memory
+    property must survive its own collectives."""
+    vec = np.asarray(vec, dtype=np.float64)
+    out = np.empty(len(vec))
+    for lo in range(0, len(vec), chunk):
+        hi = min(lo + chunk, len(vec))
+        buf = np.zeros((tc.n_ranks, hi - lo))
+        buf[tc.rank] = vec[lo:hi]
+        out[lo:hi] = tc.allreduce_sum_any(buf).max(axis=0)
+    return out
 
 
 def _gather_concat(tc: TreeComm, arr: np.ndarray, root: int = 0,
@@ -161,7 +175,8 @@ def _pgsequ(tc: TreeComm, a_loc: DistributedCSR):
     np.maximum.at(rowmax_loc, rows, absa)
     rowmax = np.zeros(n)
     rowmax[a_loc.fst_row:a_loc.fst_row + a_loc.m_loc] = rowmax_loc
-    rowmax = _allreduce_max(tc, rowmax)
+    # rows are rank-disjoint: a disjoint-slot sum-reduce IS the max
+    rowmax = tc.allreduce_sum_any(rowmax)
     if np.any(rowmax == 0):
         raise SuperLUError(
             f"row {int(np.argmin(rowmax != 0))} of A is exactly zero")
@@ -415,21 +430,8 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
             "ParSymbFact computes its own distributed nested-dissection "
             "ordering; col_perm must be ND/METIS_AT_PLUS_A")
     if P == 1 or n < 64 * P:
-        a_root = gather_distributed(tc, a_loc, root=0)
-        blob = None
-        sym_keep = None
-        if tc.rank == 0:
-            lu, bvals, _ = analyze(options, a_root, stats=stats)
-            # non-root needs the analysis products only (the
-            # _pgssvx_mesh strip/restore discipline)
-            lu.a = None
-            sym_keep = (lu.a_sym_indptr, lu.a_sym_indices)
-            lu.a_sym_indptr = lu.a_sym_indices = None
-            blob = (lu, bvals)
-        lu, bvals = tc.bcast_obj(blob, root=0)
-        if tc.rank == 0:
-            lu.a_sym_indptr, lu.a_sym_indices = sym_keep
-        return lu, bvals
+        from superlu_dist_tpu.parallel.pgssvx import root_analyze_bcast
+        return root_analyze_bcast(tc, options, a_loc, stats)
 
     complex_in = np.issubdtype(np.asarray(a_loc.data).dtype,
                                np.complexfloating)
@@ -463,22 +465,22 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
     with stats.timer("ROWPERM"):
         rp = options.row_perm
         if rp in (RowPerm.LargeDiag_MC64, RowPerm.LargeDiag_AWPM):
+            from superlu_dist_tpu.parallel.pgssvx import bcast_result
             from superlu_dist_tpu.rowperm.matching import (
                 approximate_weight_matching, maximum_product_matching)
             scaled = DistributedCSR(n=n, m_loc=m_loc, fst_row=lo_row,
                                     indptr=a_loc.indptr,
                                     indices=a_loc.indices, data=vals)
             a1_root = gather_distributed(tc, scaled, root=0)
-            blob = None
-            if tc.rank == 0:
+
+            def _match():
                 if rp == RowPerm.LargeDiag_MC64:
-                    row_order, r1, c1 = maximum_product_matching(a1_root)
-                else:
-                    row_order = approximate_weight_matching(a1_root)
-                    r1 = c1 = np.ones(n)
-                blob = (row_order, r1, c1)
-                del a1_root
-            row_order, r1, c1 = tc.bcast_obj(blob, root=0)
+                    return maximum_product_matching(a1_root)
+                return (approximate_weight_matching(a1_root),
+                        np.ones(n), np.ones(n))
+
+            row_order, r1, c1 = bcast_result(tc, _match)
+            del a1_root
         elif rp == RowPerm.MY_PERMR:
             row_order = np.asarray(options.user_perm_r, dtype=np.int64)
             r1 = c1 = np.ones(n)
@@ -587,16 +589,16 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
         vw_full = np.zeros(cur_n)
         vw_full[blocks[tc.rank][0]:blocks[tc.rank][1]] = cur_w
         vw_full = tc.reduce_sum_any(vw_full, root=0)
-        clabels = None
-        if tc.rank == 0:
+        from superlu_dist_tpu.parallel.pgssvx import bcast_result
+
+        def _bisect():
             from superlu_dist_tpu.sparse.formats import coo_to_csr
             cg = coo_to_csr(cur_n, cur_n, er.astype(np.int64),
                             ec.astype(np.int64), ew)
-            clabels, _nsep = _coarse_bisect(
-                cur_n, cg.indptr, cg.indices, vw_full, P)
-        clabels = tc.bcast_any(
-            clabels if clabels is not None
-            else np.zeros(cur_n, dtype=np.int64), root=0).astype(np.int64)
+            return _coarse_bisect(cur_n, cg.indptr, cg.indices,
+                                  vw_full, P)[0]
+
+        clabels = np.asarray(bcast_result(tc, _bisect), dtype=np.int64)
         # project through the contraction maps: label of fine vertex v
         lab = clabels
         for fmap in reversed(maps):
@@ -615,24 +617,28 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
     part_mask = lab[pr] == tc.rank
     ppr, ppc, ppv = pr[part_mask], pc[part_mask], pv[part_mask]
 
+    sr0, sc0, sv0 = pr[sep_mask], pc[sep_mask], pv[sep_mask]
     with stats.timer("SYMBFACT"):
-        out = _part_symbolic(tc, n, P, lab, ppr, ppc, ppv,
-                             pr[sep_mask], pc[sep_mask], pv[sep_mask],
-                             options, vdtype)
-    if tc.rank == 0:
-        (sf, bvals) = out
+        ctx = _part_symbolic(tc, n, P, lab, ppr, ppc, ppv, options,
+                             vdtype)
+
+    def _finish_root():
+        # root-only: separator symbolic + assembly + plan.  Runs inside
+        # bcast_result so an assembly failure reaches every rank
+        # instead of stranding them in the skeleton broadcast.
+        sf, bvals = _assemble_root(ctx, n, P, lab, sr0, sc0, sv0,
+                                   options, vdtype)
         with stats.timer("DIST"):
             plan = build_plan(sf, min_bucket=options.min_bucket,
                               growth=options.bucket_growth)
-        lu = LUFactorization(
-            n=n, options=options, equed=equed, dr=dr, dc=dc, r1=r1, c1=c1,
-            row_order=row_order, col_order=None, sf=sf, plan=plan,
-            numeric=None, anorm=anorm, a=None,
-            a_sym_indptr=None, a_sym_indices=None)
-        blob = (lu, bvals)
-    else:
-        blob = None
-    return tc.bcast_obj(blob, root=0)
+        return LUFactorization(
+            n=n, options=options, equed=equed, dr=dr, dc=dc, r1=r1,
+            c1=c1, row_order=row_order, col_order=None, sf=sf,
+            plan=plan, numeric=None, anorm=anorm, a=None,
+            a_sym_indptr=None, a_sym_indices=None), bvals
+
+    from superlu_dist_tpu.parallel.pgssvx import bcast_result
+    return bcast_result(tc, _finish_root)
 
 
 def _block_bounds(tc, m_mine):
@@ -670,15 +676,12 @@ def _local_match(m, er_loc, ec, ew, block):
     return out
 
 
-def _part_symbolic(tc, n, P, lab, pr, pc, pv, sr0, sc0, sv0, options,
-                   vdtype):
-    """Per-part bordered symbolic + root-side separator symbolic +
-    assembly.  Returns (sf, bvals) on rank 0, None elsewhere.
+def _part_symbolic(tc, n, P, lab, pr, pc, pv, options, vdtype):
+    """Per-part bordered symbolic + the piece gathers.  Returns the
+    gathered context for _assemble_root on rank 0, None elsewhere.
     Everything rank-local here is O(part), the psymbfact property."""
     from superlu_dist_tpu import native
     from superlu_dist_tpu.ordering.dissection import bfs_nd
-    from superlu_dist_tpu.symbolic.symbfact import (
-        _finish, amalgamate_supernodes)
 
     relax = options.relax
     max_supernode = options.max_supernode
@@ -780,6 +783,23 @@ def _part_symbolic(tc, n, P, lab, pr, pc, pv, sr0, sc0, sv0, options,
 
     if tc.rank != 0:
         return None
+    return {"g": g, "snp_offs": snp_offs, "sep_start": sep_start}
+
+
+def _assemble_root(ctx, n, P, lab, sr0, sc0, sv0, options, vdtype):
+    """Root-only tail of the distributed symbolic: separator-block
+    symbolic with the parts' boundary cliques folded in, then global
+    assembly into one SymbolicFact + the permuted values.  Split from
+    _part_symbolic so panalyze can run it under the exception-shipping
+    broadcast."""
+    from superlu_dist_tpu.symbolic.symbfact import (
+        _finish, amalgamate_supernodes)
+
+    relax = options.relax
+    max_supernode = options.max_supernode
+    g = ctx["g"]
+    snp_offs = ctx["snp_offs"]
+    sep_start = ctx["sep_start"]
 
     # ---- root: separator block symbolic ---------------------------------
     # separator vertices ordered by (deeper tree node first, then label);

@@ -80,9 +80,55 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
     return SparseCSR(n, n, indptr, idx.astype(np.int64), vals)
 
 
+def bcast_result(tc: TreeComm, fn, root: int = 0):
+    """Run `fn()` on `root` and broadcast its result; a root-side
+    exception is SHIPPED and re-raised on every rank instead of leaving
+    the peers deadlocked in the broadcast (every root-serial section of
+    the distributed tiers routes through this)."""
+    payload = None
+    if tc.rank == root:
+        try:
+            payload = (None, fn())
+        except Exception as exc:
+            payload = (exc, None)
+    err, result = tc.bcast_obj(payload, root=root)
+    if err is not None:
+        raise err
+    return result
+
+
+def root_analyze_bcast(tc: TreeComm, options, a_loc: DistributedCSR,
+                       stats, lu=None):
+    """Gather the distributed rows on root, run the serial analysis
+    there (honoring `lu` Fact-reuse), and broadcast the analyzed
+    skeleton STRIPPED of the global matrix and the symmetrized-pattern
+    copies (restored on root afterwards — they only serve future
+    SamePattern reuse checks there).  Returns (lu, bvals) on every
+    rank.  The one implementation behind _pgssvx_mesh's default tier,
+    panalyze's small-problem fallback, and the A/B measurement script.
+    """
+    from superlu_dist_tpu.drivers.gssvx import analyze
+
+    a_root = gather_distributed(tc, a_loc, root=0)
+    sym_keep = None
+    box = {}
+
+    def _analyze():
+        lu2, bvals, _ = analyze(options, a_root, lu=lu, stats=stats)
+        lu2.a = None
+        box["sym"] = (lu2.a_sym_indptr, lu2.a_sym_indices)
+        lu2.a_sym_indptr = lu2.a_sym_indices = None
+        return lu2, bvals
+
+    lu2, bvals = bcast_result(tc, _analyze)
+    if tc.rank == 0:
+        lu2.a_sym_indptr, lu2.a_sym_indices = box["sym"]
+    return lu2, bvals
+
+
 def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
-           b_loc: np.ndarray, root: int = 0, grid=None, lu_out=None,
-           replicate_analysis: bool = False):
+           b_loc: np.ndarray, root: int = 0, grid=None, lu=None,
+           lu_out=None, replicate_analysis: bool = False):
     """Collectively solve op(A)·X = B from block-row distributed input.
 
     b_loc: (m_loc,) or (m_loc, nrhs) — this rank's block rows of B.
@@ -112,6 +158,14 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     LUFactorization handle (the reference's caller-owned LUstruct — on
     the fallback tier only the root has one) and lu_out["stats"] the
     factorization Stats (both tiers; on the fallback tier, root only).
+
+    `lu`: a prior handle (this rank's lu_out["lu"] from an earlier
+    call) activating options.fact's reuse tiers on the distributed
+    input, the reference's time-stepping loop over NR_loc
+    (EXAMPLE/pddrive1.c, pdgssvx.c Fact dispatch): SamePattern /
+    SamePattern_SameRowPerm reuse the analysis products and refactor
+    with the new values; FACTORED skips straight to the collective
+    solve on the existing sharded factors.
     """
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
@@ -133,7 +187,7 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
 
     if grid is not None:
         return _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
-                            lu_out=lu_out,
+                            lu=lu, lu_out=lu_out,
                             replicate_analysis=replicate_analysis)
 
     a_root = gather_distributed(tc, a_loc, root=root)
@@ -145,11 +199,12 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     info = np.zeros(1)
     solve_fn = None
     if tc.rank == root:
-        # refinement happens distributed below — root factors only
+        # refinement happens distributed below — root factors only;
+        # `lu` threads the Fact reuse tiers through (root-held handle)
         opts0 = dataclasses.replace(options,
                                     iter_refine=IterRefine.NOREFINE)
         x_r, lu, stats, info_r = gssvx(
-            opts0, a_root, b_full if nrhs > 1 else b_full[:, 0])
+            opts0, a_root, b_full if nrhs > 1 else b_full[:, 0], lu=lu)
         info[0] = float(info_r)
         if lu_out is not None:
             lu_out["lu"] = lu
@@ -189,7 +244,7 @@ def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs):
 
 
 def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
-                 lu_out=None, replicate_analysis=False):
+                 lu=None, lu_out=None, replicate_analysis=False):
     """Distributed-factors tier: rank 0 assembles the global analysis
     input and runs the host analysis ONCE, then broadcasts the analyzed
     skeleton (symbolic + plan + transforms + permuted values) over the
@@ -211,7 +266,8 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
 
     from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
-    from superlu_dist_tpu.utils.options import IterRefine, Trans
+    from superlu_dist_tpu.utils.errors import SuperLUError
+    from superlu_dist_tpu.utils.options import Fact, IterRefine, Trans
     from superlu_dist_tpu.utils.stats import Stats
 
     n = a_loc.n
@@ -225,7 +281,23 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
     # non-root rank never materializes A, only the analysis products
     opts0 = dataclasses.replace(options, iter_refine=IterRefine.NOREFINE)
     stats = Stats()
-    if replicate_analysis:
+    fact = getattr(options, "fact", Fact.DOFACT)
+    if fact == Fact.FACTORED:
+        # solve-only on the existing sharded factors (every rank holds
+        # ITS handle from a prior call's lu_out — pdgssvx's Fact=
+        # FACTORED over the grid); the solves below are collective, so
+        # a missing handle must fail on EVERY rank, not strand the
+        # others inside the SPMD solve
+        ok = np.zeros(1)
+        ok[0] = 1.0 if (lu is not None and lu.numeric is not None) \
+            else 0.0
+        ok = tc.allreduce_sum_any(ok)
+        if int(ok[0]) != tc.n_ranks:
+            raise SuperLUError(
+                "Fact=FACTORED requires EVERY rank's prior lu handle "
+                f"({int(ok[0])}/{tc.n_ranks} ranks have one)")
+        info_r = 0
+    elif replicate_analysis:
         a_all = gather_distributed(tc, a_loc, all_ranks=True)
         lu, bvals, _ = analyze(opts0, a_all, stats=stats)
         lu.a = None
@@ -236,24 +308,11 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
         from superlu_dist_tpu.parallel.panalysis import panalyze
         lu, bvals = panalyze(tc, opts0, a_loc, stats=stats)
     else:
-        a_root = gather_distributed(tc, a_loc, root=0)
-        blob = None
-        sym_keep = None
-        if tc.rank == 0:
-            lu, bvals, _ = analyze(opts0, a_root, stats=stats)
-            # the global matrix and the symmetrized-pattern copies stay
-            # on root (the pattern arrays only serve future SamePattern
-            # reuse checks there); non-root receives the analysis
-            # PRODUCTS — plan/symbolic index maps + permuted values,
-            # O(nnz) data but no global CSR and no analysis work
-            lu.a = None
-            sym_keep = (lu.a_sym_indptr, lu.a_sym_indices)
-            lu.a_sym_indptr = lu.a_sym_indices = None
-            blob = (lu, bvals)
-        lu, bvals = tc.bcast_obj(blob, root=0)
-        if tc.rank == 0:
-            lu.a_sym_indptr, lu.a_sym_indices = sym_keep
-    info_r = factorize_numeric(lu, bvals, stats, grid=grid)
+        # `lu` (root's prior handle) activates the SamePattern reuse
+        # tiers inside analyze
+        lu, bvals = root_analyze_bcast(tc, opts0, a_loc, stats, lu=lu)
+    if fact != Fact.FACTORED:
+        info_r = factorize_numeric(lu, bvals, stats, grid=grid)
     if lu_out is not None:
         lu_out["lu"] = lu
         lu_out["stats"] = stats

@@ -39,12 +39,27 @@ def machine_fingerprint() -> str:
     import platform
 
     bits = [platform.machine()]
+    platforms = os.environ.get("JAX_PLATFORMS", "")
     try:
         import jax
         import jaxlib
         bits += [jax.__version__, jaxlib.__version__]
+        # the EFFECTIVE platform selection: in-process
+        # jax.config.update("jax_platforms", "cpu") overrides the env
+        # (the session env pins axon globally, so env alone cannot
+        # distinguish a CPU-pinned worker from a TPU bench)
+        platforms = getattr(jax.config, "jax_platforms", None) or platforms
     except Exception:
         pass
+    # Platform FLAVOR: a process with the TPU/axon plugin active writes
+    # XLA:CPU host executables with different codegen preferences (e.g.
+    # +prefer-no-scatter) than a pure-CPU process on the SAME machine +
+    # jaxlib.  A CPU-only run that disk-loads such an entry while a peer
+    # rank compiles fresh executes a DIFFERENT collective schedule —
+    # observed as gloo "preamble.length <= op.nbytes" aborts in the
+    # 2-process mesh tests (r5).  Scope the cache by the axes that
+    # select the flavor so the flavors never share a directory.
+    bits += [str(platforms), os.environ.get("XLA_FLAGS", "")]
     try:
         seen = set()
         with open("/proc/cpuinfo") as fh:

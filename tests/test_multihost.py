@@ -84,7 +84,7 @@ def _communicate_all(procs, timeout, shm=None):
 
 
 _PGSSVX_WORKER = r"""
-import sys, time
+import os, sys, time
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 shm = sys.argv[4]; ngrid = int(sys.argv[5])
 from superlu_dist_tpu.parallel.mhboot import boot, attach_tree
@@ -147,6 +147,46 @@ big_lp, _ = max(fronts, key=lambda p: p[0].size)
 assert len(big_lp.sharding.device_set) == nproc, big_lp.sharding
 local = sum(s.data.size for s in big_lp.addressable_shards)
 assert local < big_lp.size, (local, big_lp.size)
+
+if os.environ.get("PGX_REUSE"):
+    # Fact-reuse tiers over the grid (the pddrive1/pddrive2 time-
+    # stepping loops at NR_loc input, EXAMPLE/pddrive1.c):
+    # 1) FACTORED — same factors, new rhs, collective solve only
+    from superlu_dist_tpu.utils.options import Fact
+    import dataclasses as _dc
+    xt2 = np.random.default_rng(11).standard_normal(n)
+    b2 = a.matvec(xt2)
+    b2_loc = b2[mine.fst_row:mine.fst_row + mine.m_loc]
+    x2, info2 = pgssvx(tc, Options(fact=Fact.FACTORED), mine, b2_loc,
+                       grid=grid, lu=lu)
+    assert info2 == 0
+    r2 = float(np.linalg.norm(b2 - a.matvec(x2)) / np.linalg.norm(b2))
+    assert r2 < 1e-10, r2
+    note(f"factored leg ok {r2:.2e}")
+    # 2) SamePattern_SameRowPerm — new values, analysis products reuse
+    #    (only the root holds the reusable skeleton pieces; other
+    #    ranks pass their handle, which the root-analysis tier ignores)
+    mine3 = _dc.replace(mine, data=np.asarray(mine.data) * 1.7)
+    a3 = a.__class__(a.n_rows, a.n_cols, a.indptr, a.indices,
+                     a.data * 1.7)
+    b3 = a3.matvec(xt2)
+    b3_loc = b3[mine.fst_row:mine.fst_row + mine.m_loc]
+    out3 = {}
+    x3, info3 = pgssvx(tc, Options(fact=Fact.SamePattern_SameRowPerm,
+                                   relax=128, max_supernode=512,
+                                   min_bucket=32, bucket_growth=1.3,
+                                   amalg_tol=1.2),
+                       mine3, b3_loc, grid=grid, lu=lu, lu_out=out3)
+    assert info3 == 0
+    r3 = float(np.linalg.norm(b3 - a3.matvec(x3)) / np.linalg.norm(b3))
+    assert r3 < 1e-10, r3
+    st3 = out3["stats"]
+    if pid == 0:
+        # the reuse contract: symbolic + plan phases drop to ~0
+        assert st3.utime.get("SYMBFACT", 0) < 0.05, st3.utime
+        assert st3.utime.get("DIST", 0) < 0.05, st3.utime
+    note(f"samepattern leg ok {r3:.2e}")
+
 tc.close(unlink=pid == 0)
 print(f"proc {pid} pgssvx-mesh ok n={n} resid={resid:.2e}", flush=True)
 """
@@ -179,6 +219,15 @@ def test_pgssvx_mesh_par_symb_fact(tmp_path):
     out sharded, solve to 1e-10, through the same driver surface."""
     _run_pgssvx_mesh(tmp_path, nproc=4, ngrid=24, timeout=900,
                      extra_env={"SLU_TPU_PAR_SYMB_FACT": "1"})
+
+
+def test_pgssvx_mesh_reuse_tiers(tmp_path):
+    """Fact reuse over the distributed-factors tier: FACTORED re-solves
+    on the existing sharded factors; SamePattern_SameRowPerm refactors
+    new values with SYMBFACT+DIST ~ 0 (the reference's pddrive1/2
+    time-stepping loops at NR_loc input)."""
+    _run_pgssvx_mesh(tmp_path, nproc=2, ngrid=24, timeout=900,
+                     extra_env={"PGX_REUSE": "1"})
 
 
 def test_pgssvx_mesh_two_processes_small(tmp_path):
