@@ -68,6 +68,8 @@ def _rank_body(mode, name, nranks, rank, part, q):
         t = time.perf_counter() - t0
         assert lu.plan is not None and len(bvals) > 0
         q.put({"rank": rank, "mode": mode, "analysis_seconds": round(t, 3),
+               "nnz_L": int(lu.sf.nnz_L),          # ordering quality:
+               "struct_flops": float(lu.sf.flops),  # parsymb vs serial ND
                "vm_rss_mb": round(_mem_mb("VmRSS"), 1),
                "vm_hwm_mb": round(_mem_mb("VmHWM"), 1),
                "baseline_mb": round(base_mb, 1),

@@ -47,7 +47,7 @@ _KEY_ALIAS = {
     "Fact": "fact", "Equil": "equil", "ColPerm": "col_perm",
     "RowPerm": "row_perm", "ReplaceTinyPivot": "replace_tiny_pivot",
     "IterRefine": "iter_refine", "Trans": "trans", "DiagInv": "diag_inv",
-    "PrintStat": "print_stat",
+    "PrintStat": "print_stat", "ParSymbFact": "par_symb_fact",
 }
 _ENUM_FIELDS = {
     "fact": _slu.Fact, "col_perm": _slu.ColPerm, "row_perm": _slu.RowPerm,

@@ -56,7 +56,9 @@ int slu_tpu_free_handle(int64_t handle);
 /* ---- full-surface API (the superlu_c2f_dwrap.c:51-327 analog) ---------
  * Option handles carry the reference's superlu_dist_options_t surface.
  * Keys accept reference names ("Fact", "Equil", "ColPerm", "RowPerm",
- * "ReplaceTinyPivot", "IterRefine", "Trans", "DiagInv", "PrintStat") or
+ * "ReplaceTinyPivot", "IterRefine", "Trans", "DiagInv", "PrintStat",
+ * "ParSymbFact" — the distributed-analysis tier of the multi-process
+ * driver, parallel/panalysis.py) or
  * native field names (e.g. "relax", "max_supernode", "factor_dtype").
  * Values are strings: enum member names ("METIS_AT_PLUS_A", "NOTRANS",
  * "SamePattern", ...), "YES"/"NO" for flags, or numbers.

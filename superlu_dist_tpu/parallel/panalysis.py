@@ -107,21 +107,33 @@ def _allreduce_max(tc: TreeComm, vec: np.ndarray,
 
 
 def _gather_concat(tc: TreeComm, arr: np.ndarray, root: int = 0,
-                   all_ranks: bool = False, dtype=np.float64):
+                   all_ranks: bool = False, dtype=np.float64,
+                   window: int = 1 << 21):
     """Concatenate every rank's 1-D array in rank order (on root, or on
-    every rank) via disjoint-slot sum-reduction."""
+    every rank) via WINDOWED disjoint-slot sum-reduction: only the
+    receiver materializes the O(total) result; every other rank's
+    transient is O(window) — the gathers must not break the module's
+    O(part)-per-rank memory property."""
     counts = np.zeros(tc.n_ranks)
     counts[tc.rank] = len(arr)
     counts = tc.allreduce_sum_any(counts)
     offs = np.zeros(tc.n_ranks + 1, dtype=np.int64)
     offs[1:] = np.cumsum(counts).astype(np.int64)
-    buf = np.zeros(int(offs[-1]), dtype=dtype)
-    buf[offs[tc.rank]:offs[tc.rank + 1]] = arr
+    total = int(offs[-1])
+    my_lo, my_hi = int(offs[tc.rank]), int(offs[tc.rank + 1])
     op = tc.allreduce_sum_any if all_ranks else tc.reduce_sum_any
-    buf = op(buf, root=root)
-    if not all_ranks and tc.rank != root:
-        return None, offs
-    return buf, offs
+    keep = all_ranks or tc.rank == root
+    out = np.empty(total, dtype=dtype) if keep else None
+    for lo in range(0, total, window):
+        hi = min(lo + window, total)
+        buf = np.zeros(hi - lo, dtype=dtype)
+        a, b = max(my_lo, lo), min(my_hi, hi)
+        if a < b:
+            buf[a - lo:b - lo] = arr[a - my_lo:b - my_lo]
+        buf = op(buf, root=root)
+        if keep:
+            out[lo:hi] = buf
+    return out, offs
 
 
 def _route(tc: TreeComm, dest: np.ndarray, payloads: dict):
@@ -821,6 +833,9 @@ def _assemble_root(ctx, n, P, lab, sr0, sc0, sv0, options, vdtype):
     snp_all = g["snp"].astype(np.int64)
     rcnt_all = g["rcnt"].astype(np.int64)
     rflat_all = g["rflat"].astype(np.int64)
+    # the float64 transport copies are dead once decoded — the root's
+    # transient peak is THE assembly cost, keep it one copy per payload
+    del g["snw"], g["snp"], g["rcnt"], g["rflat"]
     rows_split = np.split(rflat_all, np.cumsum(rcnt_all)[:-1]) \
         if len(rcnt_all) else []
     clique_r, clique_c = [], []
@@ -868,6 +883,7 @@ def _assemble_root(ctx, n, P, lab, sr0, sc0, sv0, options, vdtype):
         return out
 
     sn_rows = [dec_rows(r) for r in rows_split]
+    del rows_split, rflat_all          # decoded copies supersede them
     sn_rows += [np.asarray(r, dtype=np.int64) + sep_start
                 for r in sn_rows_s]
     # parents: per-part ids shift by the rank's supernode offset; local
@@ -919,6 +935,7 @@ def _assemble_root(ctx, n, P, lab, sr0, sc0, sv0, options, vdtype):
     pcnt = g["pcnt"].astype(np.int64)
     pcol_enc = g["pcol"].astype(np.int64)
     pval = g["pval"]
+    del g["pcnt"], g["pcol"], g["pval"]
     # separator rows' pattern (root-held), in final labels
     srow_fin = sep_final_pos[sr0]
     scol_fin = np.where(lab[sc0] < 0, sep_final_pos[sc0], -1)
@@ -940,6 +957,7 @@ def _assemble_root(ctx, n, P, lab, sr0, sc0, sv0, options, vdtype):
     np.cumsum(counts, out=indptr[1:])
     indices = np.concatenate([pcol_fin, scol_fin])
     bvals = np.concatenate([pval, sv_fin]).astype(vdtype)
+    del pcol_enc, pcol_fin, scol_fin, pval, sv_fin
     # sort within each row by final column
     rowid = np.repeat(np.arange(n), counts)
     o = np.lexsort((indices, rowid))

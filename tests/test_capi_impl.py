@@ -45,6 +45,8 @@ def test_options_registry_contract():
     assert ci.opt_get(h, "Equil") == "NO"
     assert ci.opt_set(h, "relax", "12") == 0
     assert ci.opt_get(h, "relax") == "12"
+    assert ci.opt_set(h, "ParSymbFact", "YES") == 0
+    assert ci.opt_get(h, "ParSymbFact") == "YES"
     assert ci.opt_set(h, "NoSuchKey", "1") == ci._BAD_KEY
     assert ci.opt_set(h, "ColPerm", "NOT_AN_ORDERING") == ci._BAD_VALUE
     assert ci.opt_set(999_999, "Equil", "NO") == ci._BAD_HANDLE
