@@ -11,6 +11,15 @@ timeout + compile cache), attaches the shared-memory tree domain for
 the host-side analysis collectives, and calls `pgssvx(..., grid=...)`.
 
     python examples/pddrive_grid.py [matrix.rua] [--nproc 2]
+                                    [--parsymb] [--resolve]
+
+--parsymb selects the distributed analysis (options ParSymbFact: the
+get_perm_c_parmetis + psymbfact shape, parallel/panalysis.py) — no
+rank assembles the full graph or does the full symbolic work.
+--resolve appends the reference's pddrive1 time-stepping loop: a
+FACTORED re-solve with a new rhs on the SAME sharded factors, then a
+SamePattern_SameRowPerm refactorization with new values (SYMBFACT and
+DIST drop to ~0; EXAMPLE/pddrive1.c / pddrive2.c over NR_loc input).
 """
 
 import glob
@@ -22,16 +31,18 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 _WORKER = r"""
+import dataclasses
 import sys
 pid = int(sys.argv[1]); nproc = int(sys.argv[2]); port = sys.argv[3]
 shm = sys.argv[4]; path = sys.argv[5]
+parsymb = sys.argv[6] == "1"; resolve = sys.argv[7] == "1"
 from superlu_dist_tpu.parallel.mhboot import boot, attach_tree
 boot(nproc, pid, port)
 import numpy as np
 from superlu_dist_tpu.parallel.grid import gridinit_multihost
 from superlu_dist_tpu.parallel.dist import distribute_rows
 from superlu_dist_tpu.parallel.pgssvx import pgssvx
-from superlu_dist_tpu.utils.options import Options
+from superlu_dist_tpu.utils.options import Fact, Options
 
 grid = gridinit_multihost(1, nproc)
 if path == "@poisson2d":
@@ -48,18 +59,58 @@ parts = distribute_rows(a, nproc)
 mine = parts[pid]
 xt = np.random.default_rng(0).standard_normal(n)
 b = a.matvec(xt)
+opts = Options(par_symb_fact=parsymb)
 out = {}
-x, info = pgssvx(tc, Options(), mine,
+x, info = pgssvx(tc, opts, mine,
                  b[mine.fst_row:mine.fst_row + mine.m_loc],
                  grid=grid, lu_out=out)
 assert info == 0, info
 resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
 big_lp, _ = max(out["lu"].numeric.fronts, key=lambda p: p[0].size)
 assert len(big_lp.sharding.device_set) == nproc    # factors span ranks
-tc.close(unlink=pid == 0)
 print(f"rank {pid}: residual {resid:.2e}; largest front sharded over "
-      f"{len(big_lp.sharding.device_set)} process devices", flush=True)
+      f"{len(big_lp.sharding.device_set)} process devices"
+      + (" [ParSymbFact analysis]" if parsymb else ""), flush=True)
 assert resid < 1e-10, resid
+
+if resolve:
+    # pddrive1: same factors, new rhs — collective solve only
+    lu = out["lu"]
+    b2 = a.matvec(xt * 3.0)
+    x2, info2 = pgssvx(tc, Options(fact=Fact.FACTORED), mine,
+                       b2[mine.fst_row:mine.fst_row + mine.m_loc],
+                       grid=grid, lu=lu)
+    assert info2 == 0
+    r2 = float(np.linalg.norm(b2 - a.matvec(x2)) / np.linalg.norm(b2))
+    if parsymb:
+        # a panalyze skeleton records no value-gather map, so the
+        # SamePattern tiers are serial-analysis-only (analyze() raises
+        # explicitly); the FACTORED tier above works on either skeleton
+        print(f"rank {pid}: FACTORED re-solve {r2:.2e} "
+              "(SamePattern reuse needs a serial-analysis skeleton)",
+              flush=True)
+        assert r2 < 1e-10
+        tc.close(unlink=pid == 0)
+        raise SystemExit(0)
+    # pddrive2: same pattern + row perm, NEW VALUES — refactor with the
+    # analysis products reused
+    vals2 = np.asarray(mine.data) * 1.5
+    mine2 = dataclasses.replace(mine, data=vals2)
+    a2 = a.__class__(n, n, a.indptr, a.indices, a.data * 1.5)
+    b3 = a2.matvec(xt)
+    out3 = {}
+    x3, info3 = pgssvx(tc, Options(fact=Fact.SamePattern_SameRowPerm),
+                       mine2, b3[mine.fst_row:mine.fst_row + mine.m_loc],
+                       grid=grid, lu=lu, lu_out=out3)
+    assert info3 == 0
+    r3 = float(np.linalg.norm(b3 - a2.matvec(x3)) / np.linalg.norm(b3))
+    st = out3["stats"]
+    print(f"rank {pid}: FACTORED re-solve {r2:.2e}; SamePattern "
+          f"refactor {r3:.2e} (SYMBFACT {st.utime.get('SYMBFACT', 0):.2f}s "
+          f"DIST {st.utime.get('DIST', 0):.2f}s)", flush=True)
+    assert r2 < 1e-10 and r3 < 1e-10
+
+tc.close(unlink=pid == 0)
 """
 
 _REF_FIXTURE = "/root/reference/EXAMPLE/g20.rua"
@@ -72,6 +123,10 @@ def main():
                     help="matrix file (HB/RB/MM); defaults to the "
                          "reference g20.rua fixture, else @poisson2d")
     ap.add_argument("--nproc", type=int, default=2)
+    ap.add_argument("--parsymb", action="store_true",
+                    help="distributed analysis (options ParSymbFact)")
+    ap.add_argument("--resolve", action="store_true",
+                    help="append the pddrive1/2 reuse legs")
     ap.add_argument("--backend", default=None,
                     help="accepted for _common.py symmetry; unused here")
     ns = ap.parse_args()          # rejects unknown --flags, supports '='
@@ -96,7 +151,8 @@ def main():
             os.path.dirname(os.path.abspath(__file__)), ".."))
         env.pop("XLA_FLAGS", None)
         procs = [subprocess.Popen(
-            [sys.executable, wf, str(i), str(nproc), str(port), shm, path],
+            [sys.executable, wf, str(i), str(nproc), str(port), shm, path,
+             "1" if ns.parsymb else "0", "1" if ns.resolve else "0"],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
             for i in range(nproc)]
         try:
