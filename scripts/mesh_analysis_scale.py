@@ -138,19 +138,41 @@ def main():
 
     out = {"n": n, "nnz": int(sum(p.nnz_loc for p in parts)),
            "nranks": nranks}
+    # partial re-runs (MAS_MODES) merge into the existing artifact so a
+    # modes subset never clobbers previously measured sections
+    path = os.path.join(REPO, "docs", f"mesh_analysis_4proc_n{n}.json")
+    if os.path.exists(path):
+        with open(path) as fh:
+            prior = json.load(fh)
+        for k in ("replicated", "root_bcast", "parsymb"):
+            if k in prior:
+                out[k] = prior[k]
     modes = tuple(os.environ.get(
         "MAS_MODES", "replicated,root_bcast,parsymb").split(","))
+    run_id = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
     for mode in modes:
         t0 = time.perf_counter()
         rows = _run_mode(mode, parts, nranks)
-        out[mode] = {"ranks": rows,
+        out[mode] = {"ranks": rows, "run_id": run_id,
                      "wall_seconds": round(time.perf_counter() - t0, 3)}
         print(f"[{mode}] wall={out[mode]['wall_seconds']}s  " +
               "  ".join(f"r{r['rank']}:{r['analysis_seconds']}s/"
                         f"{r['vm_hwm_mb']:.0f}MB" for r in rows),
               flush=True)
 
-    if "parsymb" in out and "root_bcast" in out:
+    def same_run(*ks):
+        # cross-section ratios are only honest when both operands were
+        # measured in the SAME run (same load, same code) — a partial
+        # MAS_MODES rerun must not mix a fresh section with a stale one
+        ids = {out[k].get("run_id") for k in ks if k in out}
+        return (all(k in out for k in ks)
+                and len(ids) == 1 and None not in ids)
+
+    for k in ("parsymb_root_time_ratio", "parsymb_root_hwm_delta_ratio",
+              "nonroot_time_ratio", "nonroot_hwm_ratio",
+              "nonroot_hwm_delta_ratio", "wall_ratio"):
+        out.pop(k, None)
+    if same_run("parsymb", "root_bcast"):
         # what the distributed analysis buys OVER the root+bcast tier:
         # the root stops doing the whole ordering+symbolic itself
         ps = out["parsymb"]["ranks"]
@@ -161,35 +183,30 @@ def main():
         out["parsymb_root_hwm_delta_ratio"] = round(
             bc0[0].get("analysis_hwm_delta_mb", float("nan"))
             / max(ps[0].get("analysis_hwm_delta_mb", 1e-9), 1e-9), 2)
-    if "replicated" not in out or "root_bcast" not in out:
-        path = os.path.join(REPO, "docs", f"mesh_analysis_4proc_n{n}.json")
-        with open(path, "w") as fh:
-            json.dump(out, fh, indent=1)
-        print("wrote", path)
-        return
-    rep = out["replicated"]["ranks"]
-    bc = out["root_bcast"]["ranks"]
-    out["nonroot_time_ratio"] = round(
-        np.mean([r["analysis_seconds"] for r in rep[1:]])
-        / max(np.mean([r["analysis_seconds"] for r in bc[1:]]), 1e-9), 2)
-    out["nonroot_hwm_ratio"] = round(
-        np.mean([r["vm_hwm_mb"] for r in rep[1:]])
-        / np.mean([r["vm_hwm_mb"] for r in bc[1:]]), 2)
-    out["nonroot_hwm_delta_ratio"] = round(
-        np.mean([r["analysis_hwm_delta_mb"] for r in rep[1:]])
-        / max(np.mean([r["analysis_hwm_delta_mb"] for r in bc[1:]]),
-              1e-9), 2)
-    # the barrier wall time: in replicated mode 4 analyses contend for
-    # the core; in bcast mode one analysis + one O(nnz) transfer
-    out["wall_ratio"] = round(out["replicated"]["wall_seconds"]
-                              / out["root_bcast"]["wall_seconds"], 2)
-    path = os.path.join(REPO, "docs", f"mesh_analysis_4proc_n{n}.json")
+    if same_run("replicated", "root_bcast"):
+        rep = out["replicated"]["ranks"]
+        bc = out["root_bcast"]["ranks"]
+        out["nonroot_time_ratio"] = round(
+            np.mean([r["analysis_seconds"] for r in rep[1:]])
+            / max(np.mean([r["analysis_seconds"] for r in bc[1:]]),
+                  1e-9), 2)
+        out["nonroot_hwm_ratio"] = round(
+            np.mean([r["vm_hwm_mb"] for r in rep[1:]])
+            / np.mean([r["vm_hwm_mb"] for r in bc[1:]]), 2)
+        out["nonroot_hwm_delta_ratio"] = round(
+            np.mean([r["analysis_hwm_delta_mb"] for r in rep[1:]])
+            / max(np.mean([r["analysis_hwm_delta_mb"] for r in bc[1:]]),
+                  1e-9), 2)
+        # the barrier wall time: in replicated mode 4 analyses contend
+        # for the core; in bcast mode one analysis + one O(nnz) transfer
+        out["wall_ratio"] = round(out["replicated"]["wall_seconds"]
+                                  / out["root_bcast"]["wall_seconds"], 2)
     with open(path, "w") as fh:
         json.dump(out, fh, indent=1)
     print("wrote", path)
     print(json.dumps({k: out[k] for k in
                       ("nonroot_time_ratio", "nonroot_hwm_ratio",
-                       "wall_ratio")}))
+                       "wall_ratio") if k in out}))
 
 
 if __name__ == "__main__":

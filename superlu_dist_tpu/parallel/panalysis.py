@@ -51,15 +51,16 @@ TPU-native redesign, same two properties, different machinery:
    skeleton is still replicated, exactly as the non-ParSymbFact path
    replicates it after pddistribute in the reference.
 
-Measured honestly (docs/mesh_analysis_4proc_n110592.json): non-root
-ranks keep the root+bcast tier's ~2-3x time/peak wins, and the
-ordering+symbolic WORK is genuinely O(part) per rank — but the root's
-wall time is currently at parity with the root-analysis tier and its
-transient peak is HIGHER, because the critical path at this scale is
-the root-side assembly + plan build (the pddistribute-analog), which
-this tier does not distribute.  The tier's value today is the
-distributed ordering/symbolic machinery itself (the psymbfact
-capability) and the non-root properties, not a root-side speedup.
+Measured at n=110,592 / 4 ranks
+(docs/mesh_analysis_4proc_n110592.json): ordering quality is at
+PARITY with the serial native ND (nnz_L 52.5M vs 53.3M, structural
+flops 162G vs 161G — the fine-level separator trimming is what closes
+this; without it the projected slab separators cost 1.9x fill), the
+non-root ranks keep the root+bcast tier's time/peak wins and O(part)
+work, and the root's transient peak is slightly BELOW the root-bcast
+tier's.  Root wall time runs ~15% behind the root-bcast tier: the
+critical path is the root-side assembly + plan build (the
+pddistribute-analog), which stays on root by design.
 
 Equilibration is computed distributed (the pdgsequ analog: local row
 maxima, tree-allreduced column maxima).  LargeDiag_MC64/AWPM row
@@ -243,24 +244,33 @@ def _bfs_order(indptr, indices, sub_nodes, start):
 
 def _coarse_bisect(n, indptr, indices, vwgt, nparts):
     """Recursive BFS-level-set bisection of the coarse graph into
-    `nparts` leaf parts.  Returns (labels, n_sep_nodes): labels[v] =
-    part id in [0, nparts) or -(sep_node_id + 1); separator tree nodes
-    are numbered so that DEEPER separators get LOWER ids (they are
-    eliminated first; the top separator is the last block).
+    `nparts` leaf parts.  Returns (labels, n_sep_nodes, part_anc):
+    labels[v] = part id in [0, nparts) or -(sep_node_id + 1);
+    separator tree nodes are numbered so that DEEPER separators get
+    LOWER ids (they are eliminated first; the top separator is the last
+    block).  part_anc[p] lists the final separator ids on part p's path
+    to the root — the ancestor sets the fine-level separator trimming
+    validates moves against.
 
     The get_perm_c_parmetis.c:255 role: build the separator tree that
     the symbolic phase partitions over."""
     labels = np.full(n, -1, dtype=np.int64)
     sep_nodes = []          # (depth, vertices) in creation order
-    # work items: (vertex subset, rank ids, depth)
-    work = [(np.arange(n, dtype=np.int64), list(range(nparts)), 0)]
+    part_anc_cre = {}       # part -> ancestor sep CREATION indices
+    # work items: (vertex subset, rank ids, depth, ancestor creation ids)
+    work = [(np.arange(n, dtype=np.int64), list(range(nparts)), 0, ())]
     while work:
-        nodes, ranks, depth = work.pop()
+        nodes, ranks, depth, anc = work.pop()
         if len(ranks) == 1:
             labels[nodes] = ranks[0]
+            part_anc_cre[ranks[0]] = anc
             continue
         if len(nodes) == 0:
-            continue        # empty rank subtree: those parts stay empty
+            # empty rank subtree: record the chain anyway so
+            # part_anc/anc_allowed coverage stays total for every rank
+            for r in ranks:
+                part_anc_cre[r] = anc
+            continue
         levels = _bfs_order(indptr, indices, nodes, int(nodes[0]))
         comp = np.concatenate(levels)
         if len(comp) < len(nodes):
@@ -270,13 +280,13 @@ def _coarse_bisect(n, indptr, indices, vwgt, nparts):
             half = len(ranks) // 2
             wc, wr = vwgt[comp].sum(), vwgt[rest].sum()
             if wc >= wr:
-                work.append((comp, ranks[:max(half, 1)], depth))
+                work.append((comp, ranks[:max(half, 1)], depth, anc))
                 work.append((rest, ranks[max(half, 1):] or ranks[:1],
-                             depth))
+                             depth, anc))
             else:
-                work.append((rest, ranks[:max(half, 1)], depth))
+                work.append((rest, ranks[:max(half, 1)], depth, anc))
                 work.append((comp, ranks[max(half, 1):] or ranks[:1],
-                             depth))
+                             depth, anc))
             continue
         # pseudo-peripheral restart for a better diameter
         levels = _bfs_order(indptr, indices, nodes, int(levels[-1][0]))
@@ -284,9 +294,9 @@ def _coarse_bisect(n, indptr, indices, vwgt, nparts):
             # clique-ish blob: no useful separator; give it to the first
             # rank half entirely (the other half gets an empty part)
             half = max(len(ranks) // 2, 1)
-            work.append((nodes, ranks[:half], depth))
+            work.append((nodes, ranks[:half], depth, anc))
             work.append((np.empty(0, dtype=np.int64), ranks[half:],
-                         depth))
+                         depth, anc))
             continue
         lw = np.array([vwgt[l].sum() for l in levels], dtype=float)
         half_ranks = len(ranks) // 2
@@ -297,15 +307,85 @@ def _coarse_bisect(n, indptr, indices, vwgt, nparts):
         left = np.concatenate(levels[:cut])
         right = (np.concatenate(levels[cut + 1:])
                  if cut + 1 < len(levels) else np.empty(0, dtype=np.int64))
+        cre = len(sep_nodes)
         sep_nodes.append((depth, sep))
-        work.append((left, ranks[:half_ranks], depth + 1))
-        work.append((right, ranks[half_ranks:], depth + 1))
+        work.append((left, ranks[:half_ranks], depth + 1, anc + (cre,)))
+        work.append((right, ranks[half_ranks:], depth + 1, anc + (cre,)))
     # separator ids: deeper first, top (depth 0) last
     order = sorted(range(len(sep_nodes)),
                    key=lambda i: -sep_nodes[i][0])
+    cre2sid = {i: sid for sid, i in enumerate(order)}
     for sid, i in enumerate(order):
         labels[sep_nodes[i][1]] = -(sid + 1)
-    return labels, len(sep_nodes)
+    part_anc = {p: [cre2sid[c] for c in anc]
+                for p, anc in part_anc_cre.items()}
+    return labels, len(sep_nodes), part_anc
+
+
+def _trim_separators(tc: TreeComm, lab, sr, sc, my_lo, my_hi, part_anc,
+                     P, passes: int = 6):
+    """Fine-graph separator refinement (the multilevel 'sep thinning'
+    step ParMETIS applies during uncoarsening): a projected separator
+    vertex whose every neighbor lies in ONE part p or in a separator on
+    p's root path moves into p — peeling a k-layer slab from both faces
+    until a ~1-layer true separator remains.  Each rank trims only the
+    vertices it owns; updates combine by disjoint-slot reduction, and a
+    verify round reverts (to separator status — always safe) the
+    higher-indexed endpoint of any cross-part edge two simultaneous
+    moves created."""
+    n = len(lab)
+    # allowed (part, separator-label) pairs: p's ancestor chain as a
+    # dense boolean table over sep ids (sep label -s-1 -> row s)
+    n_sep_ids = int(-lab.min()) if (lab < 0).any() else 0
+    allowed = np.zeros((P, n_sep_ids + 1), dtype=bool)
+    for p in range(P):
+        for s in part_anc.get(p, []):
+            if s < n_sep_ids:
+                allowed[p, s] = True
+    # my owned vertices' adjacency (CSR over the block), self-loops out
+    keep = sr != sc
+    order = np.argsort(sr[keep], kind="stable")
+    sr_s, sc_s = sr[keep][order], sc[keep][order]
+    ptr = np.searchsorted(sr_s, np.arange(my_lo, my_hi + 1))
+    row_of = sr_s - my_lo
+    for _ in range(passes):
+        moves = np.zeros(n)
+        nl = lab[sc_s]
+        # per owned row: are all part-labeled neighbors one part p?
+        big = np.where(nl >= 0, nl, P + 1)     # sentinel above any part
+        small = np.where(nl >= 0, nl, -2)      # sentinel below any part
+        pmax = np.full(my_hi - my_lo, -2, dtype=np.int64)
+        pmin = np.full(my_hi - my_lo, P + 1, dtype=np.int64)
+        np.minimum.at(pmin, row_of, big)
+        np.maximum.at(pmax, row_of, small)
+        one_part = (pmin == pmax) & (pmax >= 0)
+        # and is every separator-labeled neighbor either the vertex's
+        # own slab or an ancestor of that part?
+        vlab = lab[my_lo:my_hi]
+        is_sep_n = nl < 0
+        own = nl[is_sep_n] == vlab[row_of[is_sep_n]]
+        p_row = np.clip(pmax[row_of[is_sep_n]], 0, P - 1)
+        anc_ok = allowed[p_row, np.clip(-nl[is_sep_n] - 1, 0, n_sep_ids)]
+        sep_bad = np.zeros(my_hi - my_lo, dtype=bool)
+        np.logical_or.at(sep_bad, row_of[is_sep_n], ~(own | anc_ok))
+        movable = (vlab < 0) & one_part & ~sep_bad
+        mv = np.flatnonzero(movable)
+        moves[mv + my_lo] = pmax[mv] - vlab[mv]     # encode the delta
+        moves = tc.allreduce_sum_any(moves)
+        if not moves.any():
+            break
+        cand = lab + moves.astype(np.int64)
+        # verify: two adjacent vertices moved into different parts makes
+        # a cross-part edge — revert the higher-indexed endpoint
+        bad = ((cand[sr_s] >= 0) & (cand[sc_s] >= 0)
+               & (cand[sr_s] != cand[sc_s]))
+        revert = np.zeros(n)
+        if bad.any():
+            hi_end = np.maximum(sr_s[bad], sc_s[bad])
+            revert[hi_end] = 1.0
+        revert = tc.allreduce_sum_any(revert)
+        lab = np.where(revert > 0, lab, cand)
+    return lab
 
 
 # ---------------------------------------------------------------------------
@@ -607,15 +687,22 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
             from superlu_dist_tpu.sparse.formats import coo_to_csr
             cg = coo_to_csr(cur_n, cur_n, er.astype(np.int64),
                             ec.astype(np.int64), ew)
-            return _coarse_bisect(cur_n, cg.indptr, cg.indices,
-                                  vw_full, P)[0]
+            labels, _nsep, part_anc = _coarse_bisect(
+                cur_n, cg.indptr, cg.indices, vw_full, P)
+            return labels, part_anc
 
-        clabels = np.asarray(bcast_result(tc, _bisect), dtype=np.int64)
+        clabels, part_anc = bcast_result(tc, _bisect)
+        clabels = np.asarray(clabels, dtype=np.int64)
         # project through the contraction maps: label of fine vertex v
         lab = clabels
         for fmap in reversed(maps):
             lab = lab[fmap]
         # lab[v] >= 0: part id; < 0: separator node -(id+1), deeper first
+        # projected separators are THICK SLABS (one matching level ~
+        # doubles the width) and top-separator width enters the fill
+        # cubically — refine them on the fine graph before partitioning
+        lab = _trim_separators(tc, lab, sr, sc, my_lo, my_hi, part_anc,
+                               P)
 
     # ---- route rows to their part owners (seps to root) ------------------
     dest = np.where(lab[sr] >= 0, lab[sr], 0).astype(np.int64)

@@ -34,9 +34,13 @@ def test_coarse_bisect_separates():
     a = symmetrize_pattern(poisson2d(20))
     n = a.n_rows
     for nparts in (2, 4, 3):
-        labels, nsep = _coarse_bisect(
+        labels, nsep, part_anc = _coarse_bisect(
             n, a.indptr, a.indices, np.ones(n), nparts)
         assert labels.min() >= -nsep and labels.max() < nparts
+        assert set(part_anc) == set(range(nparts))
+        # every part's label region is bounded by its ancestor chain
+        for p, anc in part_anc.items():
+            assert all(0 <= s < nsep for s in anc)
         # every vertex labeled; no edge joins two different parts
         rows = np.repeat(np.arange(n), np.diff(a.indptr))
         lr, lc = labels[rows], labels[a.indices]
