@@ -203,3 +203,35 @@ def test_panalyze_convdiff_mc64():
 def test_panalyze_complex():
     a, lu, bvals = _run_panalyze(_build_helmholtz, {})
     _check_solves(a, lu, bvals, tol=1e-6)
+
+
+class _LoneTree:
+    """Single-rank stand-in for TreeComm: allreduce is identity."""
+    n_ranks = 1
+    rank = 0
+
+    def allreduce_sum_any(self, arr, root=0):
+        return arr
+
+
+def test_trim_separators_thins_slab():
+    """A 3-wide separator slab on a path graph peels to one layer, the
+    result still separates the parts, and the trimmed vertices join
+    their adjacent parts."""
+    from superlu_dist_tpu.parallel.panalysis import _trim_separators
+
+    n = 20
+    # path 0-1-...-19; slab = {9,10,11}; parts 0:[0..8], 1:[12..19]
+    lab = np.array([0] * 9 + [-1, -1, -1] + [1] * 8, dtype=np.int64)
+    sr = np.repeat(np.arange(n), 2)[1:-1]
+    sc = np.empty_like(sr)
+    sc[0::2] = sr[0::2] + 1
+    sc[1::2] = sr[1::2] - 1
+    out = _trim_separators(_LoneTree(), lab.copy(), sr, sc, 0, n,
+                           {0: [0], 1: [0]}, 2)
+    assert (out < 0).sum() == 1, out          # slab thinned to 1 vertex
+    # still a separator: no edge joins part 0 and part 1
+    cross = (out[sr] >= 0) & (out[sc] >= 0) & (out[sr] != out[sc])
+    assert not cross.any()
+    # outer layers went to their adjacent parts
+    assert out[9] == 0 and out[11] == 1
