@@ -58,9 +58,13 @@ flops 162G vs 161G — the fine-level separator trimming is what closes
 this; without it the projected slab separators cost 1.9x fill), the
 non-root ranks keep the root+bcast tier's time/peak wins and O(part)
 work, and the root's transient peak is slightly BELOW the root-bcast
-tier's.  Root wall time runs ~15% behind the root-bcast tier: the
-critical path is the root-side assembly + plan build (the
-pddistribute-analog), which stays on root by design.
+tier's.  Root wall time runs ~5% behind the root-bcast tier at
+n=110,592 and at parity at n=1,000,000 — where the tier additionally
+HALVES the root's transient peak (4.2 GB vs 9.3 GB,
+docs/mesh_analysis_4proc_n1000000.json): no rank ever holds the full
+fine graph + symbolic working set.  The remaining root-side phases are
+assembly + plan build (the pddistribute-analog), which stay on root by
+design.
 
 Equilibration is computed distributed (the pdgsequ analog: local row
 maxima, tree-allreduced column maxima).  LargeDiag_MC64/AWPM row
