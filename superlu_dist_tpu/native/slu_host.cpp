@@ -688,12 +688,28 @@ void slu_mmd(i64 n, const i64* indptr, const i64* indices, i64* order_out) {
     heap.emplace(degree[v], v);
   }
 
-  auto external = [&](i64 v) {
-    VSet s = adj[v];
-    for (i64 e : var_elems[v]) s = vset_union(s, elem_vars[e - n]);
-    vset_erase(s, v);
-    return s;
+  // Epoch-stamped scratch instead of sorted-vector unions: external
+  // sets/degrees are computed by flat marking scans (same RESULTS,
+  // identical tie-breaking — the Python oracle stays bit-exact), which
+  // removes the allocation+merge cost that made 3D-mesh elements
+  // (O(n^{2/3}) wide) pathological: 654 s -> measured seconds-class at
+  // n=110,592.  The per-step pivot element is marked ONCE and every
+  // neighbor's adjacency filtered in O(deg) against it.
+  std::vector<i64> mark(n, -1);
+  i64 epoch = 0;
+  auto external_set = [&](i64 v, VSet& out) {
+    ++epoch;
+    mark[v] = epoch;
+    out.clear();
+    for (i64 x : adj[v])
+      if (mark[x] != epoch) { mark[x] = epoch; out.push_back(x); }
+    for (i64 e : var_elems[v])
+      for (i64 x : elem_vars[e - n])
+        if (mark[x] != epoch) { mark[x] = epoch; out.push_back(x); }
+    std::sort(out.begin(), out.end());
   };
+  std::vector<i64> in_le(n, -1);            // step stamp: x in pivot elem
+  VSet le, scratch;
 
   for (i64 k = 0; k < n; ++k) {
     i64 v;
@@ -707,17 +723,39 @@ void slu_mmd(i64 n, const i64* indptr, const i64* indices, i64* order_out) {
     }
     order_out[k] = v;
     alive[v] = 0;
-    VSet le = external(v);
+    external_set(v, le);
     const VSet absorbed = var_elems[v];     // copy: elements of v, absorbed
     for (i64 e : absorbed) elem_vars[e - n].clear();
     elem_vars[k] = le;
     i64 eid = n + k;
+    for (i64 x : le) in_le[x] = k;
+    in_le[v] = k;                           // v leaves every adjacency
     for (i64 u : le) {
-      vset_erase(adj[u], v);
-      vset_subtract(adj[u], le);            // edges now covered by element
+      // adj[u] minus (le ∪ {v}) in one linear pass (edges now covered
+      // by the new element)
+      scratch.clear();
+      for (i64 x : adj[u])
+        if (in_le[x] != k) scratch.push_back(x);
+      adj[u].swap(scratch);
       vset_subtract(var_elems[u], absorbed);
       vset_insert(var_elems[u], eid);
-      degree[u] = (i64)external(u).size();
+      // exact external degree WITHOUT rescanning the new element for
+      // every member (the |le|^2 term that dominated on 3D meshes):
+      // le \ {u} are pairwise distinct, alive, disjoint from the
+      // just-filtered adj[u]; only the OLD elements need the dedup
+      // scan, skipping le members (in_le stamp) and u itself
+      degree[u] = (i64)le.size() - 1 + (i64)adj[u].size();
+      ++epoch;
+      mark[u] = epoch;
+      for (i64 x : adj[u]) mark[x] = epoch;
+      for (i64 e : var_elems[u]) {
+        if (e == eid) continue;
+        for (i64 x : elem_vars[e - n])
+          if (in_le[x] != k && mark[x] != epoch) {
+            mark[x] = epoch;
+            ++degree[u];
+          }
+      }
       heap.emplace(degree[u], u);
     }
   }
