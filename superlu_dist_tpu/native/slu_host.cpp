@@ -633,14 +633,6 @@ namespace {
 
 using VSet = std::vector<i64>;  // sorted, unique
 
-VSet vset_union(const VSet& a, const VSet& b) {
-  VSet out;
-  out.reserve(a.size() + b.size());
-  std::set_union(a.begin(), a.end(), b.begin(), b.end(),
-                 std::back_inserter(out));
-  return out;
-}
-
 void vset_subtract(VSet& a, const VSet& b) {
   if (a.empty() || b.empty()) return;
   VSet out;
@@ -844,17 +836,22 @@ void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
     }
     order_out[k] = c;
     col_alive[c] = 0;
-    // merge every live element containing c into one fill element
+    // merge every live element containing c into one fill element —
+    // concatenate then sort+unique once (a chained set_union pays
+    // O(k·|merged|) across k absorbed elements)
     VSet merged;
     VSet absorbed;
     for (i64 e : col_elems[c])
       if (elem_alive[e]) {
-        merged = vset_union(merged, elem_cols[e]);
+        merged.insert(merged.end(), elem_cols[e].begin(),
+                      elem_cols[e].end());
         absorbed.push_back(e);
         elem_alive[e] = 0;
         elem_cols[e].clear();
         elem_cols[e].shrink_to_fit();
       }
+    std::sort(merged.begin(), merged.end());
+    merged.erase(std::unique(merged.begin(), merged.end()), merged.end());
     std::sort(absorbed.begin(), absorbed.end());
     vset_erase(merged, c);
     // drop dead columns so element sizes track live structure
@@ -865,10 +862,18 @@ void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
     i64 eid = n_rows + k;
     elem_cols[eid] = live;
     elem_alive[eid] = 1;
+    // score update without rescanning the new element per member (the
+    // |live|^2 term — the 3D-mesh pathology): it contributes
+    // |live| - 1 to every member identically; only the OLD live
+    // elements need the per-column walk
+    const i64 base = (i64)live.size() - 1;
     for (i64 j : live) {
       vset_subtract(col_elems[j], absorbed);
       col_elems[j].push_back(eid);          // eid > all current entries
-      score[j] = col_score(j);
+      i64 s = base;
+      for (i64 e : col_elems[j])
+        if (e != eid && elem_alive[e]) s += (i64)elem_cols[e].size() - 1;
+      score[j] = std::min<i64>(std::max<i64>(s, 0), n_cols - 1);
       heap.emplace(score[j], j);
     }
     ++k;
