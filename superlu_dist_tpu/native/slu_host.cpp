@@ -822,6 +822,9 @@ void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
 
   elem_cols.resize(n_rows + n_cols);       // room for fill elements
   elem_alive.resize(n_rows + n_cols, 0);
+  std::vector<i64> col_mark(n_cols, -1);   // step stamp: col in new elem
+  std::vector<i64> elem_tested(n_rows + n_cols, -1);
+  VSet keep;
   i64 k = 0;
   i64 n_live = n_cols - (i64)dense_cols.size();
   while (k < n_live) {
@@ -840,19 +843,16 @@ void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
     // concatenate then sort+unique once (a chained set_union pays
     // O(k·|merged|) across k absorbed elements)
     VSet merged;
-    VSet absorbed;
     for (i64 e : col_elems[c])
       if (elem_alive[e]) {
         merged.insert(merged.end(), elem_cols[e].begin(),
                       elem_cols[e].end());
-        absorbed.push_back(e);
         elem_alive[e] = 0;
         elem_cols[e].clear();
         elem_cols[e].shrink_to_fit();
       }
     std::sort(merged.begin(), merged.end());
     merged.erase(std::unique(merged.begin(), merged.end()), merged.end());
-    std::sort(absorbed.begin(), absorbed.end());
     vset_erase(merged, c);
     // drop dead columns so element sizes track live structure
     VSet live;
@@ -862,14 +862,42 @@ void slu_colamd(i64 n_rows, i64 n_cols, const i64* indptr,
     i64 eid = n_rows + k;
     elem_cols[eid] = live;
     elem_alive[eid] = 1;
+    // aggressive absorption (mirror of ordering/colamd.py): an old
+    // element whose every LIVE column lies inside the new element is
+    // dominated by it — drop it, which tightens the scores AND stops
+    // the per-column element lists from accumulating (the 3D-mesh
+    // slowdown's root)
+    for (i64 x : live) col_mark[x] = k;
+    for (i64 j : live) {
+      for (i64 e : col_elems[j]) {
+        if (e == eid || !elem_alive[e] || elem_tested[e] == k) continue;
+        elem_tested[e] = k;
+        bool dominated = true;
+        for (i64 x : elem_cols[e])
+          if (col_alive[x] && col_mark[x] != k) {
+            dominated = false;
+            break;
+          }
+        if (dominated) {
+          elem_alive[e] = 0;
+          elem_cols[e].clear();
+          elem_cols[e].shrink_to_fit();
+        }
+      }
+    }
     // score update without rescanning the new element per member (the
     // |live|^2 term — the 3D-mesh pathology): it contributes
     // |live| - 1 to every member identically; only the OLD live
-    // elements need the per-column walk
+    // elements need the per-column walk.  The compaction keeps only
+    // live elements (drops this step's absorbed AND dominated — both
+    // dead now), then appends eid.
     const i64 base = (i64)live.size() - 1;
     for (i64 j : live) {
-      vset_subtract(col_elems[j], absorbed);
-      col_elems[j].push_back(eid);          // eid > all current entries
+      keep.clear();
+      for (i64 e : col_elems[j])
+        if (elem_alive[e]) keep.push_back(e);
+      keep.push_back(eid);
+      col_elems[j].swap(keep);
       i64 s = base;
       for (i64 e : col_elems[j])
         if (e != eid && elem_alive[e]) s += (i64)elem_cols[e].size() - 1;

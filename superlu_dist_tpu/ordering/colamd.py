@@ -90,20 +90,32 @@ def _colamd_py(n_rows, n_cols, indptr, indices):
         order[k] = c
         alive[c] = False
         merged = set()
-        absorbed = []
         for e in col_elems[c]:
             if e in elem_cols:
                 merged.update(elem_cols[e])
-                absorbed.append(e)
                 del elem_cols[e]
         merged.discard(c)
         live = sorted(j for j in merged if alive[j])
         eid = n_rows + k
         elem_cols[eid] = live
-        absorbed_set = set(absorbed)
+        # aggressive absorption (the colamd trick this implementation's
+        # first cut missed): an old element whose every LIVE column lies
+        # inside the new element is dominated by it — drop it, which
+        # tightens the scores AND stops the per-column element lists
+        # from accumulating (the 3D-mesh slowdown)
+        live_set = set(live)
+        tested = set()
+        for j in live:
+            for e in col_elems[j]:
+                if e == eid or e in tested or e not in elem_cols:
+                    continue
+                tested.add(e)
+                if all(not alive[x] or x in live_set
+                       for x in elem_cols[e]):
+                    del elem_cols[e]
         for j in live:
             col_elems[j] = [e for e in col_elems[j]
-                            if e not in absorbed_set] + [eid]
+                            if e in elem_cols] + [eid]
             score[j] = col_score(j)
             heapq.heappush(heap, (int(score[j]), j))
         k += 1
