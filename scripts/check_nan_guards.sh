@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# NaN-guard smoke run: execute the numeric-core and driver test families
+# under JAX_DEBUG_NANS=1, which makes XLA raise the moment any jitted
+# computation PRODUCES a NaN.  Healthy inputs must never do so; a failure
+# here means a kernel regressed into relying on NaN propagation.
+#
+# Tests that *intentionally* create NaN/Inf are deselected:
+#   - singular systems factored with replace_tiny_pivot=False (the info>0
+#     path deliberately lets a zero pivot propagate), and
+#   - the known-failing zdf64 end-to-end case (pre-existing, BASELINE.md).
+# The recovery suite's NaN-poisoned sentinel tests live in
+# tests/test_recovery.py and are excluded wholesale for the same reason.
+#
+# Wired for CI next to the tier-1 command (ROADMAP.md); ~1-2 min on CPU.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec env JAX_PLATFORMS=cpu JAX_DEBUG_NANS=1 \
+  python -m pytest tests/test_gssvx.py tests/test_dense_ops.py \
+  tests/test_device_solve.py tests/test_df64.py \
+  -q -m 'not slow' -p no:cacheprovider \
+  --deselect tests/test_gssvx.py::test_exact_singularity_reported_without_replacement \
+  --deselect tests/test_df64.py::test_zdf64_complex_factorization_end_to_end \
+  "$@"

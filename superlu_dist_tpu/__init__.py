@@ -33,9 +33,11 @@ Architecture (TPU-first, not a port):
 
 from superlu_dist_tpu.utils.options import (
     Options, Fact, ColPerm, RowPerm, IterRefine, Trans, YesNo,
-    set_default_options,
+    RecoveryPolicy, set_default_options,
 )
-from superlu_dist_tpu.utils.stats import Stats
+from superlu_dist_tpu.utils.stats import Stats, SolveReport
+from superlu_dist_tpu.utils.errors import (
+    SuperLUError, SingularMatrixError, NumericBreakdownError)
 from superlu_dist_tpu.sparse.formats import SparseCSR, SparseCSC
 
 

@@ -29,8 +29,9 @@ from superlu_dist_tpu.sparse.formats import SparseCSR, symmetrize_pattern
 from superlu_dist_tpu.utils.options import (
     Options, Fact, RowPerm, IterRefine, Trans, default_factor_dtype,
     print_options)
-from superlu_dist_tpu.utils.stats import Stats
-from superlu_dist_tpu.utils.errors import SuperLUError, SingularMatrixError
+from superlu_dist_tpu.utils.stats import Stats, SolveReport, RungRecord
+from superlu_dist_tpu.utils.errors import (
+    SuperLUError, SingularMatrixError, NumericBreakdownError)
 from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
 from superlu_dist_tpu.rowperm.matching import (
     maximum_product_matching, approximate_weight_matching)
@@ -355,13 +356,15 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
                 plan, bvals, lu.anorm,
                 replace_tiny=options.replace_tiny_pivot,
                 mesh=grid.mesh if grid is not None else None,
-                pool_partition=options.pool_partition)
+                pool_partition=options.pool_partition,
+                check_finite=options.recovery.sentinels)
         else:
             numeric = numeric_factorize(
                 plan, bvals, lu.anorm, dtype=dtype,
                 replace_tiny=options.replace_tiny_pivot,
                 mesh=grid.mesh if grid is not None else None,
-                pool_partition=options.pool_partition)
+                pool_partition=options.pool_partition,
+                check_finite=options.recovery.sentinels)
         for lp, up in numeric.fronts:
             if hasattr(lp, "block_until_ready"):
                 lp.block_until_ready()
@@ -453,6 +456,204 @@ def gssvx_dist(options: Options, parts, b: np.ndarray,
     return gssvx(options, gather_rows(parts), b, lu=lu, stats=stats)
 
 
+def _adjoint_solver(lu: LUFactorization, trans, cplx: bool):
+    """op⁻ᴴ through the stored factors (for the FERR estimator); None when
+    the trans/complex combination has no clean adjoint through them."""
+    if trans == Trans.NOTRANS:
+        return lambda r: lu.solve_factored_trans(r, conj=cplx)
+    if not cplx:
+        return lu.solve_factored     # real: (Aᵀ)ᴴ = A
+    return None
+
+
+def _trans_solver(lu: LUFactorization, trans, a_dtype):
+    """The op(A)⁻¹ apply matching options.trans, on an arbitrary handle."""
+    if trans == Trans.NOTRANS:
+        return lu.solve_factored
+    conj = trans == Trans.CONJ and np.issubdtype(a_dtype,
+                                                 np.complexfloating)
+    return lambda rhs: lu.solve_factored_trans(rhs, conj=conj)
+
+
+def _escalation_dtype(cur) -> str | None:
+    """The next factor-precision tier above `cur`, or None at the top:
+    f64/c128 on a CPU backend with x64, emulated-double df64 on f32-only
+    hardware (numeric/df64_factor.py — true ~2^-48 factors)."""
+    cur = str(cur)
+    if cur in ("float64", "complex128") or "df64" in cur:
+        return None
+    import jax
+    if jax.default_backend() == "cpu":
+        try:
+            if jax.config.read("jax_enable_x64"):
+                return "float64"
+        except Exception:
+            pass
+    return "df64"
+
+
+def _permuted_values(lu: LUFactorization):
+    """Recompute analyze()'s structurally-permuted value array from the
+    stored transforms (so an escalation rung can refactor on the SAME
+    skeleton without redoing the analysis).  None when the skeleton cannot
+    reproduce it — panalyze skeletons (no value-gather map), stripped
+    handles, or pattern drift."""
+    if lu.a is None or lu.sf is None or lu.sf.value_perm is None:
+        return None
+    a1 = (lu.a.row_scale(lu.dr).col_scale(lu.dc)
+          if lu.equed != "N" else lu.a)
+    a2 = a1.row_scale(lu.r1).col_scale(lu.c1).permute(perm_r=lu.row_order)
+    sym = symmetrize_pattern(a2)
+    if sym.nnz != len(lu.sf.value_perm):
+        return None
+    if (lu.a_sym_indptr is not None
+            and not (np.array_equal(sym.indptr, lu.a_sym_indptr)
+                     and np.array_equal(sym.indices, lu.a_sym_indices))):
+        return None
+    return sym.data[lu.sf.value_perm]
+
+
+def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
+              lu: LUFactorization, stats: Stats, trans, solve_fn,
+              x: np.ndarray, residual_dtype, report: SolveReport,
+              target: float):
+    """The automatic escalation ladder (the ShyLU fallback-ladder shape:
+    low-precision node solves wrapped in quality checks).  Runs when
+    refinement stagnated above `target` or produced non-finite values:
+
+      1. residual-precision — same factors, exact f64 residual;
+      2. hiprec-factors     — refactor the SAME skeleton at the next
+                              precision tier (f64 / df64) and redo the
+                              correction solves through it;
+      3. refactor-rescale   — full re-analysis with equilibration +
+                              MC64 re-scaling/ordering forced on, at the
+                              escalated precision.
+
+    Every rung is recorded in report.rungs whether or not it helped; a
+    rung's result is only ADOPTED when it strictly improved berr.
+    Returns (x, lu_effective, solve_fn, residual_dtype)."""
+    import time
+
+    recovery = options.recovery
+    cur_x = np.asarray(x)
+    cur_berr = report.berr if report.berr is not None else float("inf")
+    if not np.all(np.isfinite(cur_x)):
+        cur_berr = float("inf")
+    lu_eff = lu
+    a_dtype = np.asarray(a.data).dtype
+
+    def attempt(name, detail, solve2, res_dtype, start_x):
+        """Run IR with `solve2` corrections; record; adopt on improvement.
+        Returns True when the target is reached."""
+        nonlocal cur_x, cur_berr, solve_fn, residual_dtype
+        t0 = time.perf_counter()
+        try:
+            x0 = (start_x if np.all(np.isfinite(start_x))
+                  else np.asarray(solve2(b)))
+            x2, errs = iterative_refinement(op, b, x0, solve2,
+                                            residual_dtype=res_dtype)
+        except SuperLUError as e:
+            report.rungs.append(RungRecord(
+                name=name, detail=f"{detail}: {type(e).__name__}",
+                berr_before=cur_berr,
+                seconds=time.perf_counter() - t0))
+            return False
+        berr2 = errs[-1] if errs else float("inf")
+        if not np.all(np.isfinite(np.asarray(x2))):
+            berr2 = float("inf")
+        report.rungs.append(RungRecord(
+            name=name, detail=detail, berr_before=cur_berr,
+            berr_after=berr2, seconds=time.perf_counter() - t0))
+        report.berr_history.extend(errs)
+        stats.refine_steps += len(errs)
+        if berr2 < cur_berr:
+            cur_x, cur_berr = np.asarray(x2), berr2
+            solve_fn, residual_dtype = solve2, res_dtype
+            report.berr = berr2
+        return cur_berr <= target
+
+    done = False
+    # ---- rung 1: escalate residual precision --------------------------------
+    # (SLU_SINGLE's f32 residual can't see below single eps; same factors,
+    # exact residual is the cheapest repair)
+    if (np.dtype(residual_dtype) != np.float64
+            and len(report.rungs) < recovery.max_rungs):
+        done = attempt("residual-precision", "float64 residual",
+                       solve_fn, np.float64, cur_x)
+
+    # ---- rung 2: higher-precision correction factors ------------------------
+    esc = _escalation_dtype(lu.numeric.dtype)
+    if (not done and esc is not None
+            and len(report.rungs) < recovery.max_rungs):
+        bvals = _permuted_values(lu)
+        if bvals is not None:
+            lu_esc = dataclasses.replace(
+                lu, numeric=None, dev_solver=None, dev_spmv=None,
+                berrs=None,
+                options=dataclasses.replace(options, factor_dtype=esc))
+            try:
+                info2 = factorize_numeric(lu_esc, bvals, stats)
+            except SuperLUError:
+                info2 = -1
+            if info2 == 0:
+                solve2 = _trans_solver(lu_esc, trans, a_dtype)
+                done = attempt("hiprec-factors", esc, solve2,
+                               np.float64, cur_x)
+                if solve_fn is solve2:    # adopted: hand the caller the
+                    lu_eff = lu_esc       # factors the answer rests on
+
+    # ---- rung 3: refactor with re-scaling / re-ordering ---------------------
+    # only when it would actually change something the first pass didn't do
+    would_change = (not options.equil
+                    or options.row_perm != RowPerm.LargeDiag_MC64
+                    or not options.replace_tiny_pivot
+                    or esc is not None)
+    if not done and would_change and len(report.rungs) < recovery.max_rungs:
+        t0 = time.perf_counter()
+        opts3 = dataclasses.replace(
+            options, fact=Fact.DOFACT, equil=True,
+            row_perm=RowPerm.LargeDiag_MC64, replace_tiny_pivot=True,
+            factor_dtype=esc if esc is not None else options.factor_dtype,
+            iter_refine=IterRefine.SLU_DOUBLE, print_stat=False,
+            user_perm_r=None,
+            # no recursion, no mid-ladder raises: the ladder itself is
+            # the consumer of this sub-solve's report
+            recovery=dataclasses.replace(recovery, enabled=False,
+                                         condest="never", sentinels=False))
+        try:
+            x3, lu3, stats3, info3 = gssvx(opts3, a, b)
+        except SuperLUError as e:
+            x3, lu3, stats3, info3 = None, None, None, -1
+            err3 = type(e).__name__
+        if info3 == 0 and x3 is not None:
+            rep3 = stats3.solve_report
+            berr3 = (rep3.berr if rep3 is not None
+                     and rep3.berr is not None else float("inf"))
+            if not np.all(np.isfinite(np.asarray(x3))):
+                berr3 = float("inf")
+            report.rungs.append(RungRecord(
+                name="refactor-rescale", detail=str(opts3.factor_dtype),
+                berr_before=cur_berr, berr_after=berr3,
+                seconds=time.perf_counter() - t0))
+            if rep3 is not None:
+                report.berr_history.extend(rep3.berr_history)
+            if berr3 < cur_berr:
+                cur_x, cur_berr, lu_eff = np.asarray(x3), berr3, lu3
+                solve_fn = _trans_solver(lu3, trans, a_dtype)
+                residual_dtype = np.float64
+                report.berr = berr3
+                report.tiny_pivots = rep3.tiny_pivots if rep3 else 0
+        else:
+            report.rungs.append(RungRecord(
+                name="refactor-rescale",
+                detail=f"failed: info={info3}"
+                       + (f" ({err3})" if info3 == -1 else ""),
+                berr_before=cur_berr,
+                seconds=time.perf_counter() - t0))
+
+    return cur_x, lu_eff, solve_fn, residual_dtype
+
+
 def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                       lu: LUFactorization, stats: Stats):
     n = a.n_rows
@@ -476,6 +677,10 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
     stats.ops["SOLVE"] += 4.0 * lu.sf.nnz_L * nrhs  # fwd+back L,U sweeps
 
     info = 0
+    report = SolveReport(factor_dtype=str(lu.numeric.dtype),
+                         tiny_pivots=lu.numeric.tiny_pivots)
+    stats.solve_report = report
+    recovery = options.recovery
     if options.iter_refine != IterRefine.NOREFINE:
         # SLU_SINGLE rounds the residual/correction to f32 (refinement
         # stops at single eps); SLU_DOUBLE uses options.ir_dtype (f64
@@ -518,6 +723,53 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                                             residual_dtype=residual_dtype)
         stats.refine_steps += len(berrs)
         lu.berrs = berrs
+        report.berr_history = list(berrs)
+        report.berr = berrs[-1] if berrs else None
+        eps_w = float(np.finfo(np.dtype(residual_dtype)).eps)
+        target = (recovery.berr_target if recovery.berr_target
+                  else 10.0 * eps_w)
+        report.target = target
+        bad = (report.berr is None or report.berr > target
+               or not np.all(np.isfinite(np.asarray(x))))
+        if recovery.enabled and bad:
+            # the escalation ladder: each rung buys accuracy the previous
+            # tier could not, and is recorded so the caller sees what
+            # degraded and why the answer is still trustworthy
+            x, lu_final, solve_fn, residual_dtype = _escalate(
+                options, a, op, b, lu, stats, trans, solve_fn, x,
+                residual_dtype, report, target)
+        else:
+            lu_final = lu
+        report.refine_steps = len(report.berr_history)
+        report.converged = (report.berr is not None
+                            and report.berr <= target)
+    else:
+        lu_final = lu
+
+    # rcond/ferr (the pdgscon + dgsrfs-FERR reporting): "always", or on
+    # "auto" only when the answer needs defending — the ladder fired,
+    # tiny pivots were replaced, or refinement missed its target
+    want_cond = (recovery.condest == "always"
+                 or (recovery.condest == "auto"
+                     and (report.rungs or report.tiny_pivots
+                          or not report.converged)))
+    if want_cond:
+        from superlu_dist_tpu.refine.condest import (
+            condition_estimate, ferr_estimate)
+        report.rcond = condition_estimate(lu_final)
+        cplx = np.issubdtype(np.asarray(a.data).dtype, np.complexfloating)
+        adj_fn = _adjoint_solver(lu_final, trans, cplx)
+        if adj_fn is not None and options.iter_refine != IterRefine.NOREFINE:
+            try:
+                report.ferr = ferr_estimate(op, b, x, solve_fn, adj_fn)
+            except Exception:
+                report.ferr = None       # estimation must never kill a solve
+
+    # final non-finite sentinel: a silent NaN/Inf solution is the one
+    # outcome the health subsystem exists to prevent
+    report.finite = bool(np.all(np.isfinite(np.asarray(x))))
+    if not report.finite and recovery.sentinels:
+        raise NumericBreakdownError(where="solve")
     if options.print_stat:
         stats.print()
-    return x, lu, stats, info
+    return x, lu_final, stats, info

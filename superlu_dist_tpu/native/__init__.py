@@ -30,18 +30,20 @@ _I64 = ctypes.POINTER(ctypes.c_int64)
 _F64 = ctypes.POINTER(ctypes.c_double)
 
 
-def _build() -> str | None:
+def _build(force: bool = False) -> str | None:
     """Compile the shared library if missing or stale; return path or None."""
     try:
-        if (os.path.exists(_LIB)
+        if (not force and os.path.exists(_LIB)
                 and os.path.getmtime(_LIB) >= os.path.getmtime(_SRC)):
             return _LIB
         # per-process tmp name: concurrent first-use builds (pytest workers,
         # bench + tests) must not interleave writes; os.replace is atomic
+        # (-lrt: shm_open lives in librt on glibc < 2.34; a no-op stub on
+        # newer glibc, so linking it unconditionally is safe)
         tmp = f"{_LIB}.{os.getpid()}.tmp"
         subprocess.run(
             ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
-             "-o", tmp, _SRC],
+             "-o", tmp, _SRC, "-lrt"],
             check=True, capture_output=True, timeout=300)
         os.replace(tmp, _LIB)
         return _LIB
@@ -63,7 +65,16 @@ def _load():
         if path is None:
             return None
         try:
-            lib = ctypes.CDLL(path)
+            try:
+                lib = ctypes.CDLL(path)
+            except OSError:
+                # a stale .so built for a different libc (e.g. shm_open
+                # moved between librt and libc) loads nowhere — rebuild
+                # against THIS toolchain and retry once
+                path = _build(force=True)
+                if path is None:
+                    return None
+                lib = ctypes.CDLL(path)
             lib.slu_etree.argtypes = [ctypes.c_int64, _I64, _I64, _I64]
             lib.slu_postorder.argtypes = [ctypes.c_int64, _I64, _I64]
             # (slu_symbolic — the serial alias — stays exported for the C

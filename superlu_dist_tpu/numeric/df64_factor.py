@@ -385,7 +385,8 @@ def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                            anorm: float,
                            replace_tiny: bool = True,
                            mesh=None,
-                           pool_partition: bool = False
+                           pool_partition: bool = False,
+                           check_finite: bool = True
                            ) -> NumericFactorization:
     """Factor with ~f64 accuracy on f32-only hardware (real or complex).
 
@@ -411,6 +412,16 @@ def df64_numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     if not replace_tiny:
         from superlu_dist_tpu.numeric.factor import localize_singularity
         finite, info_col = localize_singularity(plan, fronts)
+    elif check_finite:
+        # non-finite sentinel (same contract as numeric_factorize): with
+        # tiny-pivot replacement active, NaN/Inf means breakdown
+        from superlu_dist_tpu.numeric.factor import (
+            fronts_finite, localize_nonfinite)
+        if not fronts_finite(fronts):
+            from superlu_dist_tpu.utils.errors import NumericBreakdownError
+            sn, col = localize_nonfinite(plan, fronts)
+            raise NumericBreakdownError(supernode=sn, col=col,
+                                        where="df64 numeric factorization")
     return NumericFactorization(plan=plan, fronts=fronts, tiny_pivots=tiny,
                                 dtype=np.dtype(alg.out_dtype),
                                 finite=finite, info_col=info_col)

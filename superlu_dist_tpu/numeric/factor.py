@@ -249,7 +249,8 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       replace_tiny: bool = True,
                       executor: str = "auto",
                       mesh=None,
-                      pool_partition: bool = False) -> NumericFactorization:
+                      pool_partition: bool = False,
+                      check_finite: bool = True) -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
@@ -258,6 +259,12 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     With replace_tiny=False an exact zero pivot propagates inf/nan; the
     result is flagged non-finite (the reference's info>0 singularity path,
     pdgstrf.c:234-241).
+
+    check_finite arms the non-finite sentinel: with ReplaceTinyPivot
+    active a NaN/Inf in the factors means overflow or NaN input (never
+    expected singularity), so the cheap isfinite reductions below trip a
+    structured NumericBreakdownError naming the offending supernode
+    instead of letting NaN propagate through every later front.
     """
     dtype = jnp.dtype(dtype)
     real_dtype = jnp.dtype(dtype).type(0).real.dtype
@@ -268,15 +275,62 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     avals = jnp.asarray(pattern_values, dtype=dtype)
     fn = get_executor(plan, dtype, executor, mesh=mesh,
                       pool_partition=pool_partition)
+    if hasattr(fn, "check_finite"):
+        # streamed executor: also sentinel each offloaded group as it
+        # lands on the host (early abort — see stream._emit_front)
+        fn.check_finite = bool(check_finite and replace_tiny)
     fronts_out, tiny_total = fn(avals, thresh)
     fronts_out = list(fronts_out)
     finite = True
     info_col = -1
     if not replace_tiny:
         finite, info_col = localize_singularity(plan, fronts_out)
+    elif check_finite and not fronts_finite(fronts_out):
+        from superlu_dist_tpu.utils.errors import NumericBreakdownError
+        sn, col = localize_nonfinite(plan, fronts_out)
+        raise NumericBreakdownError(supernode=sn, col=col,
+                                    where="numeric factorization")
     return NumericFactorization(plan=plan, fronts=fronts_out,
                                 tiny_pivots=int(tiny_total), dtype=dtype,
                                 finite=finite, info_col=info_col)
+
+
+def fronts_finite(fronts) -> bool:
+    """Cheap isfinite sentinel over factored panels: one all-reduce per
+    group, device-resident panels reduced device-side (a few scalar
+    transfers — O(panel bytes) reads, trivial next to the factorization's
+    O(n·w²) flops)."""
+    flags = []
+    for lp, up in fronts:
+        if isinstance(lp, np.ndarray):
+            if not (np.isfinite(lp).all() and np.isfinite(up).all()):
+                return False
+        else:
+            flags.append(jnp.isfinite(lp).all() & jnp.isfinite(up).all())
+    if flags:
+        return bool(np.all(jax.device_get(flags)))
+    return True
+
+
+def localize_nonfinite(plan: FactorPlan, fronts):
+    """Earliest contaminated supernode over all fronts: returns
+    (supernode, first global column), or (-1, -1) if everything is finite.
+    The localization mirrors localize_singularity's per-SLOT attribution —
+    an unrelated subtree batched in the same group must not be blamed."""
+    sn_start = plan.sf.sn_start
+    best_sn, best_col = -1, -1
+    for grp, (lp, up) in zip(plan.groups, fronts):
+        lph = np.asarray(lp)
+        nf = ~np.isfinite(lph.reshape(lph.shape[0], -1)).all(axis=1)
+        nf |= ~np.isfinite(np.asarray(up).reshape(
+            lph.shape[0], -1)).all(axis=1)
+        if nf.any():
+            sns = np.asarray(grp.sns)[np.nonzero(nf)[0]]
+            sn = int(sns[np.argmin(sn_start[sns])])
+            col = int(sn_start[sn])
+            if best_col < 0 or col < best_col:
+                best_sn, best_col = sn, col
+    return best_sn, best_col
 
 
 def localize_singularity(plan: FactorPlan, fronts):

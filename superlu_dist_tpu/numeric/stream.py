@@ -155,6 +155,11 @@ class StreamExecutor:
         # transfer-wait / (the rest =) device compute
         self.last_offload_wait_seconds = None
         self._lag = int(os.environ.get("SLU_TPU_OFFLOAD_LAG", "8"))
+        # non-finite sentinel (set per call by numeric_factorize): when
+        # armed, every group materialized on the host mid-stream is
+        # isfinite-checked so a breakdown aborts the stream at the
+        # offending supernode instead of NaN-ing the remaining levels
+        self.check_finite = False
 
         # Host-share split (the reference's CPU/GPU work division:
         # gemm_division_cpu_gpu + the N_GEMM flops threshold,
@@ -402,8 +407,26 @@ class StreamExecutor:
                     t0 = time.perf_counter()
                     fronts[i] = (np.asarray(dlp), np.asarray(dup))
                     self._offload_wait += time.perf_counter() - t0
+                    if self.check_finite:
+                        self._sentinel_check(i, *fronts[i])
         else:
             fronts.append((lp, up))
+
+    def _sentinel_check(self, gi, lp, up):
+        """Trip NumericBreakdownError if group `gi`'s materialized panels
+        carry NaN/Inf — the mid-stream half of the non-finite sentinel
+        (the end-of-run half lives in factor.numeric_factorize)."""
+        if np.isfinite(lp).all() and np.isfinite(up).all():
+            return
+        from superlu_dist_tpu.utils.errors import NumericBreakdownError
+        grp = self.plan.groups[gi]
+        sn_start = self.plan.sf.sn_start
+        nf = ~np.isfinite(lp.reshape(lp.shape[0], -1)).all(axis=1)
+        nf |= ~np.isfinite(up.reshape(lp.shape[0], -1)).all(axis=1)
+        sns = np.asarray(grp.sns)[np.nonzero(nf)[0]]
+        sn = int(sns[np.argmin(sn_start[sns])])
+        raise NumericBreakdownError(supernode=sn, col=int(sn_start[sn]),
+                                    where="streamed factorization")
 
     def _finalize_fronts(self, fronts):
         if self.offload == "host" or self._n_host_groups:

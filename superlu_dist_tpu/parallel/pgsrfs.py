@@ -22,7 +22,7 @@ import numpy as np
 
 from superlu_dist_tpu.parallel.dist import DistributedCSR
 from superlu_dist_tpu.parallel.treecomm import TreeComm
-from superlu_dist_tpu.refine.ir import ITMAX
+from superlu_dist_tpu.refine.ir import ITMAX, componentwise_berr
 
 
 def _pad_full(local: np.ndarray, fst_row: int, n: int) -> np.ndarray:
@@ -34,7 +34,8 @@ def _pad_full(local: np.ndarray, fst_row: int, n: int) -> np.ndarray:
 def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
            x0: np.ndarray | None, solve_fn, itmax: int = ITMAX,
            root: int = 0, trans=None,
-           collective_solve: bool = False) -> np.ndarray:
+           collective_solve: bool = False,
+           stats_out: dict | None = None) -> np.ndarray:
     """Collectively refine op(A)·x = b (single RHS; op per `trans` —
     NOTRANS/TRANS/CONJ like pdgssvx's trans dispatch; complex payloads
     ride the f64 tree as re/im passes via TreeComm.*_any).
@@ -54,6 +55,10 @@ def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
                and the dx broadcast is skipped — this IS the reference's
                shape, where pdgstrs runs on the whole grid inside
                pdgsrfs (SRC/pdgsrfs.c:205).
+    stats_out — optional dict filled with {"iters", "berr", "berrs"}:
+               the iteration count and componentwise backward-error
+               history (every rank gets the same values — they are
+               computed from allreduced quantities).
 
     Returns the full refined x on every rank.
     """
@@ -70,6 +75,14 @@ def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
          else np.asarray(x0, dtype=wdtype))
     x = tc.bcast_any(x, root=root)
 
+    # global nnz for the shared BERR underflow guard (refine/ir.py's
+    # componentwise_berr — the safe1·safmin bump, NOT a den>0 -> 1.0
+    # rewrite, which understates berr on tiny denominators)
+    cnt = np.zeros(1)
+    cnt[0] = float(a_loc.nnz_loc)
+    nnz_glob = int(tc.allreduce_sum_any(cnt, root=root)[0])
+
+    berrs = []
     lstres = np.inf
     for _ in range(itmax):
         # r = b − op(A)·x as one all-reduce of per-rank contributions
@@ -90,8 +103,8 @@ def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
         r = tc.allreduce_sum_any(r_c, root=root)
         # componentwise backward error denominator |op(A)|·|x| + |b|
         den = tc.allreduce_sum_any(den_c, root=root)
-        den = np.where(den > 0, den, 1.0)
-        berr = float(np.max(np.abs(r) / den))
+        berr = componentwise_berr(r, den, nnz_glob, np.float64)
+        berrs.append(berr)
         if berr <= eps or berr >= lstres / 2.0:
             break
         lstres = berr
@@ -106,4 +119,8 @@ def pgsrfs(tc: TreeComm, a_loc: DistributedCSR, b_loc: np.ndarray,
                 dx = np.asarray(solve_fn(r), dtype=wdtype)
             dx = tc.bcast_any(dx, root=root)
         x = x + dx
+    if stats_out is not None:
+        stats_out["iters"] = len(berrs)
+        stats_out["berr"] = berrs[-1] if berrs else None
+        stats_out["berrs"] = berrs
     return x

@@ -166,6 +166,13 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     SamePattern_SameRowPerm reuse the analysis products and refactor
     with the new values; FACTORED skips straight to the collective
     solve on the existing sharded factors.
+
+    Solve health: when refinement ran, lu_out["stats"].solve_report
+    carries berr (+ history) from the distributed loop; if it stagnated
+    above the recovery target and options.recovery is enabled, ONE
+    escalated retry at the next factor-precision tier runs collectively
+    (the decision is taken from allreduced quantities, so every rank
+    agrees — no rank-divergent control flow) and is recorded as a rung.
     """
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
@@ -186,9 +193,12 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     wdtype = np.complex128 if complex_in else np.float64
 
     if grid is not None:
-        return _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
-                            lu=lu, lu_out=lu_out,
-                            replicate_analysis=replicate_analysis)
+        x, info, rep = _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d,
+                                    wdtype, lu=lu, lu_out=lu_out,
+                                    replicate_analysis=replicate_analysis)
+        return _maybe_escalate_distributed(
+            tc, options, a_loc, b_loc, x, info, rep, lu_out, grid=grid,
+            replicate_analysis=replicate_analysis)
 
     a_root = gather_distributed(tc, a_loc, root=root)
     b_full = np.zeros((n, nrhs), dtype=wdtype)
@@ -222,13 +232,21 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     if int(info[0]) != 0:
         return None, int(info[0])
     x0 = tc.bcast_any(x0, root=root)
-    return _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d,
-                        nrhs)
+    x, info_out, rep = _refine_tail(tc, options, a_loc, b2, x0, solve_fn,
+                                    root, one_d, nrhs, lu_out=lu_out)
+    return _maybe_escalate_distributed(tc, options, a_loc, b_loc, x,
+                                       info_out, rep, lu_out, root=root)
 
 
-def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs):
+def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs,
+                 lu_out=None, collective_solve=False, stats=None):
+    """Distributed refinement over the RHS columns; returns
+    (x, info, SolveReport-or-None).  The report is identical on every
+    rank (built from allreduced berr values), so callers may branch on
+    it collectively."""
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
     from superlu_dist_tpu.utils.options import IterRefine, Trans
+    rep = None
     if options.iter_refine == IterRefine.NOREFINE:
         x = x0
     else:
@@ -236,11 +254,103 @@ def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs):
         # RHS columns with per-RHS berr, pdgsrfs.c:205-235)
         trans = getattr(options, "trans", Trans.NOTRANS)
         cols = []
+        rhs_stats = []
         for j in range(nrhs):
+            so = {}
             cols.append(pgsrfs(tc, a_loc, b2[:, j], x0[:, j], solve_fn,
-                               root=root, trans=trans))
+                               root=root, trans=trans,
+                               collective_solve=collective_solve,
+                               stats_out=so))
+            rhs_stats.append(so)
         x = np.stack(cols, axis=1)
-    return (x[:, 0] if one_d else x), 0
+        rep = _attach_distributed_report(options, rhs_stats, x,
+                                         lu_out=lu_out, stats=stats)
+    return (x[:, 0] if one_d else x), 0, rep
+
+
+def _attach_distributed_report(options, rhs_stats, x, lu_out=None,
+                               stats=None):
+    """Build the SolveReport of a distributed refinement (every rank sees
+    the same allreduced berr values, so every rank builds the same
+    report) and attach it to the Stats handed back via lu_out."""
+    from superlu_dist_tpu.utils.stats import SolveReport
+    berrs = [s["berr"] for s in rhs_stats if s.get("berr") is not None]
+    target = (options.recovery.berr_target
+              or 10.0 * float(np.finfo(np.float64).eps))
+    rep = SolveReport(
+        berr=max(berrs) if berrs else None,
+        berr_history=[b for s in rhs_stats for b in s.get("berrs", [])],
+        target=target,
+        finite=bool(np.all(np.isfinite(x))))
+    rep.refine_steps = sum(s.get("iters", 0) for s in rhs_stats)
+    rep.converged = rep.berr is not None and rep.berr <= target
+    if stats is None and lu_out is not None:
+        stats = lu_out.get("stats")
+    if stats is not None:
+        # the root factorization's NOREFINE report carries the
+        # factorization facts; the distributed refinement supersedes it
+        # but inherits them
+        prev = stats.solve_report
+        if prev is not None:
+            rep.tiny_pivots = prev.tiny_pivots
+            rep.factor_dtype = prev.factor_dtype
+            rep.rcond = prev.rcond
+        stats.solve_report = rep
+    if lu_out is not None:
+        lu_out["solve_report"] = rep
+    return rep
+
+
+def _maybe_escalate_distributed(tc, options, a_loc, b_loc, x, info, rep,
+                                lu_out, root=0, grid=None,
+                                replicate_analysis=False):
+    """One collective escalation rung for the distributed driver: when
+    the distributed refinement stagnated above the recovery target,
+    rerun the whole flow at the next factor-precision tier.  Every input
+    to the decision (the report's berr/target, the shared options) is
+    replicated, so all ranks take the same branch — rank-divergent
+    control flow here would strand peers in the collectives (which is
+    also why the decision must NOT depend on per-rank lu_out presence)."""
+    import dataclasses
+
+    from superlu_dist_tpu.drivers.gssvx import _escalation_dtype
+    from superlu_dist_tpu.utils.options import Fact, IterRefine
+    from superlu_dist_tpu.utils.stats import RungRecord
+
+    recovery = options.recovery
+    if (info != 0 or rep is None or rep.converged
+            or not recovery.enabled
+            or options.iter_refine == IterRefine.NOREFINE):
+        return x, info
+    from superlu_dist_tpu.utils.options import default_factor_dtype
+    cur = options.factor_dtype or default_factor_dtype()
+    esc = _escalation_dtype(cur)
+    if esc is None:
+        return x, info
+    opts2 = dataclasses.replace(
+        options, fact=Fact.DOFACT, factor_dtype=esc,
+        recovery=dataclasses.replace(recovery, enabled=False))
+    lu_out2 = {}
+    x2, info2 = pgssvx(tc, opts2, a_loc, b_loc, root=root, grid=grid,
+                       lu_out=lu_out2,
+                       replicate_analysis=replicate_analysis)
+    rep2 = lu_out2.get("solve_report")
+    berr2 = rep2.berr if rep2 is not None and rep2.berr is not None \
+        else float("inf")
+    rung = RungRecord(name="distributed-hiprec", detail=str(esc),
+                      berr_before=rep.berr, berr_after=berr2)
+    rep.rungs.append(rung)
+    if info2 == 0 and berr2 < rep.berr:
+        rep.berr = berr2
+        rep.berr_history.extend(rep2.berr_history if rep2 else [])
+        rep.converged = berr2 <= rep.target
+        rep.finite = bool(np.all(np.isfinite(x2)))
+        if lu_out is not None:
+            # the answer now rests on the escalated factors/handle
+            lu_out.update(lu_out2)
+            lu_out["solve_report"] = rep
+        return x2, info2
+    return x, info
 
 
 def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
@@ -317,7 +427,7 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
         lu_out["lu"] = lu
         lu_out["stats"] = stats
     if info_r != 0:
-        return None, int(info_r)
+        return None, int(info_r), None
     trans = getattr(options, "trans", Trans.NOTRANS)
     if trans == Trans.NOTRANS:
         solve_fn = lu.solve_factored
@@ -327,13 +437,8 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
     with stats.timer("SOLVE"):
         x_r = solve_fn(b_full if nrhs > 1 else b_full[:, 0])
     x0 = np.asarray(x_r, dtype=wdtype).reshape(n, nrhs)
-    if options.iter_refine == IterRefine.NOREFINE:
-        x = x0
-    else:
-        # collective=True: every rank calls solve_fn (the mesh solve is
-        # an SPMD program all controllers must enter), so no dx broadcast
-        cols = [pgsrfs(tc, a_loc, b2[:, j], x0[:, j], solve_fn,
-                       trans=trans, collective_solve=True)
-                for j in range(nrhs)]
-        x = np.stack(cols, axis=1)
-    return (x[:, 0] if one_d else x), 0
+    # collective_solve=True: every rank calls solve_fn (the mesh solve is
+    # an SPMD program all controllers must enter), so no dx broadcast
+    return _refine_tail(tc, options, a_loc, b2, x0, solve_fn, 0, one_d,
+                        nrhs, lu_out=lu_out, collective_solve=True,
+                        stats=stats)

@@ -18,6 +18,8 @@ from Isend/Irecv.
 from __future__ import annotations
 
 import ctypes
+import os
+import time
 
 import numpy as np
 
@@ -178,3 +180,112 @@ class TreeComm:
 
     def __exit__(self, *exc):
         self.close()
+
+
+class FaultyTreeComm(TreeComm):
+    """Fault-injection wrapper for the distributed tier's robustness tests.
+
+    Simulates an unreliable transport at the *chunk* layer of the typed
+    collectives (_f64_op — every bcast_any/reduce_sum_any/allreduce/
+    bcast_obj payload streams through it):
+
+      * reorder — a payload's chunks are delivered in a shuffled order
+        (each result still lands in its own slice, the sequence-number
+        reassembly a real transport would do);
+      * drop    — a chunk's collective runs but its delivery is discarded;
+        after a simulated timeout (`delay` seconds) the chunk is
+        retransmitted, up to `max_retries` times — the timeout-with-retry
+        discipline on collectives;
+      * dup     — a chunk is delivered twice; the duplicate overwrites the
+        same slice with the same data (idempotent receive).
+
+    The fault schedule is a deterministic function of (seed, draw index)
+    and every rank draws in the same order, so ALL ranks agree on which
+    chunk operations run and how many times: faults perturb ordering and
+    repetition, never collective matching (a mismatched schedule would
+    deadlock the shared-memory trees, exactly like mismatched MPI
+    collectives).  Counts land in .fault_counts.
+
+    Enable via make_treecomm + SLU_TPU_FAULTS (see below) or construct
+    directly in tests.
+    """
+
+    def __init__(self, name, n_ranks, rank, max_len: int = 4096,
+                 create: bool | None = None, drop: float = 0.0,
+                 dup: float = 0.0, reorder: float = 0.0,
+                 delay: float = 0.0, seed: int = 0, max_retries: int = 3):
+        super().__init__(name, n_ranks, rank, max_len=max_len,
+                         create=create)
+        self._p_drop = float(drop)
+        self._p_dup = float(dup)
+        self._p_reorder = float(reorder)
+        self._delay = float(delay)
+        self._max_retries = int(max_retries)
+        # one stream, consumed in lock-step on every rank (all ranks make
+        # the same collective calls with the same payload sizes)
+        self._frng = np.random.default_rng(seed)
+        self.fault_counts = {"drop": 0, "dup": 0, "reorder": 0}
+
+    def _f64_op(self, flat: np.ndarray, root: int, op) -> np.ndarray:
+        out = np.empty(flat.size, dtype=np.float64)
+        step = self.max_len
+        offsets = list(range(0, flat.size, step))
+        if len(offsets) > 1 and self._frng.random() < self._p_reorder:
+            self._frng.shuffle(offsets)
+            self.fault_counts["reorder"] += 1
+        for lo in offsets:
+            hi = min(lo + step, flat.size)
+            for attempt in range(self._max_retries + 1):
+                # each attempt re-slices the ORIGINAL payload: a
+                # retransmission carries the same contribution, so the
+                # reduction result is identical (idempotent resend)
+                res = op(np.ascontiguousarray(flat[lo:hi],
+                                              dtype=np.float64),
+                         root=root)[:hi - lo]
+                if (attempt < self._max_retries
+                        and self._frng.random() < self._p_drop):
+                    self.fault_counts["drop"] += 1
+                    if self._delay:
+                        time.sleep(self._delay)   # the simulated timeout
+                    continue
+                break
+            if self._frng.random() < self._p_dup:
+                self.fault_counts["dup"] += 1
+                res = op(np.ascontiguousarray(flat[lo:hi],
+                                              dtype=np.float64),
+                         root=root)[:hi - lo]
+            out[lo:hi] = res
+        return out
+
+
+def parse_fault_spec(spec: str) -> dict:
+    """Parse 'drop=0.1,dup=0.05,reorder=0.2,delay=0.001,seed=7' into
+    FaultyTreeComm kwargs; unknown keys raise (a typo'd knob silently
+    injecting nothing would defeat the test)."""
+    out = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key in ("seed", "max_retries"):
+            out[key] = int(val)
+        elif key in ("drop", "dup", "reorder", "delay"):
+            out[key] = float(val)
+        else:
+            raise ValueError(f"unknown fault-injection knob {key!r}")
+    return out
+
+
+def make_treecomm(name, n_ranks, rank, max_len: int = 4096,
+                  create: bool | None = None) -> TreeComm:
+    """Env-gated TreeComm factory: with SLU_TPU_FAULTS set (e.g.
+    'drop=0.2,reorder=0.2,seed=7') every attachment becomes a
+    FaultyTreeComm — all ranks read the same environment, so the
+    deterministic schedules agree.  Unset/empty: a plain TreeComm."""
+    spec = os.environ.get("SLU_TPU_FAULTS", "").strip()
+    if not spec:
+        return TreeComm(name, n_ranks, rank, max_len=max_len, create=create)
+    return FaultyTreeComm(name, n_ranks, rank, max_len=max_len,
+                          create=create, **parse_fault_spec(spec))

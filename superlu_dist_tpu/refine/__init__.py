@@ -1,1 +1,4 @@
-from superlu_dist_tpu.refine.ir import iterative_refinement
+from superlu_dist_tpu.refine.ir import (
+    iterative_refinement, componentwise_berr)
+from superlu_dist_tpu.refine.condest import (
+    onenormest, condition_estimate, ferr_estimate)

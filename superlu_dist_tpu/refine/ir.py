@@ -21,6 +21,38 @@ from superlu_dist_tpu.sparse.formats import SparseCSR
 ITMAX = 20
 
 
+def componentwise_berr(r: np.ndarray, den: np.ndarray, nnz: int,
+                       residual_dtype=np.float64) -> float:
+    """max_i |r_i| / den_i with the reference's underflow guard
+    (pdgsrfs.c:225 / dgsrfs.f:214): denominators at or below
+    safe1·safmin = (nnz+1)·safmin are bumped by that amount, so an
+    exactly-zero row reports berr 0 instead of 0/0 while a *tiny*
+    denominator is not rounded up to 1 (which understates berr).  The ONE
+    implementation shared by the serial loop here and the distributed
+    loop (parallel/pgsrfs.py) — the two must never drift."""
+    safmin = float(np.finfo(np.dtype(residual_dtype)).tiny)
+    bump = (nnz + 1) * safmin
+    den = np.where(den <= bump, den + bump, den)
+    return float(np.max(np.abs(r) / den))
+
+
+def _normalize_correction(dx, n: int, ncols: int) -> np.ndarray:
+    """Normalize a correction-solve result to (n, ncols).
+
+    solve_fn implementations legitimately squeeze a single remaining
+    column to (n,) (the host/device solvers mirror b's ndim); anything
+    else that doesn't match is a real contract violation and must fail
+    loudly here rather than broadcast garbage into the iterate."""
+    dx = np.asarray(dx)
+    if dx.ndim == 1:
+        dx = dx[:, None]
+    if dx.shape != (n, ncols):
+        raise ValueError(
+            f"correction solve returned shape {np.asarray(dx).shape}, "
+            f"expected ({n}, {ncols})")
+    return dx
+
+
 def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
                          solve_fn, itmax: int = ITMAX,
                          residual_dtype=np.float64):
@@ -42,8 +74,6 @@ def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
                 else np.float32)
     x2 = (x[:, None] if squeeze else x).astype(work, copy=True)
     eps = float(np.finfo(residual_dtype).eps)
-    safe1 = a.nnz + 1
-    safmin = np.finfo(residual_dtype).tiny
     nrhs = b2.shape[1]
     berrs = []
     # per-RHS stopping state, like the reference's outer loop over RHS
@@ -60,14 +90,14 @@ def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
         for k in range(nrhs):
             den = (a.abs_matvec(np.abs(x2[:, k]))
                    + np.abs(b2[:, k])).astype(x2.real.dtype)
-            den = np.where(den <= safe1 * safmin, den + safe1 * safmin, den)
-            berr[k] = float(np.max(np.abs(r[:, k]) / den))
+            berr[k] = componentwise_berr(r[:, k], den, a.nnz, residual_dtype)
         berrs.append(berr.copy())
         active &= (berr > eps) & (berr < lstres / 2.0)
         if not active.any():
             break
         lstres = np.where(active, berr, lstres)
-        dx = solve_fn(r[:, active])
-        x2[:, active] = x2[:, active] + (dx[:, None] if dx.ndim == 1 else dx)
+        dx = _normalize_correction(solve_fn(r[:, active]), len(x2),
+                                   int(active.sum()))
+        x2[:, active] = x2[:, active] + dx
     berrs = [float(b.max()) for b in berrs]
     return (x2[:, 0] if squeeze else x2), berrs

@@ -71,6 +71,40 @@ class Trans(enum.Enum):
     CONJ = 2
 
 
+@dataclasses.dataclass
+class RecoveryPolicy:
+    """Solver health & recovery policy — the pdgscon/pdgsrfs repair loop
+    made automatic (PAPER.md L4/L8: GESP trades pivoting stability for
+    speed, then detects and repairs the damage afterwards).
+
+    ``enabled`` drives the escalation ladder in drivers/gssvx.py: when
+    iterative refinement stagnates above ``berr_target`` the driver
+    escalates residual precision, retries the correction solves on
+    higher-precision factors (f64 on CPU, emulated-double df64 on f32-only
+    hardware), and finally refactors with diagnostics-informed re-scaling /
+    re-ordering.  Every rung is recorded in the SolveReport
+    (utils/stats.py) so callers see what degraded and why the answer is
+    still trustworthy.
+
+    ``sentinels`` arms the cheap isfinite reductions on factored panels
+    (numeric/factor.py, numeric/stream.py) that trip NumericBreakdownError
+    at the offending supernode, and the final solution check in the driver.
+
+    ``condest`` selects when the Hager–Higham condition estimate (rcond,
+    the pdgscon analog) and the normwise forward-error bound (ferr) are
+    computed: "always", "never", or "auto" (only when the ladder fired or
+    tiny pivots were replaced — the cases where the answer needs defending).
+    """
+
+    enabled: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SLU_TPU_RECOVERY", 1)))
+    sentinels: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SLU_TPU_SENTINELS", 1)))
+    condest: str = "auto"              # "always" | "auto" | "never"
+    berr_target: float | None = None   # None => 10·eps(residual dtype)
+    max_rungs: int = 3                 # ladder depth cap
+
+
 def _env_int(name: str, default: int) -> int:
     try:
         return int(os.environ[name])
@@ -151,6 +185,10 @@ class Options:
     # compare=False: ndarray values would make the generated __eq__ raise.
     user_perm_c: object = dataclasses.field(default=None, compare=False)
     user_perm_r: object = dataclasses.field(default=None, compare=False)
+    # solver health & recovery: condition estimation, non-finite sentinels,
+    # and the automatic escalation ladder (see RecoveryPolicy)
+    recovery: RecoveryPolicy = dataclasses.field(
+        default_factory=RecoveryPolicy)
 
 
 def set_default_options() -> Options:
@@ -169,6 +207,9 @@ def print_options(o: Options) -> str:
         if f.name in ("user_perm_c", "user_perm_r"):
             # summarize, never dump an n-entry permutation into the banner
             v = None if v is None else f"<perm len={len(v)}>"
+        elif f.name == "recovery":
+            v = (f"enabled={v.enabled} sentinels={v.sentinels} "
+                 f"condest={v.condest}")
         lines.append(f"**    {f.name:<20s} {getattr(v, 'name', v)}")
     lines.append("**************************************************")
     return "\n".join(lines)

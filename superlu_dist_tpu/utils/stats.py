@@ -21,6 +21,61 @@ PHASES = (
 
 
 @dataclass
+class RungRecord:
+    """One escalation-ladder action (drivers/gssvx.py): what was tried,
+    why, and what it bought.  berr values are max-over-RHS componentwise
+    backward errors before/after the rung."""
+
+    name: str                     # "residual-precision" | "hiprec-factors"
+                                  # | "refactor-rescale"
+    detail: str = ""              # e.g. the dtype escalated to
+    berr_before: float = float("inf")
+    berr_after: float = float("inf")
+    seconds: float = 0.0
+
+
+@dataclass
+class SolveReport:
+    """What the solve did to earn trust — the rcond/ferr/berr outputs of
+    the reference driver (pdgssvx.c's pdgscon + pdgsrfs reporting) plus
+    the recovery ladder's actions.  Attached to Stats.solve_report by
+    drivers/gssvx.gssvx; callers inspect it to see *what* degraded and
+    *why* the answer is still trustworthy."""
+
+    rcond: float | None = None    # Hager–Higham 1-norm estimate (pdgscon)
+    ferr: list | None = None      # per-RHS normwise forward-error bounds
+    berr: float | None = None     # final max-over-RHS backward error
+    berr_history: list = field(default_factory=list)
+    rungs: list = field(default_factory=list)     # RungRecord per escalation
+    tiny_pivots: int = 0          # ReplaceTinyPivot count for THIS solve
+    refine_steps: int = 0
+    target: float | None = None   # the berr convergence target applied
+    converged: bool = True        # final berr <= target (True w/o refine)
+    finite: bool = True           # solution passed the isfinite sentinel
+    factor_dtype: str = ""        # dtype of the factors the answer rests on
+
+    def summary(self) -> str:
+        parts = [f"factor dtype {self.factor_dtype}" if self.factor_dtype
+                 else ""]
+        if self.rcond is not None:
+            parts.append(f"rcond {self.rcond:.3e}")
+        if self.berr is not None:
+            parts.append(f"berr {self.berr:.3e}")
+        if self.ferr:
+            parts.append(f"ferr {max(self.ferr):.3e}")
+        if self.tiny_pivots:
+            parts.append(f"{self.tiny_pivots} tiny pivots replaced")
+        for r in self.rungs:
+            parts.append(f"rung {r.name}[{r.detail}] "
+                         f"berr {r.berr_before:.2e}->{r.berr_after:.2e}")
+        if not self.finite:
+            parts.append("NON-FINITE")
+        if not self.converged:
+            parts.append("NOT CONVERGED to target")
+        return "; ".join(p for p in parts if p)
+
+
+@dataclass
 class Stats:
     utime: dict = field(default_factory=lambda: {p: 0.0 for p in PHASES})
     ops: dict = field(default_factory=lambda: {p: 0.0 for p in PHASES})
@@ -30,6 +85,7 @@ class Stats:
     current_memory_bytes: int = 0
     for_lu_bytes: int = 0         # dQuerySpace_dist analog: packed L+U
     pool_bytes: int = 0           # transient Schur update pool
+    solve_report: object = None   # SolveReport of the last driver solve
 
     @contextlib.contextmanager
     def timer(self, phase: str):
@@ -73,6 +129,8 @@ class Stats:
             lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
         if self.refine_steps:
             lines.append(f"    refinement steps: {self.refine_steps}")
+        if self.solve_report is not None:
+            lines.append(f"    solve health: {self.solve_report.summary()}")
         if self.for_lu_bytes:
             # dQuerySpace_dist-style report (SRC/dmemory_dist.c:73)
             lines.append(f"    L\\U storage {self.for_lu_bytes / 1e6:10.2f} MB"

@@ -1,0 +1,381 @@
+"""Solver health & recovery subsystem.
+
+The error model (info codes / SingularMatrixError / NumericBreakdownError),
+the Hager–Higham condition estimate and FERR bounds (refine/condest.py),
+the SolveReport, and the automatic escalation ladder (drivers/gssvx.py) —
+the GESP detect-and-repair loop the reference builds from pdgscon +
+pdgsrfs + ReplaceTinyPivot accounting (PAPER.md L4/L8).
+"""
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric, gssvx
+from superlu_dist_tpu.models.gallery import (
+    hilbert, poisson2d, rank_deficient_arrowhead, zero_row_col)
+from superlu_dist_tpu.refine.condest import onenormest
+from superlu_dist_tpu.refine.ir import (
+    componentwise_berr, iterative_refinement)
+from superlu_dist_tpu.utils.errors import (
+    NumericBreakdownError, SingularMatrixError)
+from superlu_dist_tpu.utils.options import (
+    ColPerm, IterRefine, Options, RecoveryPolicy, RowPerm)
+from superlu_dist_tpu.utils.stats import SolveReport
+
+
+# ---------------------------------------------------------------------------
+# error model: info conventions and propagation
+# ---------------------------------------------------------------------------
+
+def test_singular_matrix_error_info_is_one_based():
+    err = SingularMatrixError(5)       # 0-based first zero-pivot column
+    assert err.info == 6               # reference: 1-based info > 0
+    assert "U(5,5)" in str(err)
+
+
+def test_replace_tiny_pivot_false_propagates_info():
+    """Exactly-singular A + ReplaceTinyPivot=NO: the driver returns
+    info > 0 and no solution (pdgstrf.c:234-241), and a later solve on
+    the poisoned handle raises with the SAME 1-based info."""
+    a = zero_row_col(6, which="row")
+    opts = Options(replace_tiny_pivot=False, equil=False,
+                   row_perm=RowPerm.NOROWPERM, col_perm=ColPerm.NATURAL,
+                   iter_refine=IterRefine.NOREFINE)
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert info > 0 and x is None
+    assert lu.numeric is not None and not lu.numeric.finite
+    with pytest.raises(SingularMatrixError) as exc:
+        lu.solve_factored(np.ones(a.n_rows))
+    assert exc.value.info == info
+
+
+def test_zero_column_singular_flagged():
+    a = zero_row_col(6, which="col")
+    opts = Options(replace_tiny_pivot=False, equil=False,
+                   row_perm=RowPerm.NOROWPERM,
+                   iter_refine=IterRefine.NOREFINE)
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert info > 0 and x is None
+
+
+# ---------------------------------------------------------------------------
+# non-finite sentinels: NumericBreakdownError
+# ---------------------------------------------------------------------------
+
+def _nan_poisoned(nx=8):
+    a = poisson2d(nx)
+    a.data = a.data.copy()
+    a.data[len(a.data) // 2] = np.nan
+    return a
+
+
+def test_nan_input_trips_numeric_breakdown():
+    """NaN input with ReplaceTinyPivot active must trip the structured
+    sentinel at factorization time — naming a supernode — instead of
+    propagating NaN through the whole elimination."""
+    a = _nan_poisoned()
+    opts = Options(equil=False, row_perm=RowPerm.NOROWPERM)
+    with pytest.raises(NumericBreakdownError) as exc:
+        gssvx(opts, a, np.ones(a.n_rows))
+    assert exc.value.supernode >= 0
+    assert exc.value.col >= 0
+    assert "supernode" in str(exc.value)
+
+
+def test_sentinels_disabled_flags_instead_of_raising():
+    """With sentinels off the NaN propagates (the pre-subsystem
+    behavior), but the SolveReport still FLAGS the non-finite result —
+    never a silent wrong answer."""
+    a = _nan_poisoned()
+    opts = Options(equil=False, row_perm=RowPerm.NOROWPERM,
+                   iter_refine=IterRefine.NOREFINE,
+                   recovery=RecoveryPolicy(enabled=False, sentinels=False,
+                                           condest="never"))
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert not np.all(np.isfinite(x))
+    assert stats.solve_report is not None
+    assert stats.solve_report.finite is False
+
+
+def test_localize_nonfinite_names_earliest_supernode():
+    from superlu_dist_tpu.numeric.factor import (
+        localize_nonfinite, numeric_factorize)
+    a = _nan_poisoned(6)
+    opts = Options(equil=False, row_perm=RowPerm.NOROWPERM)
+    lu, bvals, stats = analyze(opts, a)
+    with pytest.raises(NumericBreakdownError):
+        numeric_factorize(lu.plan, bvals, lu.anorm, replace_tiny=True)
+    numeric = numeric_factorize(lu.plan, bvals, lu.anorm,
+                                replace_tiny=True, check_finite=False)
+    sn, col = localize_nonfinite(lu.plan, numeric.fronts)
+    assert 0 <= sn and 0 <= col < a.n_rows
+
+
+# ---------------------------------------------------------------------------
+# condition estimation / SolveReport
+# ---------------------------------------------------------------------------
+
+def test_onenormest_never_overestimates():
+    rng = np.random.default_rng(1)
+    for n in (5, 23, 64):
+        m = rng.standard_normal((n, n)) * np.exp(
+            2 * rng.standard_normal(n))[:, None]
+        true = float(np.abs(m).sum(axis=0).max())
+        est = onenormest(n, lambda v: m @ v, lambda v: m.T @ v)
+        assert est <= true * (1 + 1e-10)
+        assert est >= 0.25 * true
+
+
+def test_rcond_matches_true_condition():
+    a = poisson2d(10)
+    opts = Options(recovery=RecoveryPolicy(condest="always"))
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert info == 0
+    rep = stats.solve_report
+    assert rep.rcond is not None and 0 < rep.rcond <= 1
+    # equilibration is a no-op for this matrix; compare against the true
+    # 1-norm condition number (the estimate may only UNDER-estimate the
+    # condition, i.e. over-estimate rcond, by a modest factor)
+    true_rcond = 1.0 / np.linalg.cond(a.to_dense(), 1)
+    assert true_rcond <= rep.rcond <= 4 * true_rcond
+    # ferr bounds the true forward error
+    assert rep.ferr is not None and all(f < 1e-8 for f in rep.ferr)
+
+
+def test_report_fields_well_conditioned_defaults():
+    a = poisson2d(8)
+    xt = np.random.default_rng(0).standard_normal(a.n_rows)
+    x, lu, stats, info = gssvx(Options(), a, a.matvec(xt))
+    rep = stats.solve_report
+    assert isinstance(rep, SolveReport)
+    assert rep.converged and rep.finite
+    assert rep.berr is not None and rep.berr <= rep.target
+    assert rep.rungs == [] and rep.berr_history
+    assert rep.factor_dtype in ("float64", "float32")
+    assert "berr" in rep.summary()
+    assert "solve health" in stats.report()
+
+
+# ---------------------------------------------------------------------------
+# escalation ladder (acceptance criteria)
+# ---------------------------------------------------------------------------
+
+NEAR_SINGULAR = dict(n=60, delta=1e-6, seed=0)
+
+
+def test_escalation_ladder_recovers_near_singular_f32():
+    """Acceptance: a gallery near-singular system with f32 factors returns
+    finite x with rcond populated, at least one escalation rung recorded,
+    and final berr <= 10·eps(f64 working dtype)."""
+    a = rank_deficient_arrowhead(**NEAR_SINGULAR)
+    xt = np.random.default_rng(1).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    x, lu, stats, info = gssvx(Options(factor_dtype="float32"), a, b)
+    assert info == 0
+    rep = stats.solve_report
+    assert np.all(np.isfinite(x))
+    assert rep.rcond is not None and rep.rcond > 0
+    assert len(rep.rungs) >= 1
+    names = [r.name for r in rep.rungs]
+    assert "hiprec-factors" in names or "refactor-rescale" in names
+    eps = float(np.finfo(np.float64).eps)
+    assert rep.berr <= 10 * eps, rep.summary()
+    assert rep.converged
+    # the adopted rung genuinely improved things
+    adopted = [r for r in rep.rungs if r.berr_after < r.berr_before]
+    assert adopted
+
+
+def test_recovery_disabled_flags_stagnation():
+    """Same system, recovery disabled: the solver must flag the failure
+    (stagnated berr, converged=False) instead of silently returning a
+    wrong answer."""
+    a = rank_deficient_arrowhead(**NEAR_SINGULAR)
+    xt = np.random.default_rng(1).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    opts = Options(factor_dtype="float32",
+                   recovery=RecoveryPolicy(enabled=False))
+    x, lu, stats, info = gssvx(opts, a, b)
+    rep = stats.solve_report
+    assert rep.rungs == []
+    assert not rep.converged
+    assert rep.berr > rep.target
+    # diagnosis still offered on the auto tier (non-convergence gates it)
+    assert rep.rcond is not None
+
+
+def test_ladder_returns_escalated_handle():
+    """The returned lu must be the handle the answer actually rests on:
+    after a hiprec-factors rung, subsequent FACTORED-mode solves reuse
+    the escalated factors and stay accurate."""
+    from superlu_dist_tpu.utils.options import Fact
+    a = rank_deficient_arrowhead(**NEAR_SINGULAR)
+    rng = np.random.default_rng(2)
+    b1 = a.matvec(rng.standard_normal(a.n_rows))
+    x1, lu, stats, info = gssvx(Options(factor_dtype="float32"), a, b1)
+    assert info == 0 and stats.solve_report.rungs
+    assert str(lu.numeric.dtype) == "float64"    # escalated handle
+    xt2 = rng.standard_normal(a.n_rows)
+    b2 = a.matvec(xt2)
+    x2, lu, stats2, info2 = gssvx(Options(fact=Fact.FACTORED), a, b2, lu=lu)
+    assert info2 == 0
+    assert np.linalg.norm(b2 - a.matvec(x2)) / np.linalg.norm(b2) < 1e-12
+
+
+def test_hilbert_f32_ladder():
+    """Hilbert at n=8 (kappa ~ 1.5e10): past f32+IR, inside f64."""
+    a = hilbert(8)
+    xt = np.ones(a.n_rows)
+    b = a.matvec(xt)
+    x, lu, stats, info = gssvx(Options(factor_dtype="float32"), a, b)
+    assert info == 0
+    rep = stats.solve_report
+    assert rep.converged and rep.berr <= rep.target, rep.summary()
+
+
+def test_residual_precision_rung_slu_single():
+    """SLU_SINGLE's f32 residual can't see below single eps.  Against its
+    OWN tier target (10·eps32) it converges — no ladder.  Against an
+    explicit f64-class berr_target, the ladder's first rung escalates the
+    residual to f64 on the SAME factors and reaches it."""
+    a = poisson2d(10)
+    xt = np.random.default_rng(3).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    opts = Options(iter_refine=IterRefine.SLU_SINGLE)
+    x, lu, stats, info = gssvx(opts, a, b)
+    assert info == 0 and stats.solve_report.converged
+    assert stats.solve_report.rungs == []    # its own tier target is met
+
+    opts = Options(iter_refine=IterRefine.SLU_SINGLE,
+                   recovery=RecoveryPolicy(berr_target=1e-14))
+    x, lu, stats, info = gssvx(opts, a, b)
+    assert info == 0
+    rep = stats.solve_report
+    names = [r.name for r in rep.rungs]
+    assert names and names[0] == "residual-precision"
+    eps32 = float(np.finfo(np.float32).eps)
+    assert rep.berr < eps32      # beyond what the f32 residual could see
+    assert rep.berr <= 1e-14 and rep.converged
+
+
+# ---------------------------------------------------------------------------
+# shared BERR guard + IR shape normalization (satellites)
+# ---------------------------------------------------------------------------
+
+def test_componentwise_berr_guard_tiny_denominators():
+    # an exactly-zero row with zero residual reports 0, not 0/0
+    r = np.array([0.0, 1e-3])
+    den = np.array([0.0, 1.0])
+    assert componentwise_berr(r, den, nnz=10) == pytest.approx(1e-3)
+    # a zero denominator with a REAL residual reports huge (the old
+    # den>0 -> 1.0 rewrite understated this to 1e-30)
+    assert componentwise_berr(np.array([1e-30]), np.array([0.0]),
+                              nnz=10) > 1.0
+    # the distributed loop shares the one implementation
+    from superlu_dist_tpu.parallel import pgsrfs as mod
+    assert mod.componentwise_berr is componentwise_berr
+
+
+def test_ir_active_set_shape_normalization():
+    """nrhs=3 with per-column convergence at different iterations and a
+    solve_fn that SQUEEZES a single remaining column: the active-set
+    bookkeeping must normalize shapes instead of mis-broadcasting."""
+    a = poisson2d(6)
+    n = a.n_rows
+    d = a.to_dense()
+    rng = np.random.default_rng(4)
+    xt = rng.standard_normal((n, 3))
+    b = a.matvec(xt)
+    shapes = []
+
+    def solve_fn(r):
+        shapes.append(np.shape(r))
+        dx = np.linalg.solve(d, r)
+        # per-column damping => columns converge at different iterations
+        k = dx.shape[1]
+        dx = dx * (1.0 - np.array([0.2, 1e-4, 1e-8])[:k][None, :])
+        if k == 1:
+            return dx[:, 0]          # the squeezing-solver regression
+        return dx
+
+    x0 = solve_fn(b) if b.ndim > 1 else None
+    x, berrs = iterative_refinement(a, b, np.asarray(x0), solve_fn)
+    assert np.allclose(x, xt, atol=1e-10)
+    assert berrs[-1] < 1e-14
+    # the active set genuinely shrank to a single squeezed column
+    assert any(s[1] == 1 for s in shapes if len(s) == 2), shapes
+
+
+def test_ir_rejects_wrong_correction_shape():
+    a = poisson2d(4)
+    n = a.n_rows
+    b = a.matvec(np.ones(n))
+
+    def bad_solve(r):
+        return np.zeros(n + 1)       # contract violation
+
+    with pytest.raises(ValueError, match="correction solve"):
+        iterative_refinement(a, b, np.zeros(n), bad_solve)
+
+
+# ---------------------------------------------------------------------------
+# distributed driver health report
+# ---------------------------------------------------------------------------
+
+def test_pgssvx_attaches_distributed_solve_report():
+    """The distributed driver reports refinement health the same way the
+    serial one does: lu_out['solve_report'] / stats.solve_report with the
+    allreduced berr history (single-rank tree — the collective logic is
+    identical; the multi-rank path is covered by test_treecomm.py)."""
+    from superlu_dist_tpu import native
+    if not native.available():
+        pytest.skip("native library unavailable")
+    import os
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+    from superlu_dist_tpu.parallel.pgssvx import pgssvx
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    a = poisson2d(8)
+    n = a.n_rows
+    xt = np.random.default_rng(0).standard_normal(n)
+    b = a.matvec(xt)
+    part = distribute_rows(a, 1)[0]
+    name = f"/slu_rec_rep_{os.getpid()}"
+    with TreeComm(name, 1, 0, max_len=n, create=True) as tc:
+        lu_out = {}
+        x, info = pgssvx(tc, Options(factor_dtype="float32"), part, b,
+                         lu_out=lu_out)
+    assert info == 0
+    rep = lu_out["solve_report"]
+    assert rep is not None and rep.berr is not None
+    assert rep.converged and rep.finite
+    assert lu_out["stats"].solve_report is rep
+    assert np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# gallery generators
+# ---------------------------------------------------------------------------
+
+def test_gallery_hilbert_values():
+    a = hilbert(5)
+    d = a.to_dense()
+    assert d[0, 0] == 1.0 and d[2, 3] == pytest.approx(1.0 / 6.0)
+    assert np.allclose(d, d.T)
+
+
+def test_gallery_arrowhead_exact_singular():
+    a = rank_deficient_arrowhead(20, delta=0.0)
+    d = a.to_dense()
+    assert np.linalg.matrix_rank(d) == 19
+    np.testing.assert_allclose(d[-1, :-1], (d[1] + d[2])[:-1])
+
+
+def test_gallery_zero_row_col():
+    for which in ("row", "col", "both"):
+        a = zero_row_col(5, k=7, which=which)
+        d = a.to_dense()
+        if which in ("row", "both"):
+            assert not d[7].any()
+        if which in ("col", "both"):
+            assert not d[:, 7].any()

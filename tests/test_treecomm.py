@@ -15,6 +15,10 @@ import pytest
 
 from superlu_dist_tpu import native
 
+# NOTE: per-test @pytest.mark.slow below marks the multi-process fork
+# tests; the faultinject tests run in the fast tier (they use spawn
+# workers and small payloads) — wired into tier-1 by design so induced
+# communication faults are exercised on every CI run.
 pytestmark = pytest.mark.skipif(not native.available(),
                                 reason="native library unavailable")
 
@@ -74,18 +78,22 @@ def _run(n_ranks, root):
         assert allred == float(n_ranks), (rank, allred)
 
 
+@pytest.mark.slow
 def test_flat_tree_6_ranks():
     _run(6, root=0)
 
 
+@pytest.mark.slow
 def test_flat_tree_nonzero_root():
     _run(5, root=3)
 
 
+@pytest.mark.slow
 def test_binary_tree_12_ranks():
     _run(12, root=0)
 
 
+@pytest.mark.slow
 def test_binary_tree_nonzero_root():
     _run(10, root=7)
 
@@ -115,6 +123,7 @@ def _obj_worker(name, n_ranks, rank, root, q):
         q.put((rank, ok))
 
 
+@pytest.mark.slow
 def test_bcast_obj_bit_exact_chunked():
     """Pickled-object broadcast (the mesh tier's analysis transport):
     bytes ride the f64 slots bit-exactly — int64 beyond 2^53 and NaN
@@ -155,7 +164,130 @@ def test_single_rank_noop():
         np.testing.assert_array_equal(b, np.arange(4.0))
 
 
-import pytest  # noqa: E402
+# ---------------------------------------------------------------------------
+# Fault injection (TreeComm wrapper — drops/duplicates/reorders + timeout-
+# with-retry).  These run in the FAST tier on purpose: the distributed
+# refinement loop must be exercised under induced faults on every CI run.
+# ---------------------------------------------------------------------------
 
-# slow tier: multi-process / native-build / at-scale — fast CI runs -m "not slow"
-pytestmark = pytest.mark.slow
+FAULT_SPEC = "drop=0.3,dup=0.2,reorder=0.5,delay=0.0005,seed=7"
+
+
+@pytest.mark.faultinject
+def test_faulty_collectives_bit_exact_single_rank():
+    """Aggressive chunk faults (drop+retry, duplicate, reorder) must be
+    fully masked by the retransmission layer: payloads come back
+    bit-exact and the fault counters prove faults were actually
+    injected."""
+    from superlu_dist_tpu.parallel.treecomm import (
+        FaultyTreeComm, parse_fault_spec)
+    name = f"/slu_tree_fault1_{os.getpid()}"
+    rng = np.random.default_rng(3)
+    payload = rng.standard_normal(700)          # max_len=64 -> 11 chunks
+    with FaultyTreeComm(name, 1, 0, max_len=64, create=True,
+                        **parse_fault_spec(FAULT_SPEC)) as tc:
+        got = tc.bcast_any(payload.copy())
+        np.testing.assert_array_equal(got, payload)
+        got = tc.allreduce_sum_any(payload.copy())
+        np.testing.assert_array_equal(got, payload)
+        blob = b"\x01\x02 fault transport \xff" * 41
+        assert tc.bcast_bytes(blob) == blob
+        assert sum(tc.fault_counts.values()) > 0, tc.fault_counts
+
+
+def test_parse_fault_spec_rejects_unknown_knob():
+    from superlu_dist_tpu.parallel.treecomm import parse_fault_spec
+    with pytest.raises(ValueError):
+        parse_fault_spec("dorp=0.1")
+    assert parse_fault_spec(" drop=0.1, seed=3 ") == {"drop": 0.1,
+                                                      "seed": 3}
+
+
+def _pgsrfs_fault_worker(name, n_ranks, rank, part, b_loc, q):
+    # spawn-safe: constructed via the env-gated factory so the fault
+    # schedule comes from SLU_TPU_FAULTS exactly as production would
+    from superlu_dist_tpu.parallel.treecomm import make_treecomm
+    from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
+    tc = make_treecomm(name, n_ranks, rank, max_len=part.n, create=False)
+    try:
+        stats = {}
+        x = pgsrfs(tc, part, b_loc, None, None, root=0, stats_out=stats)
+        q.put((rank, x, stats["iters"], stats["berr"]))
+    finally:
+        tc.close()
+
+
+def _run_pgsrfs(a, b, x0, solve_fn, fault_spec):
+    """Run the 4-rank distributed refinement, optionally under injected
+    faults; returns (x, iters, berr) from the root's view."""
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+    from superlu_dist_tpu.parallel.treecomm import make_treecomm
+    from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
+
+    nranks = 4
+    n = a.n_rows
+    parts = distribute_rows(a, nranks)
+    b_blocks = [b[p.fst_row:p.fst_row + p.m_loc] for p in parts]
+    old = os.environ.pop("SLU_TPU_FAULTS", None)
+    if fault_spec:
+        os.environ["SLU_TPU_FAULTS"] = fault_spec
+    name = f"/slu_pgsrfs_fi_{os.getpid()}_{1 if fault_spec else 0}"
+    owner = make_treecomm(name, nranks, 0, max_len=n, create=True)
+    try:
+        ctx = mp.get_context("spawn")   # no fork of the jax-laden parent
+        q = ctx.Queue()
+        procs = [ctx.Process(target=_pgsrfs_fault_worker,
+                             args=(name, nranks, r, parts[r],
+                                   b_blocks[r], q))
+                 for r in range(1, nranks)]
+        for p in procs:
+            p.start()
+        stats = {}
+        x = pgsrfs(owner, parts[0], b_blocks[0], x0, solve_fn, root=0,
+                   stats_out=stats)
+        others = [q.get(timeout=180) for _ in procs]
+        for p in procs:
+            p.join(timeout=180)
+            assert p.exitcode == 0
+        for rank, xr, it_r, berr_r in others:
+            np.testing.assert_allclose(xr, x, rtol=0, atol=1e-12)
+            assert it_r == stats["iters"]
+    finally:
+        owner.close(unlink=True)
+        os.environ.pop("SLU_TPU_FAULTS", None)
+        if old is not None:
+            os.environ["SLU_TPU_FAULTS"] = old
+    return x, stats["iters"], stats["berr"]
+
+
+@pytest.mark.faultinject
+def test_pgsrfs_converges_under_drop_and_reorder():
+    """Acceptance: the distributed refinement reaches the same berr under
+    the fault-injection wrapper (drop+reorder+dup) as without it, within
+    +2 iterations — the faults are masked by retransmission, never
+    absorbed into the numerics."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.utils.options import IterRefine
+
+    a = poisson2d(10)
+    xtrue = np.random.default_rng(0).standard_normal(a.n_rows)
+    b = a.matvec(xtrue)
+    # coarse f32 factors so the distributed IR has real work to do
+    opts = slu.Options(iter_refine=IterRefine.NOREFINE,
+                       factor_dtype="float32")
+    x0, lu, _, info = slu.gssvx(opts, a, b)
+    assert info == 0
+
+    x_ref, iters_ref, berr_ref = _run_pgsrfs(a, b, x0, lu.solve_factored,
+                                             fault_spec=None)
+    x_flt, iters_flt, berr_flt = _run_pgsrfs(a, b, x0, lu.solve_factored,
+                                             fault_spec=FAULT_SPEC)
+    eps = float(np.finfo(np.float64).eps)
+    assert berr_ref <= 10 * eps, berr_ref
+    # same berr (retransmission is value-preserving) within +2 iterations
+    np.testing.assert_allclose(berr_flt, berr_ref, rtol=1e-6, atol=1e-15)
+    assert abs(iters_flt - iters_ref) <= 2, (iters_flt, iters_ref)
+    np.testing.assert_allclose(x_flt, x_ref, rtol=0, atol=1e-12)
