@@ -172,6 +172,15 @@ def main():
     from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
 
+    # structured tracing (obs/trace.py): SLU_TPU_TRACE=<path> turns this
+    # run into one self-describing artifact — phase spans from this
+    # function, dispatch/kernel-shape spans from the executors, comm
+    # spans for the host<->device transfers (docs/OBSERVABILITY.md)
+    from superlu_dist_tpu.obs.trace import get_tracer
+    tracer = get_tracer()
+    if tracer.enabled:
+        RESULT["trace"] = tracer.path
+
     from superlu_dist_tpu.models.gallery import poisson3d
     from superlu_dist_tpu.sparse.formats import symmetrize_pattern
     from superlu_dist_tpu.utils.options import Options
@@ -266,6 +275,7 @@ def main():
         _emit(final=True)
         return
     RESULT["phase"] = "prepare"
+    t_phase = time.perf_counter()
 
     # BENCH_MATRIX=geo3d swaps in the irregular FEM-like family
     # (random_geometric_3d, the audikw_1-class surrogate — BASELINE
@@ -306,7 +316,11 @@ def main():
     _log(f"prepared n={n} groups={len(plan.groups)} "
          f"flops={plan.flops / 1e9:.0f} GF")
 
+    tracer.complete("prepare", "phase", t_phase,
+                    time.perf_counter() - t_phase, n=n,
+                    groups=len(plan.groups))
     RESULT["phase"] = "factor-compile"
+    t_phase = time.perf_counter()
     # BENCH_GRANULARITY: "group" (one kernel per shape key, streamed),
     # "level" (one program per elimination level), or "fused" (the WHOLE
     # factorization as one XLA program — viable again now that
@@ -346,10 +360,19 @@ def main():
     RESULT["n_kernels"] = ex.n_kernels
     RESULT["executed_flops"] = ex.executed_flops
     RESULT["padding_factor"] = round(ex.executed_flops / plan.flops, 2)
+    t_up = time.perf_counter()
     avals = jnp.asarray(avals_np)
     thresh = jnp.asarray(thresh_np)
+    if tracer.enabled:
+        jax.block_until_ready((avals, thresh))
+        tracer.complete("upload-avals", "comm", t_up,
+                        time.perf_counter() - t_up, op="h2d",
+                        bytes=int(avals_np.nbytes + thresh_np.nbytes))
     out = ex(avals, thresh)
     jax.block_until_ready(out[0])
+    tracer.complete("factor-compile", "phase", t_phase,
+                    time.perf_counter() - t_phase,
+                    kernels=ex.n_kernels, offload=ex.offload)
     _log(f"warm (compile) done, kernels={ex.n_kernels}, "
          f"offload={ex.offload}")
     if _default_cfg and NX == 48 and backend != "cpu":
@@ -376,6 +399,7 @@ def main():
         out = ex(avals, thresh)
         jax.block_until_ready(out[0])
         dt = time.perf_counter() - t0
+        tracer.complete("FACT", "phase", t0, dt, rep=rep)
         times.append(dt)
         # progressive: every rep updates the reported number, so a
         # watchdog fire mid-loop still carries a real measurement
@@ -392,7 +416,10 @@ def main():
              f"{plan.flops / dt / 1e9:.1f} GFLOP/s")
     fronts, tiny = out
     RESULT["tiny_pivots"] = int(tiny)
-    if ex.last_profile:
+    # legacy stderr kernel lines only under the (deprecated)
+    # SLU_TPU_PROFILE knob — the tracer's structured kernel spans are the
+    # first-class record (last_profile also fills whenever tracing is on)
+    if ex.last_profile and os.environ.get("SLU_TPU_PROFILE"):
         # kernel-shape trace (dgemm_mnk.dat analog) to stderr, top by time
         top = sorted(ex.last_profile, key=lambda r: -r["seconds"])[:15]
         for r in top:
@@ -406,6 +433,7 @@ def main():
     # be able to zero the factor GFLOPS: each phase degrades independently
     # and the JSON line always prints.
     RESULT["phase"] = "solve-residual"
+    t_phase = time.perf_counter()
     try:
         numeric = NumericFactorization(plan=plan, fronts=list(fronts),
                                        tiny_pivots=int(tiny),
@@ -445,9 +473,13 @@ def main():
         RESULT["solve_path"] = f"failed: {type(e).__name__}: {e}"
         _log(f"solve phase failed: {e}")
 
+    tracer.complete("solve-residual", "phase", t_phase,
+                    time.perf_counter() - t_phase)
+
     # Baseline: serial SuperLU (same code family as the reference) with
     # host CPU BLAS, factoring the identical matrix
     RESULT["phase"] = "cpu-baseline"
+    t_phase = time.perf_counter()
     try:
         import scipy.sparse as sp
         from scipy.sparse.linalg import splu
@@ -465,7 +497,12 @@ def main():
     except Exception as e:                        # pragma: no cover
         _log(f"baseline failed: {e}")
 
+    tracer.complete("cpu-baseline", "phase", t_phase,
+                    time.perf_counter() - t_phase)
     RESULT["phase"] = "done"
+    # flush explicitly: the watchdog's os._exit skips atexit, so the
+    # artifact must be on disk before the final line prints
+    tracer.close()
     _emit(final=True)
 
 

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Trace-overhead smoke run (check_nan_guards.sh style).
+
+Runs a small factor+solve twice in fresh subprocesses:
+
+* tracing OFF  — asserts the disabled path never allocates a Tracer
+  (the process-global stays the NULL_TRACER singleton, its span object
+  is the reused no-op) and that no artifact file appears;
+* tracing ON   — validates the artifacts: the Chrome trace JSON loads,
+  carries phase + kernel spans whose timestamps are monotone per
+  thread, the kernel spans inside each FACT phase sum to its duration
+  (within a slack factor — Python glue around tiny test kernels), and
+  the JSONL sidecar parses line by line.
+
+Exit 0 = pass.  Wired for CI next to the tier-1 command (ROADMAP.md);
+a few seconds on CPU.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# the child: one small factor+solve through the expert driver, then a
+# JSON line reporting what tracer the process ended up with
+CHILD = r"""
+import json, os, sys
+import numpy as np
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.obs import trace
+
+a = poisson2d(10)
+b = np.ones(a.n_rows)
+x, lu, stats, info = slu.gssvx(slu.Options(), a, b)
+assert info == 0, info
+res = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
+assert res < 1e-8, res
+t = trace.get_tracer()
+print(json.dumps({
+    "tracer": type(t).__name__,
+    "null_singleton": t is trace.NULL_TRACER,
+    "span_reused": t.span("a") is t.span("b"),
+    "fact_seconds": stats.utime["FACT"],
+}))
+"""
+
+
+def run_child(extra_env):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
+    env.pop("SLU_TPU_TRACE", None)
+    env.update(extra_env)
+    r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
+                       stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr.decode())
+        raise SystemExit(f"child failed (rc={r.returncode})")
+    return json.loads(r.stdout.decode().strip().splitlines()[-1])
+
+
+def fail(msg):
+    print(f"FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def main():
+    tmp = tempfile.mkdtemp(prefix="slu_trace_check_")
+    trace_path = os.path.join(tmp, "t.json")
+    jsonl_path = os.path.join(tmp, "t.jsonl")
+
+    # ---- off path: no tracer allocated, no artifact ----------------------
+    off = run_child({})
+    if off["tracer"] != "NullTracer" or not off["null_singleton"]:
+        fail(f"disabled path allocated a tracer: {off}")
+    if not off["span_reused"]:
+        fail("disabled path did not reuse the no-op span object")
+    if os.path.exists(trace_path) or os.path.exists(jsonl_path):
+        fail("disabled path created a trace artifact")
+    print(f"off: null tracer, no artifact, FACT {off['fact_seconds']:.3f}s")
+
+    # ---- on path: artifact exists and is well-formed ---------------------
+    on = run_child({"SLU_TPU_TRACE": trace_path})
+    if on["tracer"] != "Tracer":
+        fail(f"SLU_TPU_TRACE did not install a Tracer: {on}")
+    if not os.path.exists(trace_path):
+        fail(f"no Chrome trace artifact at {trace_path}")
+    if not os.path.exists(jsonl_path):
+        fail(f"no JSONL sidecar at {jsonl_path}")
+
+    doc = json.load(open(trace_path))
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        fail("traceEvents missing or empty")
+    for ev in events:
+        for k in ("name", "cat", "ph", "ts", "dur", "pid", "tid"):
+            if k not in ev:
+                fail(f"event missing field {k!r}: {ev}")
+    cats = {ev["cat"] for ev in events}
+    if not {"phase", "kernel"} <= cats:
+        fail(f"expected phase+kernel spans, got categories {sorted(cats)}")
+    # monotone start times per thread (the artifact is sorted)
+    last = {}
+    for ev in events:
+        key = (ev["pid"], ev["tid"])
+        if ev["ts"] < last.get(key, float("-inf")):
+            fail(f"ts not monotone for {key}")
+        last[key] = ev["ts"]
+    # kernel spans within each FACT phase must account for its duration
+    facts = [e for e in events if e["name"] == "FACT"
+             and e["cat"] == "phase"]
+    kernels = [e for e in events if e["cat"] == "kernel"]
+    if not facts:
+        fail("no FACT phase span")
+    for f in facts:
+        inner = sum(k["dur"] for k in kernels
+                    if k["ts"] >= f["ts"]
+                    and k["ts"] + k["dur"] <= f["ts"] + f["dur"] + 1)
+        if not (0.25 * f["dur"] <= inner <= 1.05 * f["dur"]):
+            fail(f"kernel spans ({inner:.0f}us) do not account for the "
+                 f"FACT phase ({f['dur']:.0f}us)")
+    n_rows = 0
+    for line in open(jsonl_path):
+        if line.strip():
+            json.loads(line)
+            n_rows += 1
+    if n_rows != len(events):
+        fail(f"JSONL rows ({n_rows}) != traceEvents ({len(events)})")
+    print(f"on: {len(events)} spans, categories {sorted(cats)}, "
+          f"artifact + sidecar well-formed")
+    print("trace overhead smoke: PASS")
+
+
+if __name__ == "__main__":
+    main()

@@ -58,6 +58,11 @@ def _build_parser():
     p.add_argument("--seed", type=int, default=0, help="xtrue RNG seed")
     p.add_argument("--sweep", action="store_true",
                    help="pdtest-style sweep: Fact tiers x orderings x nrhs")
+    p.add_argument("--stats", action="store_true",
+                   help="print the full PStatPrint analog after the run: "
+                        "Stats.report() plus the SolveReport health "
+                        "summary (SLU_TPU_STATS=1 does the same, plus "
+                        "the options banner, without the flag)")
     p.add_argument("-q", "--quiet", action="store_true",
                    help="suppress the PStatPrint report")
     return p
@@ -125,8 +130,12 @@ def run_once(a, args) -> int:
     from superlu_dist_tpu.utils.precision import inf_norm_error
     res = _resid(a, x, b, trans=args.trans)
     err = inf_norm_error(x, xtrue)
-    if not args.quiet:
+    if not args.quiet or args.stats:
         print(stats.report())
+        if args.stats and stats.solve_report is not None:
+            # the SolveReport on its own line (the report() embeds it in
+            # "solve health:"; --stats promises the explicit summary)
+            print(f"    solve report: {stats.solve_report.summary()}")
         berr = lu.berrs[-1] if lu.berrs else None
         print(f"    residual ||b-Ax||/||b||  {res:.3e}")
         print(f"    ||x-xtrue||_inf/||x||_inf {err:.3e}"

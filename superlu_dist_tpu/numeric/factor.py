@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.plan import FactorPlan
+from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.ops.dense import group_partial_factor
 
 
@@ -194,7 +195,38 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
             tiny = tiny + t
         return tuple(fronts), tiny
 
-    return jax.jit(fn)
+    jfn = jax.jit(fn)
+    # the fused path keeps real batch sizes (no pow-2 pad); shape padding
+    # is already inside _front_flops' padded (w, u) dims
+    from superlu_dist_tpu.symbolic.symbfact import _front_flops
+    executed = float(sum(g.batch * _front_flops(g.w, g.u)
+                         for g in plan.groups))
+
+    def traced(avals, thresh):
+        """Kernel-shape telemetry for the one-program executor: the whole
+        factorization is a single dispatch, so it records one issue span
+        plus one aggregate kernel span (blocking only when tracing is
+        enabled — the disabled path returns the async jitted call
+        untouched)."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return jfn(avals, thresh)
+        import time
+        t0 = time.perf_counter()
+        out = jfn(avals, thresh)
+        tracer.complete("issue fused", "dispatch", t0,
+                        time.perf_counter() - t0, groups=len(plan.groups))
+        jax.block_until_ready(out[0])
+        tracer.complete("factor-fused", "kernel", t0,
+                        time.perf_counter() - t0,
+                        n_groups=len(plan.groups), aggregate=True,
+                        executed_flops=executed,
+                        structural_flops=float(plan.flops),
+                        padding=round(executed / max(float(plan.flops),
+                                                     1.0), 4))
+        return out
+
+    return traced
 
 
 def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.numeric.factor import group_step
+from superlu_dist_tpu.obs.trace import NULL_TRACER, get_tracer
 from superlu_dist_tpu.symbolic.symbfact import _front_flops
 
 
@@ -155,6 +156,7 @@ class StreamExecutor:
         # transfer-wait / (the rest =) device compute
         self.last_offload_wait_seconds = None
         self._lag = int(os.environ.get("SLU_TPU_OFFLOAD_LAG", "8"))
+        self._tracer = NULL_TRACER   # latched from the global per call
         # non-finite sentinel (set per call by numeric_factorize): when
         # armed, every group materialized on the host mid-stream is
         # isfinite-checked so a breakdown aborts the stream at the
@@ -300,8 +302,12 @@ class StreamExecutor:
         # pdgstrf.c:380-387 -> dgemm_mnk.dat): per-group synchronous timing.
         # NOTE: blocking per group serializes the async dispatch stream, so
         # profiled runs measure per-kernel cost, not end-to-end overlap.
+        # The structured span tracer (obs/trace.py, SLU_TPU_TRACE) implies
+        # profiling for the same reason: its kernel spans must sum to the
+        # factor wall time, which only per-group blocking guarantees.
         import os
-        profile = bool(os.environ.get("SLU_TPU_PROFILE"))
+        self._tracer = tracer = get_tracer()
+        profile = bool(os.environ.get("SLU_TPU_PROFILE")) or tracer.enabled
         if profile:
             self.last_profile = []
         # SLU_TPU_PROGRESS=K: log every K groups/levels issued (async
@@ -338,18 +344,28 @@ class StreamExecutor:
                 print(f"[stream] issuing group {gi}/{len(self._steps)} "
                       f"(+{time.perf_counter() - t_issue0:.1f}s)",
                       file=sys.stderr, flush=True)
-            if profile:
+            if profile or tracer.enabled:
                 t0 = time.perf_counter()
             (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)
+            if tracer.enabled:
+                # async-issue span: how long the DISPATCH took (Python +
+                # transfer setup), before any blocking — the
+                # dispatch-bound-vs-compute-bound split per group
+                tracer.complete(f"issue g{gi}", "dispatch", t0,
+                                time.perf_counter() - t0, group=gi,
+                                level=int(plan.groups[gi].level))
             if profile:
                 jax.block_until_ready(lp)
+                dt = time.perf_counter() - t0
                 (b, m, w, u), _, _, _, _ = key
                 grp = plan.groups[gi]
                 gflop = float(_front_flops(w, u)) * grp.batch / 1e9
                 self.last_profile.append({
                     "level": grp.level, "batch": b, "m": m, "w": w, "u": u,
                     "host": on_host,
-                    "seconds": time.perf_counter() - t0, "gflop": gflop})
+                    "seconds": dt, "gflop": gflop})
+                self._trace_kernel(t0, dt, grp.level, b, m, w, u,
+                                   grp.batch, on_host)
             self._emit_front(fronts, lp, up, nreal, on_host)
             tiny = tiny + t
         tiny = tiny + tiny_host
@@ -360,6 +376,27 @@ class StreamExecutor:
         self.last_dispatch_seconds = time.perf_counter() - t_issue0
         self.last_offload_wait_seconds = self._offload_wait
         return self._finalize_fronts(fronts), tiny
+
+    def _trace_kernel(self, t0, dt, level, b, m, w, u, nreal, host,
+                      aggregate=False, executed=None, structural=None):
+        """Structured kernel-shape record (the dgemm_mnk.dat analog):
+        executed vs structural flops and the padding ratio per dispatch,
+        so MFU attribution needs no stderr scraping."""
+        tr = self._tracer
+        if not tr.enabled:
+            return
+        if executed is None:
+            executed = float(b) * _front_flops(w, u)
+        if structural is None:
+            structural = float(nreal) * _front_flops(w, u)
+        tr.complete(f"lu b{b} m{m} w{w} u{u}", "kernel", t0, dt,
+                    level=int(level), batch=int(nreal),
+                    padded_batch=int(b), m=int(m), w=int(w), u=int(u),
+                    host=bool(host), aggregate=bool(aggregate),
+                    executed_flops=float(executed),
+                    structural_flops=float(structural),
+                    padding=round(float(executed)
+                                  / max(float(structural), 1.0), 4))
 
     def _host_prologue(self, avals, thresh, pool):
         """(active, avals, thresh, pool): when the plan opens with
@@ -406,7 +443,13 @@ class StreamExecutor:
                 if not isinstance(dlp, np.ndarray):
                     t0 = time.perf_counter()
                     fronts[i] = (np.asarray(dlp), np.asarray(dup))
-                    self._offload_wait += time.perf_counter() - t0
+                    dt = time.perf_counter() - t0
+                    self._offload_wait += dt
+                    if self._tracer.enabled:
+                        self._tracer.complete(
+                            f"offload g{i}", "host-offload", t0, dt,
+                            group=i, bytes=int(fronts[i][0].nbytes
+                                               + fronts[i][1].nbytes))
                     if self.check_finite:
                         self._sentinel_check(i, *fronts[i])
         else:
@@ -467,12 +510,18 @@ class StreamExecutor:
                 print(f"[stream] issuing level {level} "
                       f"({len(entries)} groups)", file=sys.stderr,
                       flush=True)
-            if profile:
+            tracer = self._tracer
+            if profile or tracer.enabled:
                 t0 = time.perf_counter()
             outs, pool, t = fn(avals, pool, thresh)
             tiny = tiny + t
+            if tracer.enabled:
+                tracer.complete(f"issue lvl{level}", "dispatch", t0,
+                                time.perf_counter() - t0,
+                                level=int(level), groups=len(entries))
             if profile:
                 jax.block_until_ready(outs)
+                dt = time.perf_counter() - t0
                 gflop = sum(float(_front_flops(g.w, g.u)) * g.batch
                             for g, _ in chunk) / 1e9
                 # a LEVEL aggregate, not one kernel's shape: m/w/u are
@@ -483,7 +532,19 @@ class StreamExecutor:
                     "m": max(g.m for g, _ in chunk),
                     "w": max(g.w for g, _ in chunk),
                     "u": max(g.u for g, _ in chunk),
-                    "seconds": time.perf_counter() - t0, "gflop": gflop})
+                    "seconds": dt, "gflop": gflop})
+                self._trace_kernel(
+                    t0, dt, level,
+                    sum(key[0][0] for key, *_ in entries),
+                    max(g.m for g, _ in chunk),
+                    max(g.w for g, _ in chunk),
+                    max(g.u for g, _ in chunk),
+                    sum(g.batch for g, _ in chunk), lv_host,
+                    aggregate=True,
+                    executed=float(sum(
+                        key[0][0] * _front_flops(key[0][2], key[0][3])
+                        for key, *_ in entries)),
+                    structural=gflop * 1e9)
             for (grp, (_, _, _, nreal, g_host)), (lp, up) in zip(chunk, outs):
                 self._emit_front(fronts, lp, up, nreal, g_host)
         self.last_offload_wait_seconds = self._offload_wait

@@ -1,0 +1,24 @@
+"""Observability subsystem — the PROFlevel analog.
+
+One layer owns all measurement machinery:
+
+* ``obs.trace``   — structured span tracer (``SLU_TPU_TRACE=<path>``):
+  nested spans with categories (phase / dispatch / kernel / comm /
+  host-offload), emitted as Chrome trace-event JSON (Perfetto-loadable)
+  plus a crash-safe JSONL sidecar;
+* comm telemetry  — per-op counters on the tree collectives
+  (``parallel/treecomm.py`` → ``utils.stats.CommStats``), the
+  PROFlevel≥1 comm split;
+* kernel-shape telemetry — structured per-dispatch records from both
+  factorization executors and the device solve (the dgemm_mnk.dat
+  analog);
+* cross-rank stat reduction — ``utils.stats.Stats.reduce`` (min/max/avg
+  + load-balance factor per phase, the sum-over-ranks PStatPrint).
+
+See docs/OBSERVABILITY.md for the artifact formats and a worked
+Perfetto example.
+"""
+
+from superlu_dist_tpu.obs.trace import (      # noqa: F401
+    CATEGORIES, NULL_SPAN, NULL_TRACER, NullTracer, Tracer,
+    complete, enabled, get_tracer, install, span)

@@ -80,6 +80,32 @@ def gather_distributed(tc: TreeComm, a_loc: DistributedCSR,
     return SparseCSR(n, n, indptr, idx.astype(np.int64), vals)
 
 
+def _finish_stats(tc: TreeComm, lu_out):
+    """Cross-rank stat epilogue — COLLECTIVE: every rank calls it at the
+    same point, with NO dependence on per-rank ``lu_out`` presence (which
+    may legitimately diverge across ranks).  Snapshots this rank's comm
+    counters into its Stats, allreduces the fixed-layout stat vectors,
+    and hands every rank the same StatsSummary: per-phase min/max/avg +
+    load-balance factor — the sum-over-ranks PStatPrint the reference
+    prints at PROFlevel≥1 (SRC/util.c:538-630).  ``SLU_TPU_STATS=1``
+    prints the reduced report once, on rank 0."""
+    import os
+
+    from superlu_dist_tpu.utils.stats import Stats
+
+    stats = (lu_out or {}).get("stats")
+    if stats is None:
+        stats = Stats()
+    stats.attach_comm(tc.comm_stats)
+    summary = stats.reduce(tc)
+    if lu_out is not None:
+        lu_out["stats_summary"] = summary
+    if os.environ.get("SLU_TPU_STATS", "").strip() not in ("", "0") \
+            and tc.rank == 0:
+        print(summary.report())
+    return summary
+
+
 def bcast_result(tc: TreeComm, fn, root: int = 0):
     """Run `fn()` on `root` and broadcast its result; a root-side
     exception is SHIPPED and re-raised on every rank instead of leaving
@@ -196,9 +222,13 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
         x, info, rep = _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d,
                                     wdtype, lu=lu, lu_out=lu_out,
                                     replicate_analysis=replicate_analysis)
-        return _maybe_escalate_distributed(
+        x, info = _maybe_escalate_distributed(
             tc, options, a_loc, b_loc, x, info, rep, lu_out, grid=grid,
             replicate_analysis=replicate_analysis)
+        # cross-rank stat reduction (collective; the escalate decision
+        # above is replicated, so every rank reaches this together)
+        _finish_stats(tc, lu_out)
+        return x, info
 
     a_root = gather_distributed(tc, a_loc, root=root)
     b_full = np.zeros((n, nrhs), dtype=wdtype)
@@ -234,8 +264,11 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     x0 = tc.bcast_any(x0, root=root)
     x, info_out, rep = _refine_tail(tc, options, a_loc, b2, x0, solve_fn,
                                     root, one_d, nrhs, lu_out=lu_out)
-    return _maybe_escalate_distributed(tc, options, a_loc, b_loc, x,
-                                       info_out, rep, lu_out, root=root)
+    x, info_out = _maybe_escalate_distributed(tc, options, a_loc, b_loc, x,
+                                              info_out, rep, lu_out,
+                                              root=root)
+    _finish_stats(tc, lu_out)
+    return x, info_out
 
 
 def _refine_tail(tc, options, a_loc, b2, x0, solve_fn, root, one_d, nrhs,

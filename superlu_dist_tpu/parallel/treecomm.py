@@ -17,6 +17,7 @@ from Isend/Irecv.
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 import time
@@ -24,6 +25,8 @@ import time
 import numpy as np
 
 from superlu_dist_tpu import native
+from superlu_dist_tpu.obs.trace import get_tracer
+from superlu_dist_tpu.utils.stats import CommStats
 
 
 class TreeComm:
@@ -61,6 +64,24 @@ class TreeComm:
         if not self._h:
             raise OSError(f"slu_tree_attach failed for {name!r}")
         self._created = bool(create)
+        # per-op comm telemetry (the PROFlevel≥1 comm split): every
+        # native collective leg accounts calls/bytes/seconds here, split
+        # by op kind; composite ops (allreduce, bcast_bytes/bcast_obj)
+        # relabel their legs via _op_label so attribution follows the
+        # caller's intent, not the transport decomposition
+        self.comm_stats = CommStats()
+        self._op_label = None
+
+    def _account(self, op: str, nbytes: int, t0: float, root: int):
+        """One collective leg completed: count it, and emit a comm span
+        when tracing is enabled (no formatting otherwise)."""
+        dt = time.perf_counter() - t0
+        self.comm_stats.add(op, nbytes, dt)
+        tr = get_tracer()
+        if tr.enabled:
+            tr.complete(f"tree-{op}", "comm", t0, dt, op=op,
+                        bytes=int(nbytes), root=int(root), rank=self.rank,
+                        n_ranks=self.n_ranks)
 
     def _prep(self, buf: np.ndarray) -> np.ndarray:
         out = np.ascontiguousarray(buf, dtype=np.float64)
@@ -74,25 +95,43 @@ class TreeComm:
         when the input is contiguous float64 the operation is in place,
         otherwise the result lives in the returned copy."""
         buf = self._prep(buf)
+        op = self._op_label or "bcast"
+        t0 = time.perf_counter()
         self._lib.slu_tree_bcast(
             self._h, int(root),
             buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
+        self._account(op, buf.nbytes, t0, root)
         return buf
 
     def reduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
         """Elementwise sum onto root (the RETURNED array holds the total
         on the root; see bcast for the in-place caveat)."""
         buf = self._prep(buf)
+        op = self._op_label or "reduce"
+        t0 = time.perf_counter()
         self._lib.slu_tree_reduce_sum(
             self._h, int(root),
             buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
+        self._account(op, buf.nbytes, t0, root)
         return buf
+
+    @contextlib.contextmanager
+    def _labeled(self, op: str):
+        """Attribute nested collective legs to the composite op that
+        issued them (outermost label wins)."""
+        prev = self._op_label
+        self._op_label = prev or op
+        try:
+            yield
+        finally:
+            self._op_label = prev
 
     def allreduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
         """reduce_sum then bcast — the composite the reference builds from
         its RdTree + BcTree pair per supernode."""
-        buf = self.reduce_sum(buf, root)
-        return self.bcast(buf, root)
+        with self._labeled("allreduce"):
+            buf = self.reduce_sum(buf, root)
+            return self.bcast(buf, root)
 
     # ---- typed payload layer -------------------------------------------
     # The native segment is f64 (the reference's trees are likewise typed,
@@ -140,6 +179,10 @@ class TreeComm:
 
     def bcast_bytes(self, data: bytes | None, root: int = 0) -> bytes:
         """Broadcast a byte string from root (non-root passes None)."""
+        with self._labeled("bcast_bytes"):
+            return self._bcast_bytes(data, root)
+
+    def _bcast_bytes(self, data: bytes | None, root: int = 0) -> bytes:
         if self.rank == root:
             n = len(data)
             payload = np.frombuffer(

@@ -20,12 +20,14 @@ persistently.
 from __future__ import annotations
 
 import functools
+import time
 
 import numpy as np
 import jax
 import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.factor import NumericFactorization
+from superlu_dist_tpu.obs.trace import get_tracer
 
 
 def _bucket_nrhs(k: int) -> int:
@@ -322,32 +324,46 @@ class DeviceSolver:
         """Shared solve scaffolding: pad rhs into the (n+1, kb) buffer
         (slot n is the OOB dump row), run sweeps(x, lsum, kb) -> x, then
         unpad — one copy for the plain and transpose paths."""
+        tracer = get_tracer()
         squeeze = rhs.ndim == 1
         r2 = rhs[:, None] if squeeze else rhs
         k = r2.shape[1]
         kb = _bucket_nrhs(k)
         pad = np.zeros((self.n + 1, kb), dtype=jnp.dtype(self.fact.dtype))
         pad[:self.n, :k] = r2
-        if self.mesh is not None:
-            # replicated over the global mesh: every process supplies the
-            # same host array, every process can read the result locally
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            rep = NamedSharding(self.mesh, P(None, None))
-            if self._replicate is None:
-                # cached: a fresh lambda per solve would miss jax's trace
-                # cache on every IR correction solve
-                self._replicate = jax.jit(lambda a: a, out_shardings=rep)
-            x = jax.device_put(pad, rep)
-            lsum = jax.device_put(np.zeros_like(pad), rep)
-            x = sweeps(x, lsum, kb)
-            # normalize whatever sharding GSPMD inferred back to fully
-            # replicated so np.asarray below is process-local
-            x = self._replicate(x)
-        else:
-            x = jnp.asarray(pad)
-            lsum = jnp.zeros_like(x)
-            x = sweeps(x, lsum, kb)
-        out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
+        with tracer.span("device-solve", cat="kernel", n=self.n, nrhs=k,
+                         padded_nrhs=kb, fused=self.fused,
+                         n_groups=len(self._groups),
+                         dtype=str(jnp.dtype(self.fact.dtype))):
+            if self.mesh is not None:
+                # replicated over the global mesh: every process supplies
+                # the same host array, every process can read the result
+                # locally
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                rep = NamedSharding(self.mesh, P(None, None))
+                if self._replicate is None:
+                    # cached: a fresh lambda per solve would miss jax's
+                    # trace cache on every IR correction solve
+                    self._replicate = jax.jit(lambda a: a,
+                                              out_shardings=rep)
+                x = jax.device_put(pad, rep)
+                lsum = jax.device_put(np.zeros_like(pad), rep)
+                x = sweeps(x, lsum, kb)
+                # normalize whatever sharding GSPMD inferred back to fully
+                # replicated so np.asarray below is process-local
+                x = self._replicate(x)
+            else:
+                x = jnp.asarray(pad)
+                lsum = jnp.zeros_like(x)
+                x = sweeps(x, lsum, kb)
+            t0 = time.perf_counter()
+            out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
+            if tracer.enabled:
+                # the solution's D2H pull (the only factor-sized data
+                # that ever crosses the boundary per solve)
+                tracer.complete("solve-d2h", "comm", t0,
+                                time.perf_counter() - t0, op="d2h",
+                                bytes=int(out.nbytes))
         return out[:, 0] if squeeze else out
 
     def solve_trans(self, rhs: np.ndarray, conj: bool = False) -> np.ndarray:

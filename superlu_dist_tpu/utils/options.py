@@ -146,7 +146,12 @@ class Options:
     # hardware solve-ladder sweep knob).
     diag_inv: bool = dataclasses.field(
         default_factory=lambda: bool(_env_int("SLU_TPU_DIAG_INV", 0)))
-    print_stat: bool = False
+    # PStatPrint analog reachable without code: SLU_TPU_STATS=1 flips the
+    # default so any driver run (CLI, examples, embedding callers) prints
+    # the options banner + full Stats.report (incl. the solve-health
+    # line) — see docs/OBSERVABILITY.md
+    print_stat: bool = dataclasses.field(
+        default_factory=lambda: bool(_env_int("SLU_TPU_STATS", 0)))
     # --- symbolic / blocking tuning (sp_ienv analogs, SRC/sp_ienv.c:70-123) ---
     # NREL: amalgamate subtrees with <= relax cols
     relax: int = dataclasses.field(

@@ -1,0 +1,503 @@
+"""Observability subsystem (obs/trace.py + comm/kernel telemetry +
+cross-rank stat reduction) — the PROFlevel analog.
+
+Covers: span nesting/ordering and both artifact formats (Chrome
+trace-event JSON, JSONL sidecar), the guaranteed-negligible disabled
+path (no file, reused no-op span), comm counters against a 2-rank
+TreeComm exchange with known byte counts, kernel-shape records from
+both factorization executors and the device solve, Stats.timer
+reentrancy, and Stats.reduce min/max/avg + load-balance factors.
+"""
+
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+from superlu_dist_tpu.obs import trace
+from superlu_dist_tpu.utils.stats import (
+    COMM_OPS, CommStats, PHASES, Stats, StatsSummary)
+
+pytestmark = pytest.mark.obs
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _tracer_hygiene(monkeypatch):
+    """Every test starts and ends with the env-driven tracer state reset
+    (the global is latched on first use)."""
+    monkeypatch.delenv("SLU_TPU_TRACE", raising=False)
+    trace._reset()
+    yield
+    trace._reset()
+
+
+# ---------------------------------------------------------------------------
+# span tracer
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_and_jsonl(tmp_path):
+    t = trace.Tracer(str(tmp_path / "t.json"))
+    with t.span("outer", cat="phase", who="test"):
+        time.sleep(0.002)
+        with t.span("inner", cat="kernel", m=8, w=4):
+            time.sleep(0.002)
+        with t.span("inner2", cat="comm", bytes=64):
+            pass
+    t.close()
+    rows = [json.loads(line) for line in open(tmp_path / "t.jsonl")]
+    assert [r["name"] for r in rows] == ["inner", "inner2", "outer"]
+    by = {r["name"]: r for r in rows}
+    outer, inner = by["outer"], by["inner"]
+    # nesting: children start after and end before the parent
+    assert inner["ts"] >= outer["ts"]
+    assert inner["ts"] + inner["dur"] <= outer["ts"] + outer["dur"]
+    assert by["inner2"]["ts"] >= inner["ts"] + inner["dur"]
+    # depth reflects nesting at record time
+    assert outer["depth"] == 0 and inner["depth"] == 1
+    assert inner["args"] == {"m": 8, "w": 4}
+    assert outer["args"] == {"who": "test"}
+
+
+def test_chrome_trace_artifact_valid(tmp_path):
+    path = str(tmp_path / "t.json")
+    t = trace.Tracer(path)
+    with t.span("a", cat="phase"):
+        with t.span("b", cat="kernel"):
+            pass
+    t.complete("c", "comm", time.perf_counter() - 0.5, 0.01, bytes=3)
+    t.close()
+    doc = json.load(open(path))
+    events = doc["traceEvents"]
+    assert len(events) == 3
+    for ev in events:
+        assert ev["ph"] == "X"
+        for key in ("name", "cat", "ts", "dur", "pid", "tid"):
+            assert key in ev
+        assert ev["cat"] in trace.CATEGORIES
+    # events are sorted: ts monotone per (pid, tid)
+    last = {}
+    for ev in events:
+        key = (ev["pid"], ev["tid"])
+        assert ev["ts"] >= last.get(key, float("-inf"))
+        last[key] = ev["ts"]
+
+
+def test_span_set_attaches_midspan_attrs(tmp_path):
+    t = trace.Tracer(str(tmp_path / "t.json"))
+    with t.span("s", cat="dispatch") as sp:
+        sp.set(result_bytes=128)
+    t.close()
+    rows = [json.loads(line) for line in open(tmp_path / "t.jsonl")]
+    assert rows[0]["args"] == {"result_bytes": 128}
+
+
+def test_disabled_path_is_noop(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    t = trace.get_tracer()
+    assert t is trace.NULL_TRACER
+    assert not t.enabled
+    # one reused no-op span object, regardless of args
+    assert t.span("a") is t.span("b", cat="kernel", x=1)
+    with t.span("a") as sp:
+        sp.set(ignored=True)
+    t.complete("x", "comm", 0.0, 1.0)
+    t.flush()
+    t.close()
+    assert os.listdir(tmp_path) == []        # nothing written, ever
+    # near-zero overhead: a hundred thousand disabled spans in well under
+    # a second (they allocate nothing and read no clock)
+    t0 = time.perf_counter()
+    for _ in range(100_000):
+        with t.span("hot", cat="kernel"):
+            pass
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_env_gated_tracer(tmp_path, monkeypatch):
+    path = str(tmp_path / "run.json")
+    monkeypatch.setenv("SLU_TPU_TRACE", path)
+    trace._reset()
+    t = trace.get_tracer()
+    assert isinstance(t, trace.Tracer) and t.enabled
+    with trace.span("gated", cat="phase"):
+        pass
+    trace._reset()                            # closes + flushes
+    doc = json.load(open(path))
+    assert doc["traceEvents"][0]["name"] == "gated"
+    assert (tmp_path / "run.jsonl").exists()
+
+
+def test_install_programmatic(tmp_path):
+    t = trace.Tracer(str(tmp_path / "p.json"))
+    prev = trace.install(t)
+    try:
+        assert trace.enabled()
+        with trace.span("prog", cat="phase"):
+            pass
+    finally:
+        trace.install(prev)
+        t.close()
+    rows = [json.loads(line) for line in open(tmp_path / "p.jsonl")]
+    assert rows[0]["name"] == "prog"
+
+
+# ---------------------------------------------------------------------------
+# kernel-shape telemetry (both executors + device solve)
+# ---------------------------------------------------------------------------
+
+def _small_plan():
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+
+    a = poisson2d(6)
+    sym = symmetrize_pattern(a)
+    sf = symbolic_factorize(sym, np.arange(a.n_rows), relax=4,
+                            max_supernode=16)
+    plan = build_plan(sf)
+    return plan, sym.data[sf.value_perm]
+
+
+def test_stream_executor_kernel_spans(tmp_path):
+    import jax.numpy as jnp
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+
+    plan, avals = _small_plan()
+    t = trace.Tracer(str(tmp_path / "s.json"))
+    prev = trace.install(t)
+    try:
+        ex = StreamExecutor(plan, "float64")
+        ex(jnp.asarray(avals), jnp.asarray(0.0))
+    finally:
+        trace.install(prev)
+        t.close()
+    events = json.load(open(tmp_path / "s.json"))["traceEvents"]
+    kernels = [e for e in events if e["cat"] == "kernel"]
+    dispatch = [e for e in events if e["cat"] == "dispatch"]
+    assert len(kernels) == len(plan.groups)
+    assert len(dispatch) == len(plan.groups)
+    for k in kernels:
+        args = k["args"]
+        for key in ("level", "batch", "padded_batch", "m", "w", "u",
+                    "executed_flops", "structural_flops", "padding"):
+            assert key in args, (key, args)
+        assert args["executed_flops"] >= args["structural_flops"] > 0
+        assert args["padding"] >= 1.0
+    # tracing implies the profile record too (no stderr scraping needed,
+    # but the legacy consumer keeps working)
+    assert len(ex.last_profile) == len(plan.groups)
+
+
+def test_fused_executor_kernel_span(tmp_path):
+    import jax.numpy as jnp
+    from superlu_dist_tpu.numeric.factor import make_factor_fn
+
+    plan, avals = _small_plan()
+    fn = make_factor_fn(plan, "float64")
+    t = trace.Tracer(str(tmp_path / "f.json"))
+    prev = trace.install(t)
+    try:
+        fn(jnp.asarray(avals), jnp.asarray(0.0))
+    finally:
+        trace.install(prev)
+        t.close()
+    events = json.load(open(tmp_path / "f.json"))["traceEvents"]
+    kernels = [e for e in events if e["cat"] == "kernel"]
+    assert len(kernels) == 1 and kernels[0]["name"] == "factor-fused"
+    args = kernels[0]["args"]
+    assert args["aggregate"] and args["structural_flops"] == plan.flops
+    assert any(e["cat"] == "dispatch" for e in events)
+
+
+def test_device_solve_spans(tmp_path):
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.solve.device import DeviceSolver
+    from superlu_dist_tpu.utils.options import IterRefine, Options
+
+    a = poisson2d(7)
+    b = np.ones(a.n_rows)
+    x, lu, stats, info = gssvx(Options(iter_refine=IterRefine.NOREFINE),
+                               a, b)
+    assert info == 0
+    t = trace.Tracer(str(tmp_path / "d.json"))
+    prev = trace.install(t)
+    try:
+        DeviceSolver(lu.numeric).solve(np.ones(a.n_rows))
+    finally:
+        trace.install(prev)
+        t.close()
+    events = json.load(open(tmp_path / "d.json"))["traceEvents"]
+    solve = [e for e in events if e["name"] == "device-solve"]
+    assert len(solve) == 1 and solve[0]["cat"] == "kernel"
+    assert solve[0]["args"]["nrhs"] == 1
+    d2h = [e for e in events if e["name"] == "solve-d2h"]
+    assert len(d2h) == 1 and d2h[0]["cat"] == "comm"
+    assert d2h[0]["args"]["bytes"] > 0
+
+
+def test_gssvx_emits_phase_spans(tmp_path):
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.models.gallery import poisson2d
+
+    t = trace.Tracer(str(tmp_path / "g.json"))
+    prev = trace.install(t)
+    try:
+        a = poisson2d(6)
+        x, lu, stats, info = slu.gssvx(slu.Options(), a,
+                                       np.ones(a.n_rows))
+        assert info == 0
+    finally:
+        trace.install(prev)
+        t.close()
+    events = json.load(open(tmp_path / "g.json"))["traceEvents"]
+    phases = {e["name"] for e in events if e["cat"] == "phase"}
+    assert {"EQUIL", "ROWPERM", "COLPERM", "SYMBFACT", "DIST", "FACT",
+            "SOLVE"} <= phases
+
+
+# ---------------------------------------------------------------------------
+# Stats.timer reentrancy (satellite regression)
+# ---------------------------------------------------------------------------
+
+def test_stats_timer_reentrant_same_phase():
+    """Nested enters of the SAME phase must not double-count: the outer
+    enter owns the accumulation (the old implementation added the inner
+    elapsed a second time)."""
+    s = Stats()
+    with s.timer("FACT"):
+        time.sleep(0.05)
+        with s.timer("FACT"):
+            time.sleep(0.05)
+    assert 0.09 <= s.utime["FACT"] < 0.14, s.utime["FACT"]
+    assert s._timer_depth["FACT"] == 0
+
+
+def test_stats_timer_sequential_accumulates():
+    s = Stats()
+    for _ in range(2):
+        with s.timer("SOLVE"):
+            time.sleep(0.02)
+    assert s.utime["SOLVE"] >= 0.04
+
+
+def test_stats_timer_reentrant_under_exception():
+    s = Stats()
+    with pytest.raises(RuntimeError):
+        with s.timer("FACT"):
+            with s.timer("FACT"):
+                raise RuntimeError("boom")
+    assert s._timer_depth["FACT"] == 0
+    with s.timer("FACT"):        # still usable afterwards
+        pass
+    assert s.utime["FACT"] > 0
+
+
+# ---------------------------------------------------------------------------
+# cross-rank stat reduction
+# ---------------------------------------------------------------------------
+
+class _FakeComm:
+    """Two-rank comm stub: rank 0's matrix summed with a preloaded rank-1
+    row — exercises the reduce math without the native transport."""
+
+    n_ranks = 2
+    rank = 0
+
+    def __init__(self, peer_stats: Stats):
+        self._peer_vec = peer_stats._pack()
+
+    def allreduce_sum_any(self, arr, root=0):
+        out = np.array(arr, dtype=np.float64)
+        out[1] += self._peer_vec
+        return out
+
+
+def test_stats_reduce_min_max_avg_balance():
+    s0, s1 = Stats(), Stats()
+    s0.utime["FACT"], s1.utime["FACT"] = 1.0, 3.0
+    s0.ops["FACT"] = s1.ops["FACT"] = 50.0
+    s0.tiny_pivots, s1.tiny_pivots = 2, 3
+    s1.comm = {"bcast": {"calls": 4, "bytes": 256, "seconds": 0.5}}
+    summary = s0.reduce(_FakeComm(s1))
+    assert isinstance(summary, StatsSummary)
+    f = summary.utime["FACT"]
+    assert f.min == 1.0 and f.max == 3.0 and f.avg == 2.0
+    assert abs(f.balance - 1.5) < 1e-12
+    assert abs(summary.balance("FACT") - 1.5) < 1e-12
+    assert summary.ops["FACT"].total == 100.0
+    assert summary.tiny_pivots == 5
+    assert summary.comm["bcast"]["calls"] == 4
+    assert summary.comm["bcast"]["bytes"] == 256
+    rep = summary.report()
+    assert "FACT" in rep and "balance" in rep.splitlines()[2]
+    # untouched phases don't clutter the report
+    assert "EQUIL" not in rep
+
+
+def test_comm_stats_accounting_and_report():
+    cs = CommStats()
+    cs.add("bcast", 64, 0.01)
+    cs.add("bcast", 64, 0.01)
+    cs.add("allreduce", 128, 0.02)
+    t = cs.totals()
+    assert t["bcast"] == {"calls": 2, "bytes": 128, "seconds": 0.02}
+    assert "reduce" not in t                  # zero ops stay out
+    assert "bcast" in cs.report()
+    s = Stats()
+    s.attach_comm(cs)
+    assert "comm bcast" in s.report()
+
+
+# ---------------------------------------------------------------------------
+# 2-rank native transport: comm counters with known byte counts + reduce
+# ---------------------------------------------------------------------------
+
+def _exchange(tc):
+    """The scripted 2-rank exchange: 1 bcast, 1 reduce, 1 allreduce of
+    8 float64 each (single chunk at max_len=64)."""
+    from superlu_dist_tpu.utils.stats import Stats
+
+    buf = np.arange(8.0) if tc.rank == 0 else np.zeros(8)
+    tc.bcast(buf, root=0)
+    ok = bool(np.array_equal(buf, np.arange(8.0)))
+    buf2 = np.full(8, float(tc.rank + 1))
+    tc.reduce_sum(buf2, root=0)
+    buf3 = np.ones(8)
+    tc.allreduce_sum(buf3, root=0)
+    totals = tc.comm_stats.totals()
+    st = Stats()
+    st.utime["FACT"] = float(tc.rank + 1)
+    st.ops["FACT"] = 100.0
+    st.tiny_pivots = tc.rank
+    st.attach_comm(tc.comm_stats)
+    summary = st.reduce(tc)
+    return ok, totals, summary
+
+
+def _obs_rank_worker(name, n_ranks, rank, q):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    tc = TreeComm(name, n_ranks, rank, max_len=64, create=False)
+    try:
+        q.put((rank,) + _exchange(tc))
+    finally:
+        tc.close()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library unavailable")
+def test_comm_counters_and_reduce_two_ranks():
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    name = f"/slu_obs_comm_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    try:
+        ctx = mp.get_context("spawn")     # no fork of the jax-laden parent
+        q = ctx.Queue()
+        p = ctx.Process(target=_obs_rank_worker, args=(name, 2, 1, q))
+        p.start()
+        ok0, totals0, summary0 = _exchange(owner)
+        rank1, ok1, totals1, summary1 = q.get(timeout=120)
+        p.join(timeout=120)
+        assert p.exitcode == 0
+    finally:
+        owner.close(unlink=True)
+    assert ok0 and ok1
+    for totals in (totals0, totals1):
+        # known byte counts: 8 float64 = 64 bytes per leg
+        assert totals["bcast"] == {"calls": 1, "bytes": 64,
+                                   "seconds": totals["bcast"]["seconds"]}
+        assert totals["reduce"]["calls"] == 1
+        assert totals["reduce"]["bytes"] == 64
+        # the composite attributes BOTH its legs to "allreduce"
+        assert totals["allreduce"]["calls"] == 2
+        assert totals["allreduce"]["bytes"] == 128
+    # every rank computed the SAME cross-rank summary
+    for summary in (summary0, summary1):
+        f = summary.utime["FACT"]
+        assert f.min == 1.0 and f.max == 2.0 and f.avg == 1.5
+        assert abs(f.balance - 2.0 / 1.5) < 1e-12
+        assert summary.tiny_pivots == 1
+        assert summary.ops["FACT"].total == 200.0
+        # comm totals summed over ranks
+        assert summary.comm["bcast"]["bytes"] == 128
+        assert summary.comm["allreduce"]["bytes"] == 256
+
+
+# ---------------------------------------------------------------------------
+# comm spans from the tree collectives
+# ---------------------------------------------------------------------------
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library unavailable")
+def test_single_rank_comm_spans(tmp_path):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    t = trace.Tracer(str(tmp_path / "c.json"))
+    prev = trace.install(t)
+    try:
+        name = f"/slu_obs_span_{os.getpid()}"
+        with TreeComm(name, 1, 0, max_len=16, create=True) as tc:
+            tc.bcast(np.ones(4))
+            tc.allreduce_sum(np.ones(4))
+            tc.bcast_bytes(b"hello")
+    finally:
+        trace.install(prev)
+        t.close()
+    events = json.load(open(tmp_path / "c.json"))["traceEvents"]
+    comm = [e for e in events if e["cat"] == "comm"]
+    ops = {e["args"]["op"] for e in comm}
+    assert {"bcast", "allreduce", "bcast_bytes"} <= ops
+    for e in comm:
+        assert e["args"]["bytes"] > 0
+        assert e["name"].startswith("tree-")
+
+
+# ---------------------------------------------------------------------------
+# mfu_report: structured-trace parsing + explicit empty-input diagnostic
+# ---------------------------------------------------------------------------
+
+def _run_mfu(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts", "mfu_report.py"),
+         *args],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def test_mfu_report_missing_inputs_diagnostic(tmp_path):
+    r = _run_mfu(str(tmp_path / "no.jsonl"), str(tmp_path / "no.err"))
+    assert r.returncode == 1
+    assert b"no trace rows found" in r.stderr
+
+
+def test_mfu_report_prefers_structured_trace(tmp_path):
+    t = trace.Tracer(str(tmp_path / "k.json"))
+    t.complete("lu b4 m32 w16 u16", "kernel", 0.0, 0.005, level=2,
+               batch=3, padded_batch=4, m=32, w=16, u=16,
+               executed_flops=4.0e7, structural_flops=3.0e7, padding=1.33)
+    t.close()
+    for artifact in ("k.json", "k.jsonl"):
+        r = _run_mfu(str(tmp_path / "no.jsonl"), str(tmp_path / artifact))
+        assert r.returncode == 0, r.stderr
+        out = r.stdout.decode()
+        assert "structured trace" in out
+        assert "m=32" in out and "lvl=2" in out
+
+
+def test_mfu_report_legacy_stderr_still_parses(tmp_path):
+    err = tmp_path / "legacy.err"
+    err.write_text("# lvl=3  B=16  m=512  w=256  u=256  12.34 ms  "
+                   "567.8 GF/s\n")
+    r = _run_mfu(str(tmp_path / "no.jsonl"), str(err))
+    assert r.returncode == 0, r.stderr
+    out = r.stdout.decode()
+    assert "legacy stderr" in out and "m=512" in out
