@@ -12,6 +12,10 @@
 # tests/test_recovery.py and are excluded wholesale for the same reason.
 #
 # Wired for CI next to the tier-1 command (ROADMAP.md); ~1-2 min on CPU.
+# Gate contract (shared with run_slulint.sh and check_trace_overhead.py):
+# exits non-zero on ANY regression — here pytest's own exit code under
+# `set -e` propagates a single NaN-producing test — so `&&`-chaining the
+# three scripts after the tier-1 run gates a change on all of them.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

@@ -13,7 +13,11 @@ Runs a small factor+solve twice in fresh subprocesses:
   the JSONL sidecar parses line by line.
 
 Exit 0 = pass.  Wired for CI next to the tier-1 command (ROADMAP.md);
-a few seconds on CPU.
+a few seconds on CPU.  Gate contract (shared with run_slulint.sh and
+check_nan_guards.sh): any regression — a child failure, a tracer
+allocated on the disabled path, a malformed artifact — raises/asserts,
+which exits non-zero, so `&&`-chaining the three scripts after the
+tier-1 run gates a change on all of them.
 """
 
 import json

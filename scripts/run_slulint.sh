@@ -1,0 +1,18 @@
+#!/usr/bin/env bash
+# slulint CI gate: exit 1 on any finding that is neither inline-suppressed
+# (# slulint: disable=SLUxxx with a justification) nor grandfathered in
+# the committed baseline (.slulint-baseline.json — target state: empty).
+#
+# Pure host-side AST analysis, no jax import: the whole tree scans in
+# ~1-2 s; the 60 s timeout is a hard ceiling far above the <10 s budget
+# (a slow scan is itself a regression — rules must stay lexical).
+#
+# Wired for CI next to the tier-1 command (ROADMAP.md), alongside
+# check_nan_guards.sh and check_trace_overhead.py, which follow the same
+# contract: non-zero exit on ANY regression, so `&&`-chaining the three
+# after pytest gates a change on all of them.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+exec timeout -k 5 60 python -m superlu_dist_tpu.analysis \
+  superlu_dist_tpu/ scripts/ bench.py "$@"

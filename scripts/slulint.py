@@ -1,0 +1,18 @@
+#!/usr/bin/env python
+"""slulint entry point — identical to `python -m superlu_dist_tpu.analysis`.
+
+Kept as a script so the gate (run_slulint.sh), editors, and pre-commit
+hooks have a stable path that works from any cwd.  See docs/ANALYSIS.md
+for the rule catalog (SLU101-SLU105), suppressions, and the baseline
+workflow.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from superlu_dist_tpu.analysis.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,18 @@
+"""slulint — project-native static analysis (docs/ANALYSIS.md).
+
+Rules:
+  SLU101 collective-consistency   (rules_collective.py)
+  SLU102 trace-purity             (rules_trace.py)
+  SLU103 index-width discipline   (rules_index.py)
+  SLU104 env-knob registry        (rules_env.py)
+  SLU105 jit-cache-key hygiene    (rules_trace.py)
+
+CLI: ``python -m superlu_dist_tpu.analysis`` (scripts/slulint.py is the
+same entry; scripts/run_slulint.sh is the CI gate).
+"""
+
+from superlu_dist_tpu.analysis.core import (Finding, Rule, analyze_paths,
+                                            analyze_source, default_rules)
+
+__all__ = ["Finding", "Rule", "analyze_paths", "analyze_source",
+           "default_rules"]

@@ -1,0 +1,108 @@
+"""slulint CLI — `python -m superlu_dist_tpu.analysis [paths...]`.
+
+Exit codes: 0 = clean (or every finding baselined/suppressed),
+1 = new findings, 2 = usage error.  Pure host-side text processing: no
+jax import, safe anywhere, fast enough for a pre-commit hook (the CI
+budget in scripts/run_slulint.sh is 10 s for the whole tree).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from superlu_dist_tpu.analysis import baseline as bl
+from superlu_dist_tpu.analysis.core import (analyze_source, default_rules,
+                                            iter_py_files)
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m superlu_dist_tpu.analysis",
+        description="slulint: project-native static analysis "
+                    "(collective-safety SLU101, trace-purity SLU102, "
+                    "index-width SLU103, env-knob registry SLU104, "
+                    "jit-cache-key hygiene SLU105)")
+    p.add_argument("paths", nargs="*",
+                   default=["superlu_dist_tpu", "scripts", "bench.py"],
+                   help="files/directories to scan (default: the package, "
+                        "scripts/, bench.py)")
+    p.add_argument("--rules", default=None,
+                   help="comma-separated rule ids to run (default: all)")
+    p.add_argument("--baseline", default=None,
+                   help="baseline JSON path (default: .slulint-baseline."
+                        "json next to the repo root when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write the current findings to the baseline and "
+                        "exit 0")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-readable output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv=None) -> int:
+    args = _build_parser().parse_args(argv)
+    rules = default_rules()
+    if args.list_rules:
+        for r in rules:
+            print(f"{r.rule_id}  {r.title}")
+        return 0
+    if args.rules:
+        wanted = {x.strip() for x in args.rules.split(",") if x.strip()}
+        unknown = wanted - {r.rule_id for r in rules}
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+        rules = [r for r in rules if r.rule_id in wanted]
+
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
+        return 2
+
+    findings, sources = [], {}
+    for path in iter_py_files(args.paths):
+        with open(path, encoding="utf-8") as fh:
+            sources[path] = fh.read()
+        findings.extend(analyze_source(sources[path], path, rules))
+
+    baseline_path = args.baseline or os.path.join(
+        _REPO_ROOT, bl.DEFAULT_BASELINE_NAME)
+    if args.write_baseline:
+        bl.write(baseline_path,
+                 [bl.entry(f, sources[f.path], root=_REPO_ROOT)
+                  for f in findings])
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    baselined = []
+    if not args.no_baseline and os.path.exists(baseline_path):
+        entries = bl.load(baseline_path)
+        findings, baselined = bl.filter_new(findings, sources, entries,
+                                            root=_REPO_ROOT)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "baselined": len(baselined)}, indent=1))
+    else:
+        for f in findings:
+            print(f.render())
+        tail = f" ({len(baselined)} baselined)" if baselined else ""
+        print(f"slulint: {len(findings)} finding(s){tail} in "
+              f"{len(sources)} file(s)")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

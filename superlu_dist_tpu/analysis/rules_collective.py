@@ -1,0 +1,184 @@
+"""SLU101 — collective-consistency.
+
+Every rank attached to a TreeComm domain must execute the same collective
+sequence (treecomm.py's contract; the reference's per-supernode Bc/Rd
+trees are likewise matched, TreeBcast_slu.hpp).  The deadly shapes are
+lexically recognizable:
+
+* a collective call INSIDE a branch (or loop) whose condition depends on
+  the caller's rank / grid coordinates — only some ranks reach it;
+* a collective call AFTER a rank-conditioned early exit (`return` /
+  `raise` / `break` / `continue` under a rank test, or an `assert` whose
+  predicate involves the rank) earlier in the same function — some ranks
+  left before reaching it;
+* a collective call inside an `except` handler — exceptions raise on a
+  strict subset of ranks by construction (the project-blessed pattern is
+  pgssvx.bcast_result, which ships the exception THROUGH a collective
+  every rank reaches).
+
+The rule is lexical per function; nested `def`s start a fresh context
+(their bodies run at call time, not at definition time).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from superlu_dist_tpu.analysis.core import Rule
+
+COLLECTIVE_METHODS = frozenset({
+    "bcast", "reduce_sum", "allreduce_sum", "bcast_bytes", "bcast_obj",
+    "bcast_any", "reduce_sum_any", "allreduce_sum_any",
+})
+
+_RANK_ATTRS = frozenset({"rank", "iam", "myrow", "mycol"})
+_RANK_NAMES = frozenset({"rank", "iam", "myrank", "my_rank"})
+
+
+def _is_rank_expr(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr in _RANK_ATTRS:
+            return True
+        if isinstance(sub, ast.Name) and sub.id in _RANK_NAMES:
+            return True
+    return False
+
+
+def _collective_calls(node: ast.AST):
+    """Collective Call nodes lexically inside `node`, excluding nested
+    function/class bodies (those execute in their own context)."""
+    stack = [node]
+    while stack:
+        cur = stack.pop()
+        for child in ast.iter_child_nodes(cur):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.Call) \
+                    and isinstance(child.func, ast.Attribute) \
+                    and child.func.attr in COLLECTIVE_METHODS:
+                yield child
+            stack.append(child)
+
+
+def _has_early_exit(stmts) -> bool:
+    for st in stmts:
+        for sub in ast.walk(st):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                continue
+            if isinstance(sub, (ast.Return, ast.Raise, ast.Break,
+                                ast.Continue)):
+                return True
+    return False
+
+
+class _FunctionScan:
+    """One function body, scanned statement-by-statement in order."""
+
+    def __init__(self, rule, path, findings):
+        self.rule = rule
+        self.path = path
+        self.findings = findings
+        self.diverged_at = None    # line of the earliest rank-dep. exit
+
+    def flag(self, call, why):
+        self.findings.append(self.rule.finding(self.path, call, why))
+
+    def scan(self, stmts, in_rank_branch=False, in_except=False):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _FunctionScan(self.rule, self.path, self.findings) \
+                    .scan(st.body)
+                continue
+            if isinstance(st, ast.ClassDef):
+                self.scan(st.body, in_rank_branch, in_except)
+                continue
+
+            rank_cond = isinstance(st, (ast.If, ast.While)) \
+                and _is_rank_expr(st.test)
+
+            # flag the collectives this statement directly owns (for
+            # compound statements that is the header expression, which
+            # every rank still evaluates — so rank_cond alone does not
+            # flag it; only an ENCLOSING rank branch does)
+            for call in self.direct_collectives(st):
+                if in_except:
+                    self.flag(call,
+                              "collective inside an `except` handler — "
+                              "the exception raised on a subset of ranks, "
+                              "so the others never reach this call")
+                elif in_rank_branch:
+                    self.flag(call,
+                              "collective under rank-dependent control "
+                              "flow — only some ranks reach it")
+                elif self.diverged_at is not None:
+                    self.flag(call,
+                              "collective after a rank-dependent early "
+                              f"exit (line {self.diverged_at}) — ranks "
+                              "that exited never reach this call")
+
+            # recurse into compound statements with updated context
+            if isinstance(st, (ast.If, ast.While)):
+                branch = in_rank_branch or rank_cond
+                self.scan(st.body, branch, in_except)
+                self.scan(st.orelse, branch, in_except)
+                if rank_cond and not in_rank_branch \
+                        and self.diverged_at is None \
+                        and (_has_early_exit(st.body)
+                             or _has_early_exit(st.orelse)):
+                    self.diverged_at = st.lineno
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self.scan(st.body, in_rank_branch, in_except)
+                self.scan(st.orelse, in_rank_branch, in_except)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                self.scan(st.body, in_rank_branch, in_except)
+            elif isinstance(st, ast.Try):
+                self.scan(st.body, in_rank_branch, in_except)
+                for h in st.handlers:
+                    self.scan(h.body, in_rank_branch, True)
+                self.scan(st.orelse, in_rank_branch, in_except)
+                self.scan(st.finalbody, in_rank_branch, in_except)
+            elif isinstance(st, ast.Assert) and _is_rank_expr(st.test) \
+                    and not in_rank_branch and self.diverged_at is None:
+                # an assert on a rank-dependent predicate is a
+                # conditional raise on a subset of ranks
+                self.diverged_at = st.lineno
+
+    @staticmethod
+    def direct_collectives(st):
+        """Collectives in `st`'s own expressions — for compound
+        statements, only the header (test/iter/items), since the body is
+        scanned recursively with its own context."""
+        if isinstance(st, (ast.If, ast.While)):
+            roots = [st.test]
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            roots = [st.iter]
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            roots = [i.context_expr for i in st.items]
+        elif isinstance(st, ast.Try):
+            roots = []
+        else:
+            roots = [st]
+        out = []
+        for r in roots:
+            if isinstance(r, ast.Call) and isinstance(r.func, ast.Attribute)\
+                    and r.func.attr in COLLECTIVE_METHODS:
+                out.append(r)
+            out.extend(_collective_calls(r))
+        return out
+
+
+class CollectiveRule(Rule):
+    rule_id = "SLU101"
+    title = "collective-consistency"
+    hint = ("make every rank reach the collective: hoist it out of the "
+            "rank branch, allreduce the predicate first, or ship the "
+            "root-side work through pgssvx.bcast_result (which carries "
+            "exceptions to every rank)")
+
+    def check(self, tree, source, path):
+        findings = []
+        # module level counts as one function body (scripts run it)
+        _FunctionScan(self, path, findings).scan(tree.body)
+        return findings

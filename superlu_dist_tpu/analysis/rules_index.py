@@ -1,0 +1,133 @@
+"""SLU103 — index-width discipline.
+
+The GESP analog of the reference's ``int_t`` audit (superlu_defs.h:80-93
+/ XSDK_INDEX_SIZE): pattern indices may be 32-bit (``sparse.formats.INT``
+— bounded by n), but anything that ACCUMULATES — indptr/offset cumsums,
+nnz totals, dimension products — overflows int32 exactly in the n≈10^6
+regime the config4 targets run at (nnz(L) > 2^31 long before n does).
+
+Flagged, in symbolic/ sparse/ numeric/ inside the project tree (and
+everywhere outside it, e.g. test fixtures):
+
+* ``np.cumsum(..., dtype=D)`` with a possibly-32-bit D (``np.int32``,
+  ``"int32"``, ``np.intc``, or the env-selected ``INT`` alias) — a
+  running prefix sum is the canonical nnz accumulator;
+* array construction (`zeros`/`empty`/`full`/`arange`/`array`/`asarray`)
+  or ``.astype`` with a possibly-32-bit dtype assigned to an
+  accumulator-named target (indptr / *off* / *ptr* / nnz* / *cnt* /
+  count / total);
+* arithmetic (`*`, `+`) where an operand is an EXPLICIT int32 cast
+  (``np.int32(x)``, ``x.astype(np.int32)``) — products of dimension-like
+  quantities must be promoted before they multiply, not after.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from superlu_dist_tpu.analysis.core import Rule, dotted_name
+
+_I32_DOTTED = frozenset({"np.int32", "numpy.int32", "np.intc",
+                         "numpy.intc", "int32"})
+# formats.INT is int32 unless SLU_TPU_INT64 is set — treat it as 32-bit
+# for accumulator purposes (the whole point of the alias is that callers
+# must not feed it to arithmetic that can exceed 2^31)
+_I32_ALIASES = frozenset({"INT"})
+
+_ACCUM_TARGET = re.compile(
+    r"(^|_)(indptr|offs?|offsets?|ptr|rows_ptr|nnz\w*|cnt|counts?|total)"
+    r"(_|$)|(_ptr|_offs?|_cnt)$")
+
+_ARRAY_CTORS = frozenset({"zeros", "empty", "full", "arange", "array",
+                          "asarray", "ones"})
+
+
+def _is_i32_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int32":
+        return True
+    name = dotted_name(node)
+    return name in _I32_DOTTED or name in _I32_ALIASES
+
+
+def _dtype_kw(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+def _is_explicit_i32_expr(node: ast.AST) -> bool:
+    """np.int32(x) or x.astype(np.int32) / x.astype('int32')."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _is_i32_dtype(node.func) and dotted_name(node.func) not in \
+            _I32_ALIASES:
+        return True
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args and _is_i32_dtype(node.args[0]):
+        return True
+    return False
+
+
+class IndexWidthRule(Rule):
+    rule_id = "SLU103"
+    title = "index-width"
+    hint = ("accumulators must be int64 regardless of the pattern-index "
+            "width: use formats.counts_to_indptr / symbfact.supernode_nnz "
+            "or an explicit dtype=np.int64, and promote operands BEFORE "
+            "products (.astype(np.int64) * ...)")
+    package_dirs = ("symbolic", "sparse", "numeric")
+
+    def check(self, tree, source, path):
+        findings = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                self._check_call(node, path, findings)
+            elif isinstance(node, ast.Assign):
+                self._check_assign(node, path, findings)
+            elif isinstance(node, ast.BinOp) \
+                    and isinstance(node.op, (ast.Mult, ast.Add)):
+                for side in (node.left, node.right):
+                    if _is_explicit_i32_expr(side):
+                        findings.append(self.finding(
+                            path, node,
+                            "int32-cast operand in arithmetic — the "
+                            "product/sum wraps at 2^31 before any later "
+                            "promotion can save it"))
+                        break
+        return findings
+
+    def _check_call(self, node, path, findings):
+        name = dotted_name(node.func)
+        if name.endswith("cumsum"):
+            dt = _dtype_kw(node)
+            if dt is not None and _is_i32_dtype(dt):
+                findings.append(self.finding(
+                    path, node,
+                    f"cumsum with 32-bit dtype `{dotted_name(dt) or 'int32'}`"
+                    " — a prefix-sum accumulator overflows at nnz > 2^31"))
+
+    def _check_assign(self, node, path, findings):
+        targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+        if not any(_ACCUM_TARGET.search(t) for t in targets):
+            return
+        val = node.value
+        if not isinstance(val, ast.Call):
+            return
+        dt = None
+        fn = val.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _ARRAY_CTORS:
+            dt = _dtype_kw(val)
+            if dt is None and len(val.args) >= 2 \
+                    and fn.attr in ("zeros", "empty", "full", "arange",
+                                    "array", "asarray", "ones"):
+                dt = val.args[-1] if _is_i32_dtype(val.args[-1]) else None
+        elif isinstance(fn, ast.Attribute) and fn.attr == "astype" \
+                and val.args:
+            dt = val.args[0]
+        if dt is not None and _is_i32_dtype(dt):
+            findings.append(self.finding(
+                path, node.value,
+                f"accumulator `{', '.join(targets)}` constructed with a "
+                "32-bit dtype — offset/nnz accumulators must be int64"))

@@ -1,0 +1,232 @@
+"""SLU102 trace-purity and SLU105 jit-cache-key hygiene.
+
+SLU102 — host coercions inside jitted code.  ``float()``/``int()``/
+``bool()``/``.item()``/``np.asarray`` on a traced value force a device
+sync (or a ConcretizationError), and ``os.environ`` reads inside a
+traced function bake a silent recompile axis into the program.  Flagged
+lexically inside functions that are ``@jit``-decorated or wrapped by a
+``jax.jit(fn)`` call in the same module, restricted to the hot
+subpackages (numeric/, solve/, ops/) inside the project tree.
+
+SLU105 — env-dependent jitted factories behind ``lru_cache``.  The
+project caches kernel builders with ``functools.lru_cache`` keyed on the
+factory arguments (ops/dense.py, solve/device.py, utils/jaxcache.py's
+persistent-cache tier below them).  Anything else the built kernel
+depends on — an ``os.environ`` read, a closure variable from an
+enclosing function — is baked into the compiled program but absent from
+the cache key, so two configurations silently share one kernel
+(ops/dense.pivot_kernel documents exactly this contract: executors must
+put the env choice IN their key).  Flagged: env reads inside an
+lru_cached jit factory, and loads of enclosing-function locals that are
+not factory parameters.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from superlu_dist_tpu.analysis.core import Rule, dotted_name, is_env_read
+
+_COERCIONS = frozenset({"float", "int", "bool"})
+_NUMPY_NAMES = frozenset({"np", "numpy", "onp"})
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """`jit` / `jax.jit` / `partial(jax.jit, ...)` as a decorator or
+    callee."""
+    if isinstance(node, ast.Call):
+        fn = node.func
+        if dotted_name(fn) in ("jit", "jax.jit"):
+            return True
+        if dotted_name(fn) in ("partial", "functools.partial") and node.args:
+            return dotted_name(node.args[0]) in ("jit", "jax.jit")
+        return False
+    return dotted_name(node) in ("jit", "jax.jit")
+
+
+def _jit_wrapped_names(tree: ast.AST) -> set:
+    """Names of local functions passed to jax.jit(fn, ...) anywhere in
+    the module (the `return jax.jit(step)` factory idiom)."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_jit_expr(node) \
+                and isinstance(node, ast.Call) and node.args:
+            if isinstance(node.args[0], ast.Name):
+                names.add(node.args[0].id)
+    return names
+
+
+def _walk_own_body(fn: ast.AST, include_nested_defs: bool = True):
+    """Walk a function body; nested defs/lambdas are included by default
+    (they are traced as part of the jitted program when defined inside
+    it)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not include_nested_defs and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class TracePurityRule(Rule):
+    rule_id = "SLU102"
+    title = "trace-purity"
+    hint = ("keep host coercions and env reads OUT of traced code: "
+            "resolve configuration before tracing and close over the "
+            "value, and return jax arrays instead of coercing — "
+            "coercions force a device sync (or ConcretizationError) on "
+            "every call")
+    package_dirs = ("numeric", "solve", "ops")
+
+    def check(self, tree, source, path):
+        findings = []
+        wrapped = _jit_wrapped_names(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            jitted = any(_is_jit_expr(d) for d in node.decorator_list) \
+                or node.name in wrapped
+            if not jitted:
+                continue
+            findings.extend(self._scan_jitted(node, path))
+        return findings
+
+    def _scan_jitted(self, fn, path):
+        out = []
+        for node in _walk_own_body(fn):
+            env = is_env_read(node)
+            if env is not None:
+                out.append(self.finding(
+                    path, env[1],
+                    f"os.environ read inside jitted `{fn.name}` — the "
+                    "value is baked in at trace time and changes silently "
+                    "recompile (or worse, don't)"))
+                continue
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                if name in _COERCIONS:
+                    out.append(self.finding(
+                        path, node,
+                        f"`{name}()` coercion inside jitted `{fn.name}` — "
+                        "host sync / ConcretizationError on traced values"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "item":
+                    out.append(self.finding(
+                        path, node,
+                        f"`.item()` inside jitted `{fn.name}` — forces a "
+                        "blocking device-to-host transfer"))
+                elif isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in ("asarray", "array") \
+                        and isinstance(node.func.value, ast.Name) \
+                        and node.func.value.id in _NUMPY_NAMES:
+                    out.append(self.finding(
+                        path, node,
+                        f"`{dotted_name(node.func)}` inside jitted "
+                        f"`{fn.name}` — materializes the traced value on "
+                        "the host (use jnp)"))
+        return out
+
+
+def _is_lru_decorator(node: ast.AST) -> bool:
+    if isinstance(node, ast.Call):
+        node = node.func
+    return dotted_name(node) in ("lru_cache", "functools.lru_cache",
+                                 "cache", "functools.cache")
+
+
+def _bound_names(fn) -> set:
+    """Approximate set of names bound in a function's own scope."""
+    bound = set()
+    a = fn.args
+    for arg in (a.posonlyargs + a.args + a.kwonlyargs
+                + ([a.vararg] if a.vararg else [])
+                + ([a.kwarg] if a.kwarg else [])):
+        bound.add(arg.arg)
+    for node in _walk_own_body(fn, include_nested_defs=False):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            bound.add(node.name)
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            bound.add(node.id)
+        elif isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                bound.add((alias.asname or alias.name).split(".")[0])
+        elif isinstance(node, ast.comprehension):
+            for sub in ast.walk(node.target):
+                if isinstance(sub, ast.Name):
+                    bound.add(sub.id)
+    return bound
+
+
+class JitCacheKeyRule(Rule):
+    rule_id = "SLU105"
+    title = "jit-cache-key-hygiene"
+    hint = ("everything a cached jitted factory bakes into the program "
+            "must be a factory PARAMETER (part of the lru_cache key): "
+            "resolve env/config in an uncached wrapper and pass it in, "
+            "the way ops/dense.make_front_kernel passes pivot_kernel()")
+
+    def check(self, tree, source, path):
+        findings = []
+        self._scan(tree.body, [], path, findings)
+        return findings
+
+    def _scan(self, stmts, enclosing, path, findings):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if any(_is_lru_decorator(d) for d in st.decorator_list) \
+                        and self._contains_jit(st):
+                    self._check_factory(st, enclosing, path, findings)
+                self._scan(st.body, enclosing + [st], path, findings)
+            elif isinstance(st, ast.ClassDef):
+                self._scan(st.body, enclosing, path, findings)
+            elif isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While)):
+                self._scan(st.body, enclosing, path, findings)
+                self._scan(st.orelse, enclosing, path, findings)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                self._scan(st.body, enclosing, path, findings)
+            elif isinstance(st, ast.Try):
+                for block in ([st.body, st.orelse, st.finalbody]
+                              + [h.body for h in st.handlers]):
+                    self._scan(block, enclosing, path, findings)
+
+    @staticmethod
+    def _contains_jit(fn) -> bool:
+        for node in _walk_own_body(fn):
+            if isinstance(node, ast.Call) and _is_jit_expr(node):
+                return True
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_is_jit_expr(d) for d in node.decorator_list):
+                return True
+        return False
+
+    def _check_factory(self, fn, enclosing, path, findings):
+        for node in _walk_own_body(fn):
+            env = is_env_read(node)
+            if env is not None:
+                findings.append(self.finding(
+                    path, env[1],
+                    f"env read inside lru_cached jit factory `{fn.name}` "
+                    "— the value selects the compiled program but is not "
+                    "part of the cache key"))
+        if not enclosing:
+            return
+        outer_bound = set()
+        for outer in enclosing:
+            outer_bound |= _bound_names(outer)
+        own = _bound_names(fn)
+        flagged = set()
+        for node in _walk_own_body(fn):
+            if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)\
+                    and node.id in outer_bound and node.id not in own \
+                    and node.id not in flagged:
+                flagged.add(node.id)
+                findings.append(self.finding(
+                    path, node,
+                    f"lru_cached jit factory `{fn.name}` closes over "
+                    f"`{node.id}` from an enclosing function — it shapes "
+                    "the compiled kernel but is missing from the cache "
+                    "key"))

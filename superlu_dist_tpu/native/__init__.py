@@ -59,7 +59,8 @@ def _load():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("SLU_TPU_NO_NATIVE"):
+        from superlu_dist_tpu.utils.options import env_flag
+        if env_flag("SLU_TPU_NO_NATIVE"):
             return None
         path = _build()
         if path is None:
@@ -357,7 +358,8 @@ def mlnd(n: int, indptr, indices, leaf_size: int = 96, seed: int = 1,
     if lib is None:
         return None
     if nthreads is None:
-        nthreads = int(os.environ.get("SLU_TPU_ND_THREADS", "1") or 1)
+        from superlu_dist_tpu.utils.options import env_int
+        nthreads = env_int("SLU_TPU_ND_THREADS")
     indptr = _as_i64(indptr)
     indices = _as_i64(indices)
     order = np.empty(n, dtype=np.int64)

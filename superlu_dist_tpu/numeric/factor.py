@@ -253,15 +253,15 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     if cache is None:
         cache = plan._factor_fns = {}
     from superlu_dist_tpu.ops.dense import pivot_kernel
+    from superlu_dist_tpu.utils.options import env_float
     # the fused executor bakes the pivot-kernel choice into its one traced
     # program, so the choice must be part of its identity; StreamExecutor
     # re-reads it per call (stream._kernel / _level_fns key on it)
-    import os
     key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition),
            pivot_kernel() if executor == "fused" else None,
            # StreamExecutor latches the host-share threshold at
            # construction — a changed SLU_TPU_HOST_FLOPS needs a new one
-           float(os.environ.get("SLU_TPU_HOST_FLOPS", "0"))
+           env_float("SLU_TPU_HOST_FLOPS")
            if executor == "stream" else None)
     fn = cache.get(key)
     if fn is None:

@@ -32,6 +32,7 @@ from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.numeric.factor import group_step
 from superlu_dist_tpu.obs.trace import NULL_TRACER, get_tracer
 from superlu_dist_tpu.symbolic.symbfact import _front_flops
+from superlu_dist_tpu.utils.options import env_flag, env_float, env_int
 
 
 # Look-ahead window (the num_lookaheads analog, reference
@@ -122,7 +123,6 @@ class StreamExecutor:
         "auto" offloads iff the padded factor bytes exceed
         SLU_TPU_FRONT_BYTES_LIMIT (default 6e9) on an accelerator backend.
         """
-        import os
         plan.check_index_width()
         self.plan = plan
         self.dtype = str(jnp.dtype(dtype))
@@ -140,7 +140,7 @@ class StreamExecutor:
         self.granularity = granularity
         self._level_fns = {}
         if offload == "auto":
-            limit = float(os.environ.get("SLU_TPU_FRONT_BYTES_LIMIT", 6e9))
+            limit = env_float("SLU_TPU_FRONT_BYTES_LIMIT")
             itemsize = jnp.dtype(dtype).itemsize
             padded = sum(
                 _bucket_len(g.batch, 1) * (g.m * g.w + g.w * g.u)
@@ -155,7 +155,7 @@ class StreamExecutor:
         # PROFlevel comm-split analog (pdgstrf.c:1930-1951): issue /
         # transfer-wait / (the rest =) device compute
         self.last_offload_wait_seconds = None
-        self._lag = int(os.environ.get("SLU_TPU_OFFLOAD_LAG", "8"))
+        self._lag = env_int("SLU_TPU_OFFLOAD_LAG")
         self._tracer = NULL_TRACER   # latched from the global per call
         # non-finite sentinel (set per call by numeric_factorize): when
         # armed, every group materialized on the host mid-stream is
@@ -174,7 +174,7 @@ class StreamExecutor:
         # (host_flops=0); env SLU_TPU_HOST_FLOPS overrides.  Mesh-sharded
         # runs keep everything on the mesh.
         if host_flops is None:
-            host_flops = float(os.environ.get("SLU_TPU_HOST_FLOPS", "0"))
+            host_flops = env_float("SLU_TPU_HOST_FLOPS")
         self._host_levels = set()
         self._cpu_dev = None
         if host_flops > 0 and mesh is None:
@@ -305,15 +305,14 @@ class StreamExecutor:
         # The structured span tracer (obs/trace.py, SLU_TPU_TRACE) implies
         # profiling for the same reason: its kernel spans must sum to the
         # factor wall time, which only per-group blocking guarantees.
-        import os
         self._tracer = tracer = get_tracer()
-        profile = bool(os.environ.get("SLU_TPU_PROFILE")) or tracer.enabled
+        profile = env_flag("SLU_TPU_PROFILE") or tracer.enabled
         if profile:
             self.last_profile = []
         # SLU_TPU_PROGRESS=K: log every K groups/levels issued (async
         # issue order, not completion) — hours-long runs are otherwise
         # silent between plan build and the final block_until_ready
-        progress = int(os.environ.get("SLU_TPU_PROGRESS", "0") or 0)
+        progress = env_int("SLU_TPU_PROGRESS")
         self._progress = max(progress, 0)
         self._offload_wait = 0.0
         if self.granularity == "level":

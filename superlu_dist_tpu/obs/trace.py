@@ -224,7 +224,8 @@ def get_tracer():
     if t is None:
         with _init_lock:
             if _tracer is None:
-                path = os.environ.get("SLU_TPU_TRACE", "").strip()
+                from superlu_dist_tpu.utils.options import env_str
+                path = env_str("SLU_TPU_TRACE").strip()
                 if path:
                     _tracer = Tracer(path)
                     atexit.register(_tracer.close)

@@ -25,12 +25,13 @@ u ≤ M−W):
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.scipy.linalg import solve_triangular
+
+from superlu_dist_tpu.utils.options import env_str
 
 _UNROLL = 16   # panel width factored by the unrolled column loop
 
@@ -50,7 +51,7 @@ def _precision():
     """Resolved lazily at first kernel build (not import) so a typo'd env
     var fails the matmul path with a pointed error instead of making the
     whole package unimportable for host-only work."""
-    name = os.environ.get("SLU_TPU_PRECISION", "highest").strip().lower()
+    name = env_str("SLU_TPU_PRECISION").strip().lower()
     if name not in _PRECISION_TIERS:
         raise ValueError(f"SLU_TPU_PRECISION={name!r} — expected one of "
                          f"{sorted(_PRECISION_TIERS)}")
@@ -143,7 +144,7 @@ def pivot_kernel() -> str:
     trace time — executors bake the choice into their cached programs, so
     callers that cache jitted kernels must include this name in their
     cache key (stream._kernel, factor.get_executor do)."""
-    name = os.environ.get("SLU_TPU_PIVOT_KERNEL", "blocked").strip().lower()
+    name = env_str("SLU_TPU_PIVOT_KERNEL").strip().lower()
     if name not in ("blocked", "recursive"):
         raise ValueError(f"SLU_TPU_PIVOT_KERNEL={name!r} — expected "
                          f"'blocked' or 'recursive'")

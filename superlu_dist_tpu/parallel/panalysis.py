@@ -794,8 +794,14 @@ def _part_symbolic(tc, n, P, lab, pr, pc, pv, options, vdtype):
     m = len(verts)
     r_l = np.searchsorted(verts, pr)
     is_int = lab[pc] == tc.rank
-    assert np.all((lab[pc] == tc.rank) | (lab[pc] < 0)), \
-        "cross-part edge: projected separator is not a separator"
+    # the separator invariant must fail COLLECTIVELY: a single-rank
+    # assert here would strand the peers in the allreduces below
+    # (slulint SLU101 — rank-dependent early exit before a collective)
+    bad = np.zeros(1)
+    bad[0] = float(np.any(~(is_int | (lab[pc] < 0))))
+    if int(tc.allreduce_sum_any(bad)[0]):
+        raise SuperLUError(
+            "cross-part edge: projected separator is not a separator")
     bnd = np.unique(pc[~is_int])            # touched separator vertices
     c_l = np.where(is_int, np.searchsorted(verts, pc),
                    m + np.searchsorted(bnd, pc))

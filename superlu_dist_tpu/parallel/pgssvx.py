@@ -89,8 +89,7 @@ def _finish_stats(tc: TreeComm, lu_out):
     load-balance factor — the sum-over-ranks PStatPrint the reference
     prints at PROFlevel≥1 (SRC/util.c:538-630).  ``SLU_TPU_STATS=1``
     prints the reduced report once, on rank 0."""
-    import os
-
+    from superlu_dist_tpu.utils.options import env_flag
     from superlu_dist_tpu.utils.stats import Stats
 
     stats = (lu_out or {}).get("stats")
@@ -100,8 +99,7 @@ def _finish_stats(tc: TreeComm, lu_out):
     summary = stats.reduce(tc)
     if lu_out is not None:
         lu_out["stats_summary"] = summary
-    if os.environ.get("SLU_TPU_STATS", "").strip() not in ("", "0") \
-            and tc.rank == 0:
+    if env_flag("SLU_TPU_STATS") and tc.rank == 0:
         print(summary.report())
     return summary
 

@@ -327,7 +327,8 @@ def make_treecomm(name, n_ranks, rank, max_len: int = 4096,
     'drop=0.2,reorder=0.2,seed=7') every attachment becomes a
     FaultyTreeComm — all ranks read the same environment, so the
     deterministic schedules agree.  Unset/empty: a plain TreeComm."""
-    spec = os.environ.get("SLU_TPU_FAULTS", "").strip()
+    from superlu_dist_tpu.utils.options import env_str
+    spec = env_str("SLU_TPU_FAULTS").strip()
     if not spec:
         return TreeComm(name, n_ranks, rank, max_len=max_len, create=create)
     return FaultyTreeComm(name, n_ranks, rank, max_len=max_len,

@@ -13,17 +13,29 @@ numpy counting sorts.
 from __future__ import annotations
 
 import dataclasses
-import os
 
 import numpy as np
 
+from superlu_dist_tpu.utils.options import env_flag
+
 # analog of int_t (superlu_defs.h:80-93): the reference's XSDK_INDEX_SIZE=64
 # build switches every index to 64-bit; here SLU_TPU_INT64=1 does.  Pattern
-# indices only — all factor-side structures (symbolic rows, plan maps, the
-# native library) are unconditionally int64, so nnz(L) > 2^31 works either
-# way; this switch covers matrices whose nnz(A) itself exceeds int32.
-INT = (np.int64 if os.environ.get("SLU_TPU_INT64", "").lower()
-       in ("1", "true", "yes") else np.int32)
+# INDICES only — each one is bounded by n.  Anything that ACCUMULATES
+# (indptr prefix sums, nnz totals) is unconditionally int64 via
+# counts_to_indptr: nnz(A) exceeds int32 long before n does, and an
+# int32 indptr wraps silently (slulint SLU103 enforces this split).
+INT = np.int64 if env_flag("SLU_TPU_INT64") else np.int32
+
+
+def counts_to_indptr(counts: np.ndarray) -> np.ndarray:
+    """(n,) or (n+1,) leading-zero per-row/col counts -> int64 indptr.
+
+    The one prefix-sum accumulator for every CSR/CSC build: int64
+    regardless of the INT index selection, so nnz > 2^31 structures keep
+    exact offsets even in the default int32-index build (the regression
+    tests/test_formats.py::test_counts_to_indptr_past_int32 constructs
+    the wrap the old dtype=INT cumsum produced)."""
+    return np.cumsum(np.asarray(counts), dtype=np.int64)
 
 
 def _aggregate_coo(n_rows, n_cols, rows, cols, vals):
@@ -193,10 +205,9 @@ def coo_to_csr(n_rows, n_cols, rows, cols, vals, aggregate=True) -> SparseCSR:
         key = rows * n_cols + cols
         order = np.argsort(key, kind="stable")
         rows, cols, vals = rows[order], cols[order], vals[order]
-    indptr = np.zeros(n_rows + 1, dtype=INT)
-    np.add.at(indptr, rows + 1, 1)
-    indptr = np.cumsum(indptr, dtype=INT)
-    return SparseCSR(int(n_rows), int(n_cols), indptr,
+    counts = np.zeros(n_rows + 1, dtype=np.int64)
+    np.add.at(counts, rows + 1, 1)
+    return SparseCSR(int(n_rows), int(n_cols), counts_to_indptr(counts),
                      cols.astype(INT), vals)
 
 
@@ -209,10 +220,10 @@ def coo_to_csc(n_rows, n_cols, rows, cols, vals, aggregate=True) -> SparseCSC:
     key = cols * n_rows + rows
     order = np.argsort(key, kind="stable")
     rows, cols, vals = rows[order], cols[order], vals[order]
-    indptr = np.zeros(n_cols + 1, dtype=INT)
-    np.add.at(indptr, cols + 1, 1)
-    indptr = np.cumsum(indptr, dtype=INT)
-    return SparseCSC(int(n_rows), int(n_cols), indptr, rows.astype(INT), vals)
+    counts = np.zeros(n_cols + 1, dtype=np.int64)
+    np.add.at(counts, cols + 1, 1)
+    return SparseCSC(int(n_rows), int(n_cols), counts_to_indptr(counts),
+                     rows.astype(INT), vals)
 
 
 def symmetrize_pattern(a: SparseCSR) -> SparseCSR:

@@ -372,13 +372,26 @@ def amalgamate_supernodes(sf: SymbolicFact, tol: float = 1.2,
                    sf.pattern_indices, sf.value_perm)
 
 
+def supernode_nnz(widths, us) -> tuple:
+    """(nnz of the dense diagonal-block triangles, nnz of the rectangular
+    panels) for supernode widths w and below-diagonal row counts u.
+
+    Promotes to int64 BEFORE the products: w·u and w·(w+1)/2 wrap int32
+    at supernode scale (w=u=50,000 → 2.5·10^9 > 2^31) even though every
+    individual width/count fits easily — the int_t accumulator
+    discipline (slulint SLU103), regression-tested with int32 inputs in
+    tests/test_symbolic.py."""
+    w = np.asarray(widths, dtype=np.int64)
+    u = np.asarray(us, dtype=np.int64)
+    return (int(np.sum(w * (w + 1) // 2)), int(np.sum(w * u)))
+
+
 def _finish(n, perm, parent, sn_start, col_to_sn, sn_rows, sn_parent,
             sn_level, us, indptr, indices, value_perm) -> SymbolicFact:
     widths = np.diff(sn_start)
-    nnz_tri = int(np.sum(widths * (widths + 1) // 2))
-    nnz_rect = int(np.sum(widths * us))
-    w = widths.astype(float)
-    u = us.astype(float)
+    nnz_tri, nnz_rect = supernode_nnz(widths, us)
+    w = np.asarray(widths, dtype=float)
+    u = np.asarray(us, dtype=float)
     flops = float(np.sum(2.0 / 3.0 * w ** 3 + 2.0 * w ** 2 * u + 2.0 * w * u ** 2))
     return SymbolicFact(
         n=n, perm=perm, parent=parent, sn_start=sn_start, col_to_sn=col_to_sn,

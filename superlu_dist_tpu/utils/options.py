@@ -19,6 +19,261 @@ class YesNo(enum.Enum):
     YES = 1
 
 
+# ---------------------------------------------------------------------------
+# Environment-knob registry — the single source of truth for every env var
+# the project reads (the sp_ienv_dist environment tier generalized,
+# SRC/sp_ienv.c:70-123).  Every read routes through env_int/env_float/
+# env_str/env_flag below, so slulint rule SLU104 (analysis/rules_env.py)
+# can flag any os.environ read whose key is not declared here, and
+# SLU_TPU_STRICT_ENV=1 turns a typo'd SLU_TPU_* knob name into a hard
+# error instead of a silently-ignored setting.  docs/ANALYSIS.md carries
+# the generated table (knob_table_md).
+# ---------------------------------------------------------------------------
+
+
+class UnknownKnobError(KeyError):
+    """An env knob was read or set that the registry does not declare."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    kind: str            # "int" | "float" | "str" | "flag"
+    default: object
+    help: str
+    group: str = "solver"
+    choices: tuple | None = None
+
+
+KNOB_REGISTRY: dict[str, Knob] = {}
+
+
+def register_knob(name: str, kind: str, default, help: str,
+                  group: str = "solver", choices: tuple | None = None) -> None:
+    assert kind in ("int", "float", "str", "flag"), kind
+    KNOB_REGISTRY[name] = Knob(name, kind, default, help, group, choices)
+
+
+def _register_all() -> None:
+    r = register_knob
+    # --- symbolic / blocking (sp_ienv analogs) -----------------------------
+    r("NREL", "int", 20, "leaf-subtree relaxation width (reference sp_ienv(2))")
+    r("NSUP", "int", 256, "max supernode width (reference sp_ienv(3))")
+    r("SLU_TPU_MIN_BUCKET", "int", 8,
+      "smallest padded front dimension for size-class bucketing")
+    r("SLU_TPU_AMALG_TOL", "float", 1.2,
+      "fill-tolerant amalgamation flop-growth tolerance (0 disables)")
+    r("SLU_TPU_SYMB_THREADS", "int", 1,
+      "threads for the native symbolic factorization (psymbfact analog)")
+    # --- numeric executors -------------------------------------------------
+    r("SLU_TPU_PRECISION", "str", "highest",
+      "MXU pass count for f32 Schur GEMMs", group="numeric",
+      choices=("default", "high", "highest"))
+    r("SLU_TPU_PIVOT_KERNEL", "str", "blocked",
+      "panel factorization kernel", group="numeric",
+      choices=("blocked", "recursive"))
+    r("SLU_TPU_FRONT_BYTES_LIMIT", "float", 6e9,
+      "padded-front bytes above which the stream executor offloads to host",
+      group="numeric")
+    r("SLU_TPU_OFFLOAD_LAG", "int", 8,
+      "in-flight group window of the host-offload pipeline", group="numeric")
+    r("SLU_TPU_HOST_FLOPS", "float", 0.0,
+      "run leading levels below this flop count on the host CPU (0=off)",
+      group="numeric")
+    r("SLU_TPU_DIAG_INV", "flag", False,
+      "precompute inverted diagonal blocks (reference DiagInv)",
+      group="numeric")
+    r("SLU_TPU_POOL_PARTITION", "flag", False,
+      "shard the Schur update pool across all mesh devices", group="numeric")
+    # --- distributed tier --------------------------------------------------
+    r("SLU_TPU_PAR_SYMB_FACT", "flag", False,
+      "partition ordering+symbolic across ranks (ParSymbFact analog)",
+      group="parallel")
+    r("SLU_TPU_FAULTS", "str", "",
+      "fault-injection spec for TreeComm (e.g. 'drop=0.2,seed=7')",
+      group="parallel")
+    # --- index width -------------------------------------------------------
+    r("SLU_TPU_INT64", "flag", False,
+      "64-bit pattern indices (reference XSDK_INDEX_SIZE=64 analog)")
+    # --- solver health & recovery ------------------------------------------
+    r("SLU_TPU_RECOVERY", "flag", True,
+      "automatic escalation ladder on refinement stagnation",
+      group="recovery")
+    r("SLU_TPU_SENTINELS", "flag", True,
+      "non-finite isfinite sentinels in the numeric layer", group="recovery")
+    # --- observability -----------------------------------------------------
+    r("SLU_TPU_TRACE", "str", "",
+      "structured span trace output path ('%p' expands to the pid)",
+      group="obs")
+    r("SLU_TPU_STATS", "flag", False,
+      "print the PStatPrint-analog report from any driver run", group="obs")
+    r("SLU_TPU_PROFILE", "flag", False,
+      "deprecated legacy '# lvl=' stderr kernel trace", group="obs")
+    r("SLU_TPU_PROGRESS", "int", 0,
+      "log every K groups/levels issued (0=silent)", group="obs")
+    # --- native layer ------------------------------------------------------
+    r("SLU_TPU_NO_NATIVE", "flag", False,
+      "disable the native C++ host-analysis library", group="native")
+    r("SLU_TPU_ND_THREADS", "int", 1,
+      "threads for native nested dissection", group="native")
+    # --- env discipline ----------------------------------------------------
+    r("SLU_TPU_STRICT_ENV", "flag", False,
+      "raise on SLU_TPU_* env vars the registry does not declare")
+    # --- test / CI harness -------------------------------------------------
+    r("SLU_TPU_SKIP_PROBE", "flag", False,
+      "__graft_entry__: skip the accelerator probe", group="test")
+    r("SLU_TPU_DRYRUN_BIG", "str", "1",
+      "__graft_entry__: include the n=1e5 pool-partition dryrun phase",
+      group="test")
+    r("SLU_TPU_ORIG_PLATFORMS", "str", "",
+      "test harness stash of the session's original JAX_PLATFORMS pin",
+      group="test")
+    # --- external (read, not owned, by this project) -----------------------
+    for name, help_ in (
+            ("JAX_PLATFORMS", "jax backend selection"),
+            ("XLA_FLAGS", "XLA compiler/runtime flags"),
+            ("JAX_ENABLE_X64", "jax 64-bit mode"),
+            ("JAX_DEBUG_NANS", "raise on NaN production in jitted code"),
+            ("PYTHONPATH", "module search path for subprocesses")):
+        r(name, "str", "", help_, group="external")
+    # --- bench.py ----------------------------------------------------------
+    r("BENCH_DEADLINE_S", "float", 1350.0,
+      "bench watchdog deadline (seconds)", group="bench")
+    for name, help_ in (
+            ("BENCH_NO_PROBE", "skip the TPU probe subprocess"),
+            ("BENCH_REQUIRE_TPU", "fail instead of falling back to CPU"),
+            ("BENCH_FORCE_CPU", "pin the bench to the CPU backend")):
+        r(name, "flag", False, help_, group="bench")
+    for name, kind, default, help_ in (
+            ("BENCH_NX", "int", 48, "Poisson grid edge (n = NX^3)"),
+            ("BENCH_REPS", "int", 3, "timed repetitions"),
+            ("BENCH_DTYPE", "str", "float32", "factor dtype"),
+            ("BENCH_PEAK_F32_TFLOPS", "float", 49.0,
+             "peak f32 TFLOP/s for the MFU denominator"),
+            ("BENCH_RELAX", "int", None, "NREL override for the bench"),
+            ("BENCH_MAXSUPER", "int", None, "NSUP override for the bench"),
+            ("BENCH_MINBUCKET", "int", None, "min bucket override"),
+            ("BENCH_GROWTH", "float", None, "bucket growth override"),
+            ("BENCH_AMALG", "float", None, "amalgamation tol override"),
+            ("BENCH_MATRIX", "str", "poisson3d", "bench matrix family"),
+            ("BENCH_GRANULARITY", "str", None, "stream granularity")):
+        r(name, kind, default, help_, group="bench")
+    # --- measurement scripts ----------------------------------------------
+    for name, kind, default, help_ in (
+            ("CONFIG4_MESH", "str", "1", "config4_virtual mesh spec"),
+            ("CONFIG4_NX", "int", 100, "config4_virtual grid edge"),
+            ("CONFIG4_DTYPE", "str", "float32", "config4_virtual dtype"),
+            ("PGS_NX", "int", 48, "pgssvx_scale grid edge"),
+            ("MAS_DEADLINE_S", "float", 14400.0,
+             "mesh_analysis_scale deadline"),
+            ("MAS_NX", "int", 48, "mesh_analysis_scale grid edge"),
+            ("MAS_MODES", "str", "replicated,root_bcast,parsymb",
+             "mesh_analysis_scale mode list"),
+            ("DF64_NX", "str", "12,16,20", "df64_cost_tpu grid edges"),
+            ("DF64S_MESH", "str", "1", "df64_scale mesh spec"),
+            ("DF64S_NX", "int", 16, "df64_scale grid edge"),
+            ("DF64S_KAPPA", "float", 1e10, "df64_scale condition target"),
+            ("DF64S_COMPLEX", "str", "0", "df64_scale complex twin")):
+        r(name, kind, default, help_, group="scripts")
+
+
+_register_all()
+
+_FLAG_FALSE = ("", "0", "false", "no", "off")
+_strict_checked = False
+
+
+def _check_strict_env() -> None:
+    """Under SLU_TPU_STRICT_ENV=1, an SLU_TPU_* env var the registry does
+    not declare raises (with a did-you-mean) instead of being silently
+    ignored — a typo'd knob name can otherwise invalidate a whole
+    hardware sweep.  Checked once, on the first registry read."""
+    global _strict_checked
+    if _strict_checked:
+        return
+    _strict_checked = True
+    raw = os.environ.get("SLU_TPU_STRICT_ENV", "")
+    if raw.strip().lower() in _FLAG_FALSE:
+        return
+    unknown = sorted(k for k in os.environ
+                     if k.startswith("SLU_TPU_") and k not in KNOB_REGISTRY)
+    if unknown:
+        import difflib
+        hints = []
+        for k in unknown:
+            close = difflib.get_close_matches(k, KNOB_REGISTRY, n=1)
+            hints.append(f"{k}" + (f" (did you mean {close[0]}?)"
+                                   if close else ""))
+        raise UnknownKnobError(
+            "unknown SLU_TPU_* environment knob(s) under "
+            f"SLU_TPU_STRICT_ENV=1: {', '.join(hints)}")
+
+
+_UNSET = object()
+
+
+def _knob_raw(name: str, default):
+    if name not in KNOB_REGISTRY:
+        raise UnknownKnobError(
+            f"env knob {name!r} is not declared in the registry "
+            "(superlu_dist_tpu/utils/options.py) — register it there")
+    _check_strict_env()
+    raw = os.environ.get(name)
+    d = KNOB_REGISTRY[name].default if default is _UNSET else default
+    return raw, d
+
+
+def env_int(name: str, default=_UNSET) -> int:
+    """Registered integer knob; unset or unparsable values yield the
+    default (the historical _env_int contract)."""
+    raw, d = _knob_raw(name, default)
+    if raw is None:
+        return d
+    try:
+        return int(raw)
+    except ValueError:
+        return d
+
+
+def env_float(name: str, default=_UNSET) -> float:
+    raw, d = _knob_raw(name, default)
+    if raw is None:
+        return d
+    try:
+        return float(raw)
+    except ValueError:
+        return d
+
+
+def env_str(name: str, default=_UNSET) -> str:
+    raw, d = _knob_raw(name, default)
+    return d if raw is None else raw
+
+
+def env_flag(name: str, default=_UNSET) -> bool:
+    """Registered on/off knob: unset -> default; '', '0', 'false', 'no',
+    'off' (any case) -> False; anything else -> True."""
+    raw, d = _knob_raw(name, default)
+    if raw is None:
+        return bool(d)
+    return raw.strip().lower() not in _FLAG_FALSE
+
+
+def knob_table_md(groups: tuple | None = None) -> str:
+    """Markdown table of the registry (docs/ANALYSIS.md carries it; the
+    doc test asserts it stays in sync with the registry)."""
+    lines = ["| Knob | Kind | Default | Group | Meaning |",
+             "|---|---|---|---|---|"]
+    for k in sorted(KNOB_REGISTRY.values(),
+                    key=lambda k: (k.group, k.name)):
+        if groups is not None and k.group not in groups:
+            continue
+        extra = (f" ({'/'.join(map(str, k.choices))})" if k.choices else "")
+        lines.append(f"| `{k.name}` | {k.kind} | `{k.default}` | {k.group} "
+                     f"| {k.help}{extra} |")
+    return "\n".join(lines)
+
+
 class Fact(enum.Enum):
     """Factorization reuse tiers (reference fact_t, superlu_defs.h:489-510).
 
@@ -97,26 +352,22 @@ class RecoveryPolicy:
     """
 
     enabled: bool = dataclasses.field(
-        default_factory=lambda: bool(_env_int("SLU_TPU_RECOVERY", 1)))
+        default_factory=lambda: env_flag("SLU_TPU_RECOVERY"))
     sentinels: bool = dataclasses.field(
-        default_factory=lambda: bool(_env_int("SLU_TPU_SENTINELS", 1)))
+        default_factory=lambda: env_flag("SLU_TPU_SENTINELS"))
     condest: str = "auto"              # "always" | "auto" | "never"
     berr_target: float | None = None   # None => 10·eps(residual dtype)
     max_rungs: int = 3                 # ladder depth cap
 
 
 def _env_int(name: str, default: int) -> int:
-    try:
-        return int(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+    """Back-compat alias for env_int (the knob must be registered)."""
+    return env_int(name, default)
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ[name])
-    except (KeyError, ValueError):
-        return default
+    """Back-compat alias for env_float (the knob must be registered)."""
+    return env_float(name, default)
 
 
 @dataclasses.dataclass
@@ -145,13 +396,13 @@ class Options:
     # many-RHS solves.  Env SLU_TPU_DIAG_INV=1 flips the default (the
     # hardware solve-ladder sweep knob).
     diag_inv: bool = dataclasses.field(
-        default_factory=lambda: bool(_env_int("SLU_TPU_DIAG_INV", 0)))
+        default_factory=lambda: env_flag("SLU_TPU_DIAG_INV"))
     # PStatPrint analog reachable without code: SLU_TPU_STATS=1 flips the
     # default so any driver run (CLI, examples, embedding callers) prints
     # the options banner + full Stats.report (incl. the solve-health
     # line) — see docs/OBSERVABILITY.md
     print_stat: bool = dataclasses.field(
-        default_factory=lambda: bool(_env_int("SLU_TPU_STATS", 0)))
+        default_factory=lambda: env_flag("SLU_TPU_STATS"))
     # --- symbolic / blocking tuning (sp_ienv analogs, SRC/sp_ienv.c:70-123) ---
     # NREL: amalgamate subtrees with <= relax cols
     relax: int = dataclasses.field(
@@ -177,13 +428,13 @@ class Options:
     # shard the Schur update pool across ALL mesh devices (the n≈1M
     # memory path; only meaningful with a grid) — SLU_TPU_POOL_PARTITION=1
     pool_partition: bool = dataclasses.field(
-        default_factory=lambda: bool(_env_int("SLU_TPU_POOL_PARTITION", 0)))
+        default_factory=lambda: env_flag("SLU_TPU_POOL_PARTITION"))
     # distributed analysis for the multi-process tier (the reference's
     # options->ParSymbFact: ParMETIS ordering + psymbfact): ordering and
     # symbolic work/memory partition across the ranks instead of running
     # on root (parallel/panalysis.py) — SLU_TPU_PAR_SYMB_FACT=1
     par_symb_fact: bool = dataclasses.field(
-        default_factory=lambda: bool(_env_int("SLU_TPU_PAR_SYMB_FACT", 0)))
+        default_factory=lambda: env_flag("SLU_TPU_PAR_SYMB_FACT"))
     # user-supplied permutations for MY_PERMC / MY_PERMR (real dataclass
     # fields so Options(user_perm_c=...) works — the reference reads these
     # from ScalePermstruct->perm_c/perm_r when ColPerm/RowPerm say MY_*).

@@ -1,0 +1,508 @@
+"""slulint static-analysis suite tests (docs/ANALYSIS.md).
+
+Per rule SLU101-SLU105: one true-positive fixture snippet and one
+known-clean negative; plus suppression-comment handling, baseline
+round-trip, the CLI exit-code contract, the knob-registry strict mode,
+and the int64 accumulator regressions the rules motivated.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.analysis import analyze_source, default_rules
+from superlu_dist_tpu.analysis import baseline as bl
+from superlu_dist_tpu.analysis.core import PARSE_ERROR_RULE
+
+pytestmark = pytest.mark.analysis
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_rules(source, path="fixture.py"):
+    return analyze_source(source, path, default_rules())
+
+
+def rule_ids(source, path="fixture.py"):
+    return sorted({f.rule for f in run_rules(source, path)})
+
+
+# --------------------------------------------------------------------------
+# SLU101 collective-consistency
+# --------------------------------------------------------------------------
+
+SLU101_BRANCH = """
+def solve(tc, x, root):
+    if tc.rank == root:
+        x = tc.bcast_any(x, root=root)
+    return x
+"""
+
+SLU101_EARLY_EXIT = """
+def gather(tc, buf, root):
+    if tc.rank != root:
+        return None
+    return tc.reduce_sum_any(buf, root=root)
+"""
+
+SLU101_EXCEPT = """
+def shutdown(tc, payload):
+    try:
+        risky(payload)
+    except ValueError:
+        tc.bcast_obj(None)
+"""
+
+SLU101_ASSERT = """
+def ship(tc, lab, sizes):
+    assert lab[0] == tc.rank, "ownership"
+    return tc.allreduce_sum_any(sizes)
+"""
+
+SLU101_CLEAN = """
+def refine(tc, r_c, dx, root):
+    r = tc.allreduce_sum_any(r_c, root=root)
+    if tc.rank == root:
+        dx = solve(r)
+    dx = tc.bcast_any(dx, root=root)
+    if tc.rank != root:
+        return None
+    return dx
+"""
+
+
+def test_slu101_flags_collective_in_rank_branch():
+    fs = run_rules(SLU101_BRANCH)
+    assert [f.rule for f in fs] == ["SLU101"]
+    assert "rank-dependent control flow" in fs[0].message
+
+
+def test_slu101_flags_collective_after_rank_early_exit():
+    fs = run_rules(SLU101_EARLY_EXIT)
+    assert [f.rule for f in fs] == ["SLU101"]
+    assert "early exit" in fs[0].message
+
+
+def test_slu101_flags_collective_in_except_handler():
+    fs = run_rules(SLU101_EXCEPT)
+    assert [f.rule for f in fs] == ["SLU101"]
+    assert "except" in fs[0].message
+
+
+def test_slu101_flags_collective_after_rank_assert():
+    # the exact shape fixed in parallel/panalysis.py:_part_symbolic
+    fs = run_rules(SLU101_ASSERT)
+    assert [f.rule for f in fs] == ["SLU101"]
+
+
+def test_slu101_clean_collective_discipline_passes():
+    # local work under a rank branch + collectives reached by all ranks
+    # + rank-dependent return with NO collective after it: all fine
+    assert rule_ids(SLU101_CLEAN) == []
+
+
+# --------------------------------------------------------------------------
+# SLU102 trace-purity
+# --------------------------------------------------------------------------
+
+SLU102_POSITIVE = """
+import os
+import jax
+import numpy as np
+
+@jax.jit
+def kernel(x):
+    scale = float(os.environ.get("SLU_TPU_TRACE", "1"))
+    return np.asarray(x) * scale
+"""
+
+SLU102_WRAPPED = """
+import jax
+
+def make(w):
+    def step(x):
+        return x * int(w.sum())
+    return jax.jit(step, donate_argnums=(0,))
+"""
+
+SLU102_CLEAN = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def kernel(x):
+    return jnp.asarray(x) * 2.0
+
+def host_helper(x):
+    return float(x.sum())
+"""
+
+
+def test_slu102_flags_coercions_and_env_in_jitted():
+    fs = run_rules(SLU102_POSITIVE)
+    assert {f.rule for f in fs} == {"SLU102"}
+    msgs = " ".join(f.message for f in fs)
+    assert "environ" in msgs and "float()" in msgs and "asarray" in msgs
+
+
+def test_slu102_flags_jit_wrapped_local_def():
+    fs = run_rules(SLU102_WRAPPED)
+    assert {f.rule for f in fs} == {"SLU102"}
+
+
+def test_slu102_clean_jnp_and_host_code_pass():
+    assert rule_ids(SLU102_CLEAN) == []
+
+
+def test_slu102_scoped_to_hot_subpackages_in_tree():
+    # inside the package tree the rule only covers numeric/ solve/ ops/
+    path_hot = os.path.join("superlu_dist_tpu", "numeric", "x.py")
+    path_cold = os.path.join("superlu_dist_tpu", "io", "x.py")
+    assert "SLU102" in rule_ids(SLU102_POSITIVE, path_hot)
+    assert "SLU102" not in rule_ids(SLU102_POSITIVE, path_cold)
+
+
+# --------------------------------------------------------------------------
+# SLU103 index-width
+# --------------------------------------------------------------------------
+
+SLU103_CUMSUM = """
+import numpy as np
+
+def build(counts):
+    indptr = np.cumsum(counts, dtype=np.int32)
+    return indptr
+"""
+
+SLU103_ALIAS = """
+import numpy as np
+from superlu_dist_tpu.sparse.formats import INT
+
+def build(counts, n):
+    indptr = np.zeros(n + 1, dtype=INT)
+    indptr = np.cumsum(indptr, dtype=INT)
+    return indptr
+"""
+
+SLU103_PRODUCT = """
+import numpy as np
+
+def flops(n_rows, n_cols):
+    return n_rows.astype(np.int32) * n_cols
+"""
+
+SLU103_CLEAN = """
+import numpy as np
+from superlu_dist_tpu.sparse.formats import INT
+
+def build(counts, cols, n):
+    indptr = np.cumsum(counts, dtype=np.int64)
+    indices = cols.astype(INT)    # indices are bounded by n: INT is fine
+    nnz = int(indptr[-1])
+    return indptr, indices, nnz
+"""
+
+
+def test_slu103_flags_int32_cumsum():
+    fs = run_rules(SLU103_CUMSUM)
+    assert [f.rule for f in fs] == ["SLU103"]
+    assert "cumsum" in fs[0].message
+
+
+def test_slu103_flags_env_selected_INT_accumulators():
+    # the exact shape fixed in sparse/formats.py (dtype=INT indptr)
+    fs = run_rules(SLU103_ALIAS)
+    assert {f.rule for f in fs} == {"SLU103"}
+    assert len(fs) == 2          # the zeros() ctor and the cumsum
+
+
+def test_slu103_flags_explicit_int32_product():
+    fs = run_rules(SLU103_PRODUCT)
+    assert [f.rule for f in fs] == ["SLU103"]
+    assert "wraps at 2^31" in fs[0].message
+
+
+def test_slu103_clean_int64_accumulators_pass():
+    assert rule_ids(SLU103_CLEAN) == []
+
+
+# --------------------------------------------------------------------------
+# SLU104 env-knob registry
+# --------------------------------------------------------------------------
+
+SLU104_POSITIVE = """
+import os
+
+def config():
+    return os.environ.get("SLU_TPU_TPYO_KNOB", "1")
+"""
+
+SLU104_CLEAN = """
+import os
+
+def config(tmp):
+    a = os.environ.get("SLU_TPU_TRACE", "")     # registered knob
+    b = os.getenv("NSUP")                       # registered knob
+    os.environ["SLU_TPU_NOT_A_KNOB_WRITE"] = "x"   # writes are exempt
+    return a, b
+"""
+
+
+def test_slu104_flags_unregistered_env_read():
+    fs = run_rules(SLU104_POSITIVE)
+    assert [f.rule for f in fs] == ["SLU104"]
+    assert "SLU_TPU_TPYO_KNOB" in fs[0].message
+
+
+def test_slu104_registered_reads_and_writes_pass():
+    assert rule_ids(SLU104_CLEAN) == []
+
+
+# --------------------------------------------------------------------------
+# SLU105 jit-cache-key hygiene
+# --------------------------------------------------------------------------
+
+SLU105_ENV = """
+import functools
+import os
+import jax
+
+@functools.lru_cache(maxsize=None)
+def make_kernel(m, w):
+    passes = os.environ.get("SLU_TPU_PRECISION", "highest")
+    def kern(x):
+        return x * len(passes)
+    return jax.jit(kern)
+"""
+
+SLU105_CLOSURE = """
+import functools
+import jax
+
+def build(plan, pad_width):
+    @functools.lru_cache(maxsize=None)
+    def make_kernel(m):
+        def kern(x):
+            return x[:m + pad_width]
+        return jax.jit(kern)
+    return make_kernel
+"""
+
+SLU105_CLEAN = """
+import functools
+import jax
+
+from superlu_dist_tpu.utils.options import env_str
+
+def make_kernel(m, w):
+    # env resolved OUTSIDE the cached factory and passed as a key arg,
+    # the ops/dense.make_front_kernel discipline
+    return _make_kernel(m, w, env_str("SLU_TPU_PRECISION"))
+
+@functools.lru_cache(maxsize=None)
+def _make_kernel(m, w, precision):
+    def kern(x):
+        return x[:m] * w if precision else x
+    return jax.jit(kern)
+"""
+
+
+def test_slu105_flags_env_read_in_cached_factory():
+    fs = run_rules(SLU105_ENV)
+    assert [f.rule for f in fs] == ["SLU105"]
+    assert "cache key" in fs[0].message
+
+
+def test_slu105_flags_enclosing_closure_variable():
+    fs = run_rules(SLU105_CLOSURE)
+    assert [f.rule for f in fs] == ["SLU105"]
+    assert "pad_width" in fs[0].message
+
+
+def test_slu105_parameterized_factory_passes():
+    assert rule_ids(SLU105_CLEAN) == []
+
+
+# --------------------------------------------------------------------------
+# suppressions, baseline, parse errors, CLI
+# --------------------------------------------------------------------------
+
+def test_inline_suppression_silences_one_line():
+    src = SLU101_BRANCH.replace(
+        "x = tc.bcast_any(x, root=root)",
+        "x = tc.bcast_any(x, root=root)  # slulint: disable=SLU101")
+    assert rule_ids(src) == []
+
+
+def test_inline_suppression_is_rule_specific():
+    src = SLU101_BRANCH.replace(
+        "x = tc.bcast_any(x, root=root)",
+        "x = tc.bcast_any(x, root=root)  # slulint: disable=SLU102")
+    assert rule_ids(src) == ["SLU101"]
+
+
+def test_file_level_suppression():
+    src = "# slulint: disable-file=SLU104\n" + SLU104_POSITIVE
+    assert rule_ids(src) == []
+
+
+def test_parse_error_is_a_gating_finding():
+    fs = run_rules("def broken(:\n")
+    assert [f.rule for f in fs] == [PARSE_ERROR_RULE]
+
+
+def test_baseline_round_trip(tmp_path):
+    src = SLU103_CUMSUM
+    path = str(tmp_path / "mod.py")
+    (tmp_path / "mod.py").write_text(src)
+    findings = analyze_source(src, path, default_rules())
+    assert findings
+    bp = str(tmp_path / "baseline.json")
+    bl.write(bp, [bl.entry(f, src) for f in findings])
+    entries = bl.load(bp)
+    new, old = bl.filter_new(findings, {path: src}, entries)
+    assert new == [] and len(old) == len(findings)
+    # the baseline absorbs each finding once: a second identical
+    # violation still fails the gate
+    doubled = findings + findings
+    new2, old2 = bl.filter_new(doubled, {path: src}, entries)
+    assert len(new2) == len(findings)
+    # editing the flagged line invalidates its entry
+    changed = src.replace("np.int32", "np.intc")
+    new3, _ = bl.filter_new(analyze_source(changed, path, default_rules()),
+                            {path: changed}, entries)
+    assert len(new3) == len(findings)
+
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, "-m", "superlu_dist_tpu.analysis", *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120)
+
+
+def test_cli_exit_codes_and_json(tmp_path):
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text(SLU103_CUMSUM)
+    clean = tmp_path / "clean.py"
+    clean.write_text(SLU103_CLEAN)
+
+    r = _run_cli([str(clean), "--no-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli([str(dirty), "--no-baseline", "--json"])
+    assert r.returncode == 1, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["findings"][0]["rule"] == "SLU103"
+    # --write-baseline then rescan: baselined findings no longer gate
+    bp = str(tmp_path / "b.json")
+    r = _run_cli([str(dirty), "--baseline", bp, "--write-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_cli([str(dirty), "--baseline", bp])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "baselined" in r.stdout
+    r = _run_cli(["--rules", "SLU999", str(clean)])
+    assert r.returncode == 2
+
+
+def test_cli_repo_tree_is_clean():
+    """The acceptance gate: the shipped tree scans clean (committed
+    baseline is empty; any finding is inline-suppressed with a
+    justification)."""
+    r = _run_cli(["superlu_dist_tpu/", "scripts/", "bench.py"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    base = json.load(open(os.path.join(REPO, ".slulint-baseline.json")))
+    assert base["findings"] == []
+
+
+# --------------------------------------------------------------------------
+# knob registry (SLU104's single source of truth)
+# --------------------------------------------------------------------------
+
+def test_unregistered_knob_read_raises():
+    from superlu_dist_tpu.utils.options import UnknownKnobError, env_int
+    with pytest.raises(UnknownKnobError):
+        env_int("SLU_TPU_DOES_NOT_EXIST", 3)
+
+
+def test_registry_parse_and_defaults(monkeypatch):
+    from superlu_dist_tpu.utils import options as o
+    assert o.env_int("NSUP") == int(os.environ.get("NSUP", 256))
+    monkeypatch.setenv("SLU_TPU_OFFLOAD_LAG", "12")
+    assert o.env_int("SLU_TPU_OFFLOAD_LAG") == 12
+    monkeypatch.setenv("SLU_TPU_OFFLOAD_LAG", "notanint")
+    assert o.env_int("SLU_TPU_OFFLOAD_LAG") == 8   # historical fallback
+    monkeypatch.setenv("SLU_TPU_RECOVERY", "off")
+    assert o.env_flag("SLU_TPU_RECOVERY") is False
+    monkeypatch.setenv("SLU_TPU_RECOVERY", "1")
+    assert o.env_flag("SLU_TPU_RECOVERY") is True
+    monkeypatch.delenv("SLU_TPU_RECOVERY", raising=False)
+    assert o.env_flag("SLU_TPU_RECOVERY") is True  # default
+
+
+def test_strict_env_flags_typod_knob():
+    """SLU_TPU_STRICT_ENV=1 + a typo'd knob name raises with a
+    did-you-mean, at the first registry read (subprocess: the check is
+    once-per-process)."""
+    code = ("import superlu_dist_tpu.utils.options as o\n"
+            "o.env_int('NSUP')\n")
+    env = dict(os.environ, SLU_TPU_STRICT_ENV="1",
+               SLU_TPU_PRECISON="high")   # sic: missing I
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode != 0
+    assert "SLU_TPU_PRECISON" in r.stderr
+    assert "SLU_TPU_PRECISION" in r.stderr   # the did-you-mean
+    # without strict mode the same typo is tolerated (historical behavior)
+    env.pop("SLU_TPU_STRICT_ENV")
+    r = subprocess.run([sys.executable, "-c", code], env=env,
+                       capture_output=True, text=True, cwd=REPO, timeout=60)
+    assert r.returncode == 0, r.stderr
+
+
+def test_knob_table_covers_registry_and_docs_in_sync():
+    from superlu_dist_tpu.utils.options import KNOB_REGISTRY, knob_table_md
+    table = knob_table_md()
+    for name in KNOB_REGISTRY:
+        assert f"`{name}`" in table
+    doc = open(os.path.join(REPO, "docs", "ANALYSIS.md")).read()
+    for name in KNOB_REGISTRY:
+        assert f"`{name}`" in doc, f"docs/ANALYSIS.md missing knob {name}"
+
+
+# --------------------------------------------------------------------------
+# int64 accumulator regressions (the SLU103 true-positive fixes)
+# --------------------------------------------------------------------------
+
+def test_counts_to_indptr_past_int32():
+    """counts that sum past 2^31 produce exact int64 offsets; the old
+    dtype=INT cumsum wrapped negative in the default int32-index build."""
+    from superlu_dist_tpu.sparse.formats import counts_to_indptr
+    counts = np.full(5, 2 ** 29, dtype=np.int32)   # total 2.5*2^30 > 2^31
+    indptr = counts_to_indptr(counts)
+    assert indptr.dtype == np.int64
+    assert int(indptr[-1]) == 5 * 2 ** 29
+    wrapped = np.cumsum(counts, dtype=np.int32)    # the old behavior
+    assert int(wrapped[-1]) != 5 * 2 ** 29         # proves the hazard
+
+
+def test_coo_to_csr_indptr_is_int64_despite_int32_indices():
+    from superlu_dist_tpu.sparse.formats import INT, coo_to_csr
+    a = coo_to_csr(3, 3, [0, 1, 2], [1, 2, 0], [1.0, 2.0, 3.0])
+    assert a.indptr.dtype == np.int64
+    assert a.indices.dtype == INT
+
+
+def test_supernode_nnz_past_int32():
+    """A structure whose width*rows product overflows int32: one 50k-wide
+    supernode with 50k below-diagonal rows has w*u = 2.5e9 > 2^31."""
+    from superlu_dist_tpu.symbolic.symbfact import supernode_nnz
+    w = np.array([50_000], dtype=np.int32)
+    u = np.array([50_000], dtype=np.int32)
+    tri, rect = supernode_nnz(w, u)
+    assert rect == 2_500_000_000
+    assert tri == 50_000 * 50_001 // 2
+    with np.errstate(over="ignore"):
+        assert int((w * u)[0]) != 2_500_000_000   # int32 product wraps
