@@ -11,11 +11,11 @@
 # The recovery suite's NaN-poisoned sentinel tests live in
 # tests/test_recovery.py and are excluded wholesale for the same reason.
 #
-# Wired for CI next to the tier-1 command (ROADMAP.md); ~1-2 min on CPU.
-# Gate contract (shared with run_slulint.sh and check_trace_overhead.py):
-# exits non-zero on ANY regression — here pytest's own exit code under
-# `set -e` propagates a single NaN-producing test — so `&&`-chaining the
-# three scripts after the tier-1 run gates a change on all of them.
+# One gate of scripts/ci_gates.sh (the consolidated CI entry point);
+# ~1-2 min on CPU.  Gate contract (shared with run_slulint.sh,
+# check_trace_overhead.py and check_verify_overhead.py): exits non-zero
+# on ANY regression — here pytest's own exit code under `set -e`
+# propagates a single NaN-producing test.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 

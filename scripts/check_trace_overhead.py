@@ -12,12 +12,11 @@ Runs a small factor+solve twice in fresh subprocesses:
   (within a slack factor — Python glue around tiny test kernels), and
   the JSONL sidecar parses line by line.
 
-Exit 0 = pass.  Wired for CI next to the tier-1 command (ROADMAP.md);
-a few seconds on CPU.  Gate contract (shared with run_slulint.sh and
-check_nan_guards.sh): any regression — a child failure, a tracer
-allocated on the disabled path, a malformed artifact — raises/asserts,
-which exits non-zero, so `&&`-chaining the three scripts after the
-tier-1 run gates a change on all of them.
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
+entry point); a few seconds on CPU.  Gate contract (shared with
+run_slulint.sh, check_nan_guards.sh and check_verify_overhead.py): any
+regression — a child failure, a tracer allocated on the disabled path,
+a malformed artifact — raises/asserts, which exits non-zero.
 """
 
 import json

@@ -1,0 +1,101 @@
+#!/usr/bin/env python
+"""SLU106 verify-mode overhead smoke (check_trace_overhead.py style).
+
+Runs TreeComm collectives in fresh subprocesses:
+
+* verify OFF — asserts the collective path allocates NO verifier state
+  (``tc._verifier is None``), hands back the reused no-op guard
+  singleton, and creates no sibling ``.vfy`` shared-memory segment —
+  the acceptance criterion that the disabled path stays zero-overhead;
+* verify ON  — asserts the verifier exists, every public collective is
+  checked exactly once (composites/chunks exempt), and payloads
+  round-trip bit-exactly through the digest-guarded path.
+
+Exit 0 = pass.  Gate contract (shared with run_slulint.sh,
+check_nan_guards.sh and check_trace_overhead.py — see
+scripts/ci_gates.sh): any regression raises/asserts, which exits
+non-zero.  Skips cleanly (exit 0 with a notice) when the native
+library is unavailable — the verifier rides the native tree transport.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CHILD = r"""
+import json, os
+import numpy as np
+from superlu_dist_tpu import native
+if not native.available():
+    print(json.dumps({"skip": "native library unavailable"}))
+    raise SystemExit(0)
+from superlu_dist_tpu.parallel import treecomm
+
+name = f"/slu_vfy_gate_{os.getpid()}"
+with treecomm.TreeComm(name, 1, 0, max_len=64, create=True) as tc:
+    payload = np.arange(48.0).reshape(6, 8)
+    got = tc.bcast_any(payload.copy())
+    ok_payload = bool((got == payload).all())
+    got = tc.allreduce_sum_any(payload.copy())
+    ok_payload &= bool((got == payload).all())
+    blob = b"\x01gate\xff" * 13
+    ok_payload &= tc.bcast_bytes(blob) == blob
+    v = tc._verifier
+    print(json.dumps({
+        "verifier": type(v).__name__ if v is not None else None,
+        "null_guard": tc._verified("bcast", (1,), "float64", 0)
+                      is treecomm._NULL_CTX if v is None else False,
+        "checks": v.checks if v is not None else 0,
+        "payload_ok": ok_payload,
+    }))
+"""
+
+
+def run_child(extra_env):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("SLU_TPU_VERIFY_COLLECTIVES", None)
+    env.update(extra_env)
+    r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
+                       stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr.decode())
+        raise SystemExit(f"child failed (rc={r.returncode})")
+    return json.loads(r.stdout.decode().strip().splitlines()[-1])
+
+
+def fail(msg):
+    print(f"FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def main():
+    off = run_child({})
+    if off.get("skip"):
+        print(f"check_verify_overhead: SKIP ({off['skip']})")
+        return
+    # ---- off path: no verifier state, no-op guard singleton -------------
+    if off["verifier"] is not None:
+        fail(f"disabled path allocated a verifier: {off['verifier']}")
+    if not off["null_guard"]:
+        fail("disabled path did not reuse the no-op guard singleton")
+    if not off["payload_ok"]:
+        fail("payload mismatch with verification off")
+
+    # ---- on path: verifier present, one check per public op -------------
+    on = run_child({"SLU_TPU_VERIFY_COLLECTIVES": "1"})
+    if on["verifier"] != "LockstepVerifier":
+        fail(f"verify mode did not install a verifier: {on['verifier']}")
+    if on["checks"] != 3:
+        fail(f"expected 3 digest checks (one per public op), got "
+             f"{on['checks']}")
+    if not on["payload_ok"]:
+        fail("payload mismatch with verification on")
+    print("check_verify_overhead: OK (off path allocates no verifier "
+          "state; on path checks each public collective once)")
+
+
+if __name__ == "__main__":
+    main()

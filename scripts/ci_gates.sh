@@ -1,0 +1,65 @@
+#!/usr/bin/env bash
+# ci_gates.sh — the ONE entry point for the repo's non-pytest CI gates.
+#
+# Consolidates (shared contract: each gate exits non-zero on ANY
+# regression, produces its diagnostics on stdout/stderr, and runs under
+# a hard per-gate timeout):
+#
+#   slulint         scripts/run_slulint.sh          static analysis
+#                   (SLU101-SLU105, interprocedural tier) over the
+#                   package, scripts/, bench.py and examples/
+#   nan-guards      scripts/check_nan_guards.sh     JAX_DEBUG_NANS smoke
+#   trace-overhead  scripts/check_trace_overhead.py tracer off-path
+#                   allocation + artifact well-formedness
+#   verify-overhead scripts/check_verify_overhead.py  SLU106 lockstep
+#                   verifier: disabled path allocates no verifier state,
+#                   enabled path round-trips and counts checks
+#
+# Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
+#         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
+#
+# Every gate runs even after an earlier one fails (CI wants the full
+# picture); the exit code is the number of failed gates.  Wired for CI
+# directly after the tier-1 pytest command (ROADMAP.md):
+#
+#   python -m pytest tests/ -q -m 'not slow' && scripts/ci_gates.sh
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+TIMEOUT="${CI_GATE_TIMEOUT_S:-600}"
+
+declare -A GATES=(
+  [slulint]="scripts/run_slulint.sh"
+  [nan-guards]="scripts/check_nan_guards.sh"
+  [trace-overhead]="python scripts/check_trace_overhead.py"
+  [verify-overhead]="python scripts/check_verify_overhead.py"
+)
+ORDER=(slulint verify-overhead trace-overhead nan-guards)
+
+requested=("$@")
+if [ ${#requested[@]} -eq 0 ]; then
+  requested=("${ORDER[@]}")
+fi
+
+failed=0
+for gate in "${requested[@]}"; do
+  cmd="${GATES[$gate]:-}"
+  if [ -z "$cmd" ]; then
+    echo "ci_gates: unknown gate '$gate' (known: ${ORDER[*]})" >&2
+    failed=$((failed + 1))
+    continue
+  fi
+  echo "=== ci_gates: $gate (timeout ${TIMEOUT}s) ==="
+  if timeout -k 10 "$TIMEOUT" $cmd; then
+    echo "=== ci_gates: $gate OK ==="
+  else
+    rc=$?
+    echo "=== ci_gates: $gate FAILED (rc=$rc) ===" >&2
+    failed=$((failed + 1))
+  fi
+done
+
+if [ "$failed" -ne 0 ]; then
+  echo "ci_gates: $failed gate(s) failed" >&2
+fi
+exit "$failed"

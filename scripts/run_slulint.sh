@@ -7,12 +7,13 @@
 # ~1-2 s; the 60 s timeout is a hard ceiling far above the <10 s budget
 # (a slow scan is itself a regression — rules must stay lexical).
 #
-# Wired for CI next to the tier-1 command (ROADMAP.md), alongside
-# check_nan_guards.sh and check_trace_overhead.py, which follow the same
-# contract: non-zero exit on ANY regression, so `&&`-chaining the three
-# after pytest gates a change on all of them.
+# One gate of scripts/ci_gates.sh (the consolidated CI entry point).
+# Shared gate contract: non-zero exit on ANY regression, diagnostics on
+# stdout/stderr, hard timeout.  Scope: the package, scripts/, bench.py
+# AND examples/ (the CLI's default path set) — the full interprocedural
+# tier (call graph + dataflow) runs in well under the 10 s budget.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 exec timeout -k 5 60 python -m superlu_dist_tpu.analysis \
-  superlu_dist_tpu/ scripts/ bench.py "$@"
+  superlu_dist_tpu/ scripts/ bench.py examples/ "$@"

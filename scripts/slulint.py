@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """slulint entry point — identical to `python -m superlu_dist_tpu.analysis`.
 
-Kept as a script so the gate (run_slulint.sh), editors, and pre-commit
-hooks have a stable path that works from any cwd.  See docs/ANALYSIS.md
-for the rule catalog (SLU101-SLU105), suppressions, and the baseline
-workflow.
+Kept as a script so the gates (run_slulint.sh / ci_gates.sh), editors,
+and pre-commit hooks have a stable path that works from any cwd.  See
+docs/ANALYSIS.md for the rule catalog (SLU101-SLU105 static + SLU106
+runtime), the call-graph/dataflow engine, suppressions, and the
+baseline workflow (`--update-baseline` prunes fixed entries).
 """
 
 import os

@@ -1,18 +1,26 @@
 """slulint — project-native static analysis (docs/ANALYSIS.md).
 
 Rules:
-  SLU101 collective-consistency   (rules_collective.py)
+  SLU101 collective-consistency   (rules_collective.py, interprocedural)
   SLU102 trace-purity             (rules_trace.py)
-  SLU103 index-width discipline   (rules_index.py)
+  SLU103 index-width discipline   (rules_index.py, flow-based)
   SLU104 env-knob registry        (rules_env.py)
-  SLU105 jit-cache-key hygiene    (rules_trace.py)
+  SLU105 jit-cache-key hygiene    (rules_trace.py, call-graph-aware)
+  SLU106 runtime lockstep verify  (parallel/treecomm.py +
+                                   numeric/stream.py retrace sentinel,
+                                   env SLU_TPU_VERIFY_COLLECTIVES=1)
+
+Engine: every scan first builds a package-wide call graph
+(callgraph.py) and per-function dataflow summaries over the
+{i32, rank, env} taint lattice (dataflow.py); rules consume both.
 
 CLI: ``python -m superlu_dist_tpu.analysis`` (scripts/slulint.py is the
-same entry; scripts/run_slulint.sh is the CI gate).
+same entry; scripts/ci_gates.sh is the consolidated CI entry point).
 """
 
 from superlu_dist_tpu.analysis.core import (Finding, Rule, analyze_paths,
-                                            analyze_source, default_rules)
+                                            analyze_source, analyze_sources,
+                                            default_rules, read_sources)
 
 __all__ = ["Finding", "Rule", "analyze_paths", "analyze_source",
-           "default_rules"]
+           "analyze_sources", "default_rules", "read_sources"]

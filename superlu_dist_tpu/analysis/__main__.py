@@ -3,7 +3,12 @@
 Exit codes: 0 = clean (or every finding baselined/suppressed),
 1 = new findings, 2 = usage error.  Pure host-side text processing: no
 jax import, safe anywhere, fast enough for a pre-commit hook (the CI
-budget in scripts/run_slulint.sh is 10 s for the whole tree).
+budget in scripts/ci_gates.sh is 10 s for the whole tree).
+
+The scan is two-pass since v2: pass one builds the package-wide call
+graph + dataflow summaries (analysis/callgraph.py, analysis/dataflow.py)
+over every scanned file, pass two runs the rules with that project in
+hand so SLU101/SLU103/SLU105 resolve cross-module indirection.
 """
 
 from __future__ import annotations
@@ -15,10 +20,12 @@ import sys
 
 from superlu_dist_tpu.analysis import baseline as bl
 from superlu_dist_tpu.analysis.core import (analyze_source, default_rules,
-                                            iter_py_files)
+                                            read_sources)
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
+
+DEFAULT_PATHS = ["superlu_dist_tpu", "scripts", "bench.py", "examples"]
 
 
 def _build_parser():
@@ -27,11 +34,12 @@ def _build_parser():
         description="slulint: project-native static analysis "
                     "(collective-safety SLU101, trace-purity SLU102, "
                     "index-width SLU103, env-knob registry SLU104, "
-                    "jit-cache-key hygiene SLU105)")
-    p.add_argument("paths", nargs="*",
-                   default=["superlu_dist_tpu", "scripts", "bench.py"],
+                    "jit-cache-key hygiene SLU105; the SLU106 runtime "
+                    "twin lives in parallel/treecomm.py under "
+                    "SLU_TPU_VERIFY_COLLECTIVES=1)")
+    p.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
                    help="files/directories to scan (default: the package, "
-                        "scripts/, bench.py)")
+                        "scripts/, bench.py, examples/)")
     p.add_argument("--rules", default=None,
                    help="comma-separated rule ids to run (default: all)")
     p.add_argument("--baseline", default=None,
@@ -42,6 +50,14 @@ def _build_parser():
     p.add_argument("--write-baseline", action="store_true",
                    help="write the current findings to the baseline and "
                         "exit 0")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="prune baseline entries no longer matched by any "
+                        "current finding (fixed findings), print the "
+                        "drift, and exit 0 — never adds new entries")
+    p.add_argument("--no-dataflow", action="store_true",
+                   help="restore the PR-3 lexical-only behavior (no call "
+                        "graph, no taint propagation) — for measuring "
+                        "what the interprocedural tier adds")
     p.add_argument("--json", action="store_true", dest="as_json",
                    help="machine-readable output")
     p.add_argument("--list-rules", action="store_true",
@@ -49,9 +65,40 @@ def _build_parser():
     return p
 
 
+def _update_baseline(baseline_path, findings, sources) -> int:
+    """Drop baseline entries that no current finding matches (they were
+    fixed) and report the drift.  New findings are NOT added — that is
+    --write-baseline's explicit, deliberate act."""
+    if not os.path.exists(baseline_path):
+        print(f"no baseline at {baseline_path} — nothing to update")
+        return 0
+    entries = bl.load(baseline_path)
+    new, matched = bl.filter_new(findings, sources, entries,
+                                 root=_REPO_ROOT)
+    kept = [bl.entry(f, sources[f.path], root=_REPO_ROOT) for f in matched]
+    stale = len(entries) - len(kept)
+    bl.write(baseline_path, kept)
+    print(f"baseline {baseline_path}: {len(entries)} -> {len(kept)} "
+          f"entries ({stale} stale pruned)")
+    if new:
+        print(f"note: {len(new)} NEW finding(s) not added (fix them or "
+              "use --write-baseline deliberately):")
+        for f in new:
+            print("  " + f.render().splitlines()[0])
+    return 0
+
+
 def main(argv=None) -> int:
     args = _build_parser().parse_args(argv)
     rules = default_rules()
+    if args.no_dataflow:
+        from superlu_dist_tpu.analysis.rules_collective import CollectiveRule
+        from superlu_dist_tpu.analysis.rules_index import IndexWidthRule
+        from superlu_dist_tpu.analysis.rules_trace import JitCacheKeyRule
+        for r in rules:
+            if isinstance(r, (CollectiveRule, IndexWidthRule,
+                              JitCacheKeyRule)):
+                r.interprocedural = False
     if args.list_rules:
         for r in rules:
             print(f"{r.rule_id}  {r.title}")
@@ -70,11 +117,14 @@ def main(argv=None) -> int:
         print(f"no such path(s): {', '.join(missing)}", file=sys.stderr)
         return 2
 
-    findings, sources = [], {}
-    for path in iter_py_files(args.paths):
-        with open(path, encoding="utf-8") as fh:
-            sources[path] = fh.read()
-        findings.extend(analyze_source(sources[path], path, rules))
+    sources = read_sources(args.paths)
+    project = None
+    if not args.no_dataflow:
+        from superlu_dist_tpu.analysis.callgraph import build_project
+        project = build_project(sources)
+    findings = []
+    for path, source in sources.items():
+        findings.extend(analyze_source(source, path, rules, project))
 
     baseline_path = args.baseline or os.path.join(
         _REPO_ROOT, bl.DEFAULT_BASELINE_NAME)
@@ -84,6 +134,8 @@ def main(argv=None) -> int:
                   for f in findings])
         print(f"wrote {len(findings)} finding(s) to {baseline_path}")
         return 0
+    if args.update_baseline:
+        return _update_baseline(baseline_path, findings, sources)
 
     baselined = []
     if not args.no_baseline and os.path.exists(baseline_path):

@@ -58,10 +58,14 @@ class Rule:
     """Base class for slulint rules.
 
     Subclasses set ``rule_id``/``title``/``hint`` and implement
-    ``check(tree, source, path) -> list[Finding]``.  ``package_dirs``
-    restricts a rule to subpackages *within* the superlu_dist_tpu tree
-    (hot-path rules like trace-purity only make sense there); files
-    outside the package — scripts, test fixtures — are always in scope.
+    ``check(tree, source, path, project=None) -> list[Finding]``.
+    ``project`` is the package-wide call graph + dataflow summaries
+    (analysis.callgraph.Project) when the driver built one — rules use
+    it for interprocedural reasoning and must degrade to their lexical
+    behavior when it is None.  ``package_dirs`` restricts a rule to
+    subpackages *within* the superlu_dist_tpu tree (hot-path rules like
+    trace-purity only make sense there); files outside the package —
+    scripts, test fixtures — are always in scope.
     """
 
     rule_id: str = "SLU1xx"
@@ -75,7 +79,8 @@ class Rule:
             return True
         return any(d in parts for d in self.package_dirs)
 
-    def check(self, tree: ast.AST, source: str, path: str) -> list:
+    def check(self, tree: ast.AST, source: str, path: str,
+              project=None) -> list:
         raise NotImplementedError
 
     def finding(self, path: str, node: ast.AST, message: str,
@@ -180,7 +185,12 @@ def default_rules() -> list:
             EnvKnobRule(), JitCacheKeyRule()]
 
 
-def analyze_source(source: str, path: str, rules) -> list:
+def analyze_source(source: str, path: str, rules, project=None) -> list:
+    """Run `rules` over one file.  With ``project=None`` a single-file
+    project (call graph + dataflow summaries of just this source) is
+    built, so intra-module interprocedural reasoning works even for
+    isolated fixtures; the driver passes the package-wide project when
+    scanning a tree."""
     try:
         tree = ast.parse(source, filename=path)
     except SyntaxError as exc:
@@ -188,12 +198,15 @@ def analyze_source(source: str, path: str, rules) -> list:
                         f"file does not parse: {exc.msg}",
                         "slulint gates on parseability so every rule "
                         "actually ran")]
+    if project is None:
+        from superlu_dist_tpu.analysis.callgraph import build_project
+        project = build_project({path: (source, tree)})
     per_line, file_wide = suppressions(source)
     out = []
     for rule in rules:
         if not rule.applies(path):
             continue
-        for f in rule.check(tree, source, path):
+        for f in rule.check(tree, source, path, project):
             if f.rule in file_wide or f.rule in per_line.get(f.line, ()):
                 continue
             out.append(f)
@@ -219,11 +232,25 @@ def iter_py_files(paths):
                     yield os.path.join(root, name)
 
 
-def analyze_paths(paths, rules=None) -> list:
-    rules = default_rules() if rules is None else rules
-    out = []
+def read_sources(paths) -> dict:
+    sources = {}
     for path in iter_py_files(paths):
         with open(path, encoding="utf-8") as fh:
-            source = fh.read()
-        out.extend(analyze_source(source, path, rules))
+            sources[path] = fh.read()
+    return sources
+
+
+def analyze_sources(sources: dict, rules=None) -> list:
+    """Whole-tree scan: ONE project (call graph + summaries) spanning
+    every file, so cross-module indirection resolves."""
+    from superlu_dist_tpu.analysis.callgraph import build_project
+    rules = default_rules() if rules is None else rules
+    project = build_project(sources)
+    out = []
+    for path, source in sources.items():
+        out.extend(analyze_source(source, path, rules, project))
     return out
+
+
+def analyze_paths(paths, rules=None) -> list:
+    return analyze_sources(read_sources(paths), rules)

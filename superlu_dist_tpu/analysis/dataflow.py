@@ -1,0 +1,470 @@
+"""Forward abstract interpretation for the dataflow-aware slulint rules.
+
+A deliberately small lattice — each variable carries a set of *taints*,
+each taint a kind plus a one-line provenance used verbatim in findings:
+
+* ``i32``  — the value is (or derives from) a 32-bit integer array:
+  a ctor/``astype``/``cumsum`` with a 32-bit dtype (including the
+  env-selected ``INT`` alias), or the return of a function whose returns
+  are i32-tainted.  ``.astype(np.int64)`` *clears* the taint — promotion
+  is exactly the fix the rule asks for.
+* ``rank`` — the value derives from the caller's rank / grid coordinate
+  (``.rank``/``.iam``/``.myrow``/``.mycol`` attribute reads, the lexical
+  rank names, or the return of a rank-deriving function like an
+  ``is_root(tc)`` predicate).
+* ``env``  — the value derives from ``os.environ`` (directly or via the
+  registry helpers ``env_int``/``env_float``/``env_str``/``env_flag``).
+
+Propagation is a single in-order forward pass per function (loop bodies
+run twice for loop-carried taint), through assignments, augmented
+assignments, tuple unpacking, subscripts, a small set of
+shape-preserving numpy passthroughs, and — via the call graph — function
+returns, iterated to a fixpoint across the project.
+
+Per-function :class:`Summary` records feed the rules: direct + transitive
+collective reachability (SLU101), return taints (SLU101 rank predicates,
+SLU103 i32-through-return), and direct + transitive env reachability
+(SLU105).  One idiom is recognized and *exempted*: a zero-argument
+``lru_cache``-decorated env reader (``ops/dense._precision``) is a
+read-once latched constant — its value cannot change within a process,
+so baking it into a compiled program without a cache key is sound, and
+env-reachability does not propagate through it.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+
+from superlu_dist_tpu.analysis.core import dotted_name, is_env_read
+
+TAINT_I32 = "i32"
+TAINT_RANK = "rank"
+TAINT_ENV = "env"
+
+#: TreeComm collective surface (rules_collective re-exports this).
+COLLECTIVE_METHODS = frozenset({
+    "bcast", "reduce_sum", "allreduce_sum", "bcast_bytes", "bcast_obj",
+    "bcast_any", "reduce_sum_any", "allreduce_sum_any",
+})
+
+_RANK_ATTRS = frozenset({"rank", "iam", "myrow", "mycol"})
+_RANK_NAMES = frozenset({"rank", "iam", "myrank", "my_rank"})
+
+_ENV_HELPER_SUFFIXES = tuple(
+    f"options.{n}" for n in ("env_int", "env_float", "env_str", "env_flag"))
+
+# ---- 32-bit dtype recognition (shared with rules_index) -------------------
+
+_I32_DOTTED = frozenset({"np.int32", "numpy.int32", "np.intc",
+                         "numpy.intc", "int32"})
+# formats.INT is int32 unless SLU_TPU_INT64 is set — treat it as 32-bit
+# for accumulator purposes (the whole point of the alias is that callers
+# must not feed it to arithmetic that can exceed 2^31)
+_I32_ALIASES = frozenset({"INT"})
+_I64_NAMES = frozenset({"np.int64", "numpy.int64", "int64", "np.intp",
+                        "numpy.intp"})
+
+_ARRAY_CTORS = frozenset({"zeros", "empty", "full", "arange", "array",
+                          "asarray", "ones"})
+# calls through which an i32 taint survives unchanged
+_PASSTHROUGH = frozenset({"cumsum", "asarray", "ascontiguousarray",
+                          "array", "copy", "ravel", "reshape",
+                          "concatenate"})
+
+
+def is_i32_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int32":
+        return True
+    name = dotted_name(node)
+    return name in _I32_DOTTED or name in _I32_ALIASES
+
+
+def is_i64_dtype(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant) and node.value == "int64":
+        return True
+    return dotted_name(node) in _I64_NAMES
+
+
+def dtype_kw(call: ast.Call):
+    for kw in call.keywords:
+        if kw.arg == "dtype":
+            return kw.value
+    return None
+
+
+def is_explicit_i32_expr(node: ast.AST) -> bool:
+    """np.int32(x) or x.astype(np.int32) / x.astype('int32')."""
+    if not isinstance(node, ast.Call):
+        return False
+    if is_i32_dtype(node.func) and dotted_name(node.func) not in \
+            _I32_ALIASES:
+        return True
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args and is_i32_dtype(node.args[0]):
+        return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# per-function summaries
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Summary:
+    """What the rest of the project needs to know about one function."""
+
+    return_taints: dict = dataclasses.field(default_factory=dict)
+    collective: str | None = None       # direct witness "op at path:line"
+    env: str | None = None              # direct witness
+    latched_env: bool = False           # zero-arg lru_cached env reader
+    # transitive: (qname of the function owning the witness, witness)
+    reaches_collective: tuple | None = None
+    reaches_env: tuple | None = None
+
+
+def _site(path: str, node: ast.AST) -> str:
+    return f"{path}:{getattr(node, 'lineno', 0)}"
+
+
+def _own_body_nodes(fn):
+    """Nodes lexically in `fn`'s own body — nested defs/lambdas excluded
+    (they execute in their own context and carry their own Summary)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def is_env_helper(target: str | None) -> bool:
+    return bool(target) and target.endswith(_ENV_HELPER_SUFFIXES)
+
+
+def _direct_collective(fi) -> str | None:
+    for node in _own_body_nodes(fi.node):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr in COLLECTIVE_METHODS:
+            return f"{node.func.attr} at {_site(fi.path, node)}"
+    return None
+
+
+def _direct_env(proj, fi) -> str | None:
+    for node in _own_body_nodes(fi.node):
+        env = is_env_read(node)
+        if env is not None:
+            key = env[0] or "<dynamic>"
+            return f"os.environ[{key!r}] at {_site(fi.path, env[1])}"
+        if isinstance(node, ast.Call):
+            target = proj.call_target(fi.path, node)
+            if is_env_helper(target):
+                return (f"{target.rsplit('.', 1)[-1]}(...) at "
+                        f"{_site(fi.path, node)}")
+    return None
+
+
+def _is_lru_decorated(fn) -> bool:
+    for d in fn.decorator_list:
+        if isinstance(d, ast.Call):
+            d = d.func
+        if dotted_name(d) in ("lru_cache", "functools.lru_cache",
+                              "cache", "functools.cache"):
+            return True
+    return False
+
+
+def _is_latched_const(fi, direct_env) -> bool:
+    """Zero-argument lru_cached env reader: reads once per process, so
+    its value is a process constant (ops/dense._precision)."""
+    a = fi.node.args
+    n_args = len(a.posonlyargs) + len(a.args) + len(a.kwonlyargs) \
+        + (1 if a.vararg else 0) + (1 if a.kwarg else 0)
+    return bool(direct_env) and n_args == 0 and _is_lru_decorated(fi.node)
+
+
+def summarize(proj) -> None:
+    """Fill proj.summaries for every function in the project."""
+    sums = {q: Summary() for q in proj.functions}
+    proj.summaries = sums
+    for q, fi in proj.functions.items():
+        s = sums[q]
+        s.collective = _direct_collective(fi)
+        s.env = _direct_env(proj, fi)
+        s.latched_env = _is_latched_const(fi, s.env)
+        if s.collective:
+            s.reaches_collective = (q, s.collective)
+        if s.env and not s.latched_env:
+            s.reaches_env = (q, s.env)
+
+    # transitive reachability over resolved call edges (cycle-safe)
+    changed = True
+    while changed:
+        changed = False
+        for q, fi in proj.functions.items():
+            s = sums[q]
+            for callee in fi.calls:
+                cs = sums.get(callee)
+                if cs is None:
+                    continue
+                if s.reaches_collective is None \
+                        and cs.reaches_collective is not None:
+                    s.reaches_collective = cs.reaches_collective
+                    changed = True
+                if s.reaches_env is None and not s.latched_env \
+                        and cs.reaches_env is not None:
+                    s.reaches_env = cs.reaches_env
+                    changed = True
+
+    # return-taint fixpoint (i32/rank/env through returns and call edges)
+    for _ in range(4):
+        changed = False
+        for q, fi in proj.functions.items():
+            flow = FnFlow.for_function(proj, fi)
+            flow.run()
+            if flow.returns != sums[q].return_taints:
+                sums[q].return_taints = flow.returns
+                changed = True
+        if not changed:
+            break
+
+
+# --------------------------------------------------------------------------
+# the forward pass
+# --------------------------------------------------------------------------
+
+class FnFlow:
+    """One function (or module) body, interpreted in order."""
+
+    def __init__(self, body, path, resolve, summaries):
+        self.body = body
+        self.path = path
+        self.resolve = resolve          # Call node -> qname | None
+        self.summaries = summaries
+        self.env: dict = {}             # var -> {kind: provenance}
+        self.assigns: dict = {}         # (line, col) -> (names, node, taints)
+        self.returns: dict = {}         # {kind: provenance}
+
+    @classmethod
+    def for_function(cls, proj, fi):
+        resolve = (lambda call: proj.call_target(fi.path, call))
+        return cls(fi.node.body, fi.path, resolve, proj.summaries)
+
+    @classmethod
+    def for_module(cls, proj, path, tree):
+        resolve = (lambda call: proj.call_target(path, call))
+        return cls(tree.body, path, resolve, proj.summaries)
+
+    def run(self):
+        self._exec(self.body)
+        return self
+
+    def rank_tainted(self, expr) -> str | None:
+        """Provenance if `expr` is rank-dependent: lexical rank names,
+        rank-tainted locals, or calls returning rank-derived values."""
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Attribute) and sub.attr in _RANK_ATTRS:
+                return f"`{dotted_name(sub) or sub.attr}`"
+            if isinstance(sub, ast.Name):
+                if sub.id in _RANK_NAMES:
+                    return f"`{sub.id}`"
+                t = self.env.get(sub.id)
+                if t and TAINT_RANK in t:
+                    return f"`{sub.id}` ({t[TAINT_RANK]})"
+            if isinstance(sub, ast.Call):
+                s = self._call_summary(sub)
+                if s is not None and TAINT_RANK in s.return_taints:
+                    return (f"`{dotted_name(sub.func)}()` returns "
+                            f"{s.return_taints[TAINT_RANK]}")
+        return None
+
+    # ---- expression taint ----------------------------------------------
+    def _call_summary(self, call):
+        target = self.resolve(call)
+        return self.summaries.get(target) if target else None
+
+    def taint(self, node) -> dict:
+        if node is None or isinstance(node, ast.Constant):
+            return {}
+        if isinstance(node, ast.Name):
+            t = dict(self.env.get(node.id, ()))
+            if node.id in _RANK_NAMES:
+                t.setdefault(TAINT_RANK, f"`{node.id}`")
+            return t
+        if isinstance(node, ast.Attribute):
+            if node.attr in _RANK_ATTRS:
+                return {TAINT_RANK: f"`{dotted_name(node) or node.attr}`"}
+            return {}
+        if isinstance(node, ast.Subscript):
+            return self.taint(node.value)
+        if isinstance(node, ast.UnaryOp):
+            return self.taint(node.operand)
+        if isinstance(node, ast.BinOp):
+            lt, rt = self.taint(node.left), self.taint(node.right)
+            out = {}
+            # numpy promotes int32 op int64 -> int64: only keep i32 when
+            # no operand is known-promoted (a constant keeps the taint)
+            if TAINT_I32 in lt and (TAINT_I32 in rt or _const_like(
+                    node.right)):
+                out[TAINT_I32] = lt[TAINT_I32]
+            elif TAINT_I32 in rt and _const_like(node.left):
+                out[TAINT_I32] = rt[TAINT_I32]
+            for t in (lt, rt):
+                for k in (TAINT_RANK, TAINT_ENV):
+                    if k in t:
+                        out.setdefault(k, t[k])
+            return out
+        if isinstance(node, (ast.BoolOp, ast.Compare)):
+            vals = (node.values if isinstance(node, ast.BoolOp)
+                    else [node.left] + list(node.comparators))
+            out = {}
+            for v in vals:
+                for k, p in self.taint(v).items():
+                    if k != TAINT_I32:      # comparisons yield bools
+                        out.setdefault(k, p)
+            return out
+        if isinstance(node, ast.IfExp):
+            out = dict(self.taint(node.body))
+            for k, p in self.taint(node.orelse).items():
+                out.setdefault(k, p)
+            return out
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = {}
+            for e in node.elts:
+                for k, p in self.taint(e).items():
+                    out.setdefault(k, p)
+            return out
+        if isinstance(node, ast.Call):
+            return self._call_taint(node)
+        return {}
+
+    def _call_taint(self, node: ast.Call) -> dict:
+        env = is_env_read(node)
+        if env is not None:
+            return {TAINT_ENV: f"os.environ[{env[0]!r}]"}
+        fn = node.func
+        name = dotted_name(fn)
+        # x.astype(D): promotion clears, demotion taints
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype" \
+                and node.args:
+            base = dict(self.taint(fn.value))
+            if is_i32_dtype(node.args[0]):
+                base[TAINT_I32] = f"`.astype({dotted_name(node.args[0]) or 'int32'})` at line {node.lineno}"
+            else:
+                base.pop(TAINT_I32, None)
+            return base
+        # np.int32(x) and friends
+        if is_explicit_i32_expr(node):
+            return {TAINT_I32: f"`{name}()` cast at line {node.lineno}"}
+        # array ctors / cumsum with an explicit 32-bit dtype
+        tail = name.rsplit(".", 1)[-1]
+        if tail in _ARRAY_CTORS or tail == "cumsum":
+            dt = dtype_kw(node)
+            if dt is None and tail in _ARRAY_CTORS and len(node.args) >= 2 \
+                    and is_i32_dtype(node.args[-1]):
+                dt = node.args[-1]
+            if dt is not None:
+                if is_i32_dtype(dt):
+                    return {TAINT_I32: f"`{name}(dtype="
+                                       f"{dotted_name(dt) or 'int32'})` "
+                                       f"at line {node.lineno}"}
+                return {}
+            if tail in _PASSTHROUGH and node.args:
+                return dict(self.taint(node.args[0]))
+            return {}
+        if tail in _PASSTHROUGH and node.args:
+            return dict(self.taint(node.args[0]))
+        target = self.resolve(node)
+        if is_env_helper(target):
+            return {TAINT_ENV: f"`{name}(...)`"}
+        s = self.summaries.get(target) if target else None
+        if s is not None and s.return_taints:
+            return {k: f"return of `{target}` ({p})"
+                    for k, p in s.return_taints.items()}
+        return {}
+
+    # ---- statements -----------------------------------------------------
+    def _bind(self, target, taints):
+        if isinstance(target, ast.Name):
+            self.env[target.id] = dict(taints)
+        elif isinstance(target, ast.Starred):
+            self._bind(target.value, taints)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._bind(e, taints)
+
+    def _record(self, targets, node, taints):
+        names = [t.id for t in targets if isinstance(t, ast.Name)]
+        if not names:
+            return
+        key = (node.lineno, node.col_offset)
+        prev = self.assigns.get(key)
+        if prev is not None:
+            merged = dict(prev[2])
+            for k, p in taints.items():
+                merged.setdefault(k, p)
+            taints = merged
+            names = sorted(set(prev[0]) | set(names))
+        self.assigns[key] = (names, node, taints)
+
+    def _exec(self, stmts):
+        for st in stmts:
+            if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+                continue
+            if isinstance(st, ast.Assign):
+                t = self.taint(st.value)
+                for target in st.targets:
+                    self._bind(target, t)
+                self._record(st.targets, st.value, t)
+            elif isinstance(st, ast.AnnAssign) and st.value is not None:
+                t = self.taint(st.value)
+                self._bind(st.target, t)
+                self._record([st.target], st.value, t)
+            elif isinstance(st, ast.AugAssign):
+                t = self.taint(st.value)
+                if isinstance(st.target, ast.Name):
+                    merged = dict(self.env.get(st.target.id, ()))
+                    for k, p in t.items():
+                        merged.setdefault(k, p)
+                    self.env[st.target.id] = merged
+            elif isinstance(st, ast.Return):
+                for k, p in self.taint(st.value).items():
+                    self.returns.setdefault(k, p)
+            elif isinstance(st, (ast.If,)):
+                self._exec(st.body)
+                self._exec(st.orelse)
+            elif isinstance(st, (ast.For, ast.AsyncFor)):
+                self._bind(st.target, self.taint(st.iter))
+                self._exec(st.body)
+                self._exec(st.body)       # loop-carried taints
+                self._exec(st.orelse)
+            elif isinstance(st, ast.While):
+                self._exec(st.body)
+                self._exec(st.body)
+                self._exec(st.orelse)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    if item.optional_vars is not None:
+                        self._bind(item.optional_vars,
+                                   self.taint(item.context_expr))
+                self._exec(st.body)
+            elif isinstance(st, ast.Try):
+                self._exec(st.body)
+                for h in st.handlers:
+                    self._exec(h.body)
+                self._exec(st.orelse)
+                self._exec(st.finalbody)
+
+
+def _const_like(node) -> bool:
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.UnaryOp):
+        return _const_like(node.operand)
+    if isinstance(node, ast.BinOp):
+        return _const_like(node.left) and _const_like(node.right)
+    return False

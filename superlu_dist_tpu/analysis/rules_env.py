@@ -46,7 +46,7 @@ class EnvKnobRule(Rule):
             self._keys = _registry_keys() | self._extra
         return self._keys
 
-    def check(self, tree, source, path):
+    def check(self, tree, source, path, project=None):
         findings = []
         for node in ast.walk(tree):
             env = is_env_read(node)

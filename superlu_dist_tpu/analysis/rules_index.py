@@ -1,4 +1,4 @@
-"""SLU103 — index-width discipline.
+"""SLU103 — index-width discipline (flow-based since v2).
 
 The GESP analog of the reference's ``int_t`` audit (superlu_defs.h:80-93
 / XSDK_INDEX_SIZE): pattern indices may be 32-bit (``sparse.formats.INT``
@@ -12,13 +12,17 @@ everywhere outside it, e.g. test fixtures):
 * ``np.cumsum(..., dtype=D)`` with a possibly-32-bit D (``np.int32``,
   ``"int32"``, ``np.intc``, or the env-selected ``INT`` alias) — a
   running prefix sum is the canonical nnz accumulator;
-* array construction (`zeros`/`empty`/`full`/`arange`/`array`/`asarray`)
-  or ``.astype`` with a possibly-32-bit dtype assigned to an
-  accumulator-named target (indptr / *off* / *ptr* / nnz* / *cnt* /
-  count / total);
 * arithmetic (`*`, `+`) where an operand is an EXPLICIT int32 cast
   (``np.int32(x)``, ``x.astype(np.int32)``) — products of dimension-like
-  quantities must be promoted before they multiply, not after.
+  quantities must be promoted before they multiply, not after;
+* any assignment to an accumulator-named target (indptr / *off* / *ptr*
+  / nnz* / *cnt* / count / total) whose value the forward dataflow pass
+  (analysis/dataflow.py) proves int32-typed.  v1 only matched a 32-bit
+  constructor written *directly* on the assignment; v2 follows the taint
+  through temporaries (``tmp = np.zeros(n, np.int32); indptr = tmp``)
+  and through function returns (``indptr = _alloc(n)`` where ``_alloc``
+  returns an int32 array — resolved through the package call graph).
+  ``.astype(np.int64)`` clears the taint: promotion is the fix.
 """
 
 from __future__ import annotations
@@ -27,13 +31,9 @@ import ast
 import re
 
 from superlu_dist_tpu.analysis.core import Rule, dotted_name
-
-_I32_DOTTED = frozenset({"np.int32", "numpy.int32", "np.intc",
-                         "numpy.intc", "int32"})
-# formats.INT is int32 unless SLU_TPU_INT64 is set — treat it as 32-bit
-# for accumulator purposes (the whole point of the alias is that callers
-# must not feed it to arithmetic that can exceed 2^31)
-_I32_ALIASES = frozenset({"INT"})
+from superlu_dist_tpu.analysis.dataflow import (FnFlow, TAINT_I32, dtype_kw,
+                                                is_explicit_i32_expr,
+                                                is_i32_dtype)
 
 _ACCUM_TARGET = re.compile(
     r"(^|_)(indptr|offs?|offsets?|ptr|rows_ptr|nnz\w*|cnt|counts?|total)"
@@ -41,33 +41,6 @@ _ACCUM_TARGET = re.compile(
 
 _ARRAY_CTORS = frozenset({"zeros", "empty", "full", "arange", "array",
                           "asarray", "ones"})
-
-
-def _is_i32_dtype(node: ast.AST) -> bool:
-    if isinstance(node, ast.Constant) and node.value == "int32":
-        return True
-    name = dotted_name(node)
-    return name in _I32_DOTTED or name in _I32_ALIASES
-
-
-def _dtype_kw(call: ast.Call):
-    for kw in call.keywords:
-        if kw.arg == "dtype":
-            return kw.value
-    return None
-
-
-def _is_explicit_i32_expr(node: ast.AST) -> bool:
-    """np.int32(x) or x.astype(np.int32) / x.astype('int32')."""
-    if not isinstance(node, ast.Call):
-        return False
-    if _is_i32_dtype(node.func) and dotted_name(node.func) not in \
-            _I32_ALIASES:
-        return True
-    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
-            and node.args and _is_i32_dtype(node.args[0]):
-        return True
-    return False
 
 
 class IndexWidthRule(Rule):
@@ -79,36 +52,75 @@ class IndexWidthRule(Rule):
             "products (.astype(np.int64) * ...)")
     package_dirs = ("symbolic", "sparse", "numeric")
 
-    def check(self, tree, source, path):
+    def __init__(self, interprocedural: bool = True):
+        self.interprocedural = interprocedural
+
+    def check(self, tree, source, path, project=None):
         findings = []
+        flagged = set()       # (line, col) dedup across lexical + flow
+
+        def add(node, message):
+            key = (getattr(node, "lineno", 0), getattr(node, "col_offset",
+                                                       0))
+            if key in flagged:
+                return
+            flagged.add(key)
+            findings.append(self.finding(path, node, message))
+
         for node in ast.walk(tree):
             if isinstance(node, ast.Call):
-                self._check_call(node, path, findings)
-            elif isinstance(node, ast.Assign):
-                self._check_assign(node, path, findings)
+                self._check_call(node, add)
+            elif isinstance(node, ast.Assign) \
+                    and not (self.interprocedural and project is not None):
+                self._check_assign(node, add)
             elif isinstance(node, ast.BinOp) \
                     and isinstance(node.op, (ast.Mult, ast.Add)):
                 for side in (node.left, node.right):
-                    if _is_explicit_i32_expr(side):
-                        findings.append(self.finding(
-                            path, node,
+                    if is_explicit_i32_expr(side):
+                        add(node,
                             "int32-cast operand in arithmetic — the "
                             "product/sum wraps at 2^31 before any later "
-                            "promotion can save it"))
+                            "promotion can save it")
                         break
+
+        if self.interprocedural and project is not None:
+            self._check_flow(tree, path, project, add)
         return findings
 
-    def _check_call(self, node, path, findings):
+    # ---- v2: the dataflow pass ------------------------------------------
+    def _check_flow(self, tree, path, project, add):
+        """Run the forward pass over the module body and every function
+        body; flag accumulator-named targets receiving i32-tainted
+        values (direct ctors, temporaries, and resolved returns)."""
+        scopes = [FnFlow.for_module(project, path, tree)]
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scopes.append(FnFlow(
+                    node.body, path,
+                    lambda c: project.call_target(path, c),
+                    project.summaries))
+        for flow in scopes:
+            flow.run()
+            for names, value_node, taints in flow.assigns.values():
+                accum = [n for n in names if _ACCUM_TARGET.search(n)]
+                if not accum or TAINT_I32 not in taints:
+                    continue
+                add(value_node,
+                    f"accumulator `{', '.join(accum)}` receives an "
+                    f"int32-typed value ({taints[TAINT_I32]}) — "
+                    "offset/nnz accumulators must be int64")
+
+    # ---- lexical checks (v1, still the base tier) -----------------------
+    def _check_call(self, node, add):
         name = dotted_name(node.func)
         if name.endswith("cumsum"):
-            dt = _dtype_kw(node)
-            if dt is not None and _is_i32_dtype(dt):
-                findings.append(self.finding(
-                    path, node,
+            dt = dtype_kw(node)
+            if dt is not None and is_i32_dtype(dt):
+                add(node,
                     f"cumsum with 32-bit dtype `{dotted_name(dt) or 'int32'}`"
-                    " — a prefix-sum accumulator overflows at nnz > 2^31"))
+                    " — a prefix-sum accumulator overflows at nnz > 2^31")
 
-    def _check_assign(self, node, path, findings):
+    def _check_assign(self, node, add):
         targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
         if not any(_ACCUM_TARGET.search(t) for t in targets):
             return
@@ -118,16 +130,15 @@ class IndexWidthRule(Rule):
         dt = None
         fn = val.func
         if isinstance(fn, ast.Attribute) and fn.attr in _ARRAY_CTORS:
-            dt = _dtype_kw(val)
+            dt = dtype_kw(val)
             if dt is None and len(val.args) >= 2 \
                     and fn.attr in ("zeros", "empty", "full", "arange",
                                     "array", "asarray", "ones"):
-                dt = val.args[-1] if _is_i32_dtype(val.args[-1]) else None
+                dt = val.args[-1] if is_i32_dtype(val.args[-1]) else None
         elif isinstance(fn, ast.Attribute) and fn.attr == "astype" \
                 and val.args:
             dt = val.args[0]
-        if dt is not None and _is_i32_dtype(dt):
-            findings.append(self.finding(
-                path, node.value,
+        if dt is not None and is_i32_dtype(dt):
+            add(node.value,
                 f"accumulator `{', '.join(targets)}` constructed with a "
-                "32-bit dtype — offset/nnz accumulators must be int64"))
+                "32-bit dtype — offset/nnz accumulators must be int64")

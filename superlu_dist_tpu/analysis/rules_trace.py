@@ -17,8 +17,14 @@ enclosing function — is baked into the compiled program but absent from
 the cache key, so two configurations silently share one kernel
 (ops/dense.pivot_kernel documents exactly this contract: executors must
 put the env choice IN their key).  Flagged: env reads inside an
-lru_cached jit factory, and loads of enclosing-function locals that are
-not factory parameters.
+lru_cached jit factory, loads of enclosing-function locals that are not
+factory parameters, and — since v2, through the package call graph —
+calls to helpers that *transitively* read env (the factory's traced
+body calling ``pivot_kernel()`` three frames down is the same bug as
+reading the env inline).  One idiom is exempt: a zero-argument
+lru_cached env reader (``ops/dense._precision``) is a read-once latched
+process constant, so baking it in without a key is sound
+(analysis/dataflow.py's ``latched_env``).
 """
 
 from __future__ import annotations
@@ -80,7 +86,7 @@ class TracePurityRule(Rule):
             "every call")
     package_dirs = ("numeric", "solve", "ops")
 
-    def check(self, tree, source, path):
+    def check(self, tree, source, path, project=None):
         findings = []
         wrapped = _jit_wrapped_names(tree)
         for node in ast.walk(tree):
@@ -169,29 +175,35 @@ class JitCacheKeyRule(Rule):
             "resolve env/config in an uncached wrapper and pass it in, "
             "the way ops/dense.make_front_kernel passes pivot_kernel()")
 
-    def check(self, tree, source, path):
+    def __init__(self, interprocedural: bool = True):
+        self.interprocedural = interprocedural
+
+    def check(self, tree, source, path, project=None):
         findings = []
-        self._scan(tree.body, [], path, findings)
+        proj = project if self.interprocedural else None
+        self._scan(tree.body, [], path, findings, proj)
         return findings
 
-    def _scan(self, stmts, enclosing, path, findings):
+    def _scan(self, stmts, enclosing, path, findings, project):
         for st in stmts:
             if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 if any(_is_lru_decorator(d) for d in st.decorator_list) \
                         and self._contains_jit(st):
-                    self._check_factory(st, enclosing, path, findings)
-                self._scan(st.body, enclosing + [st], path, findings)
+                    self._check_factory(st, enclosing, path, findings,
+                                        project)
+                self._scan(st.body, enclosing + [st], path, findings,
+                           project)
             elif isinstance(st, ast.ClassDef):
-                self._scan(st.body, enclosing, path, findings)
+                self._scan(st.body, enclosing, path, findings, project)
             elif isinstance(st, (ast.If, ast.For, ast.AsyncFor, ast.While)):
-                self._scan(st.body, enclosing, path, findings)
-                self._scan(st.orelse, enclosing, path, findings)
+                self._scan(st.body, enclosing, path, findings, project)
+                self._scan(st.orelse, enclosing, path, findings, project)
             elif isinstance(st, (ast.With, ast.AsyncWith)):
-                self._scan(st.body, enclosing, path, findings)
+                self._scan(st.body, enclosing, path, findings, project)
             elif isinstance(st, ast.Try):
                 for block in ([st.body, st.orelse, st.finalbody]
                               + [h.body for h in st.handlers]):
-                    self._scan(block, enclosing, path, findings)
+                    self._scan(block, enclosing, path, findings, project)
 
     @staticmethod
     def _contains_jit(fn) -> bool:
@@ -203,7 +215,7 @@ class JitCacheKeyRule(Rule):
                 return True
         return False
 
-    def _check_factory(self, fn, enclosing, path, findings):
+    def _check_factory(self, fn, enclosing, path, findings, project):
         for node in _walk_own_body(fn):
             env = is_env_read(node)
             if env is not None:
@@ -212,6 +224,22 @@ class JitCacheKeyRule(Rule):
                     f"env read inside lru_cached jit factory `{fn.name}` "
                     "— the value selects the compiled program but is not "
                     "part of the cache key"))
+                continue
+            # v2: transitive env reads through the call graph (the traced
+            # body calling a helper that reads env frames below), minus
+            # the latched-constant exemption
+            if project is not None and isinstance(node, ast.Call):
+                target = project.call_target(path, node)
+                s = project.summaries.get(target) if target else None
+                if s is not None and s.reaches_env is not None:
+                    owner, witness = s.reaches_env
+                    findings.append(self.finding(
+                        path, node,
+                        f"lru_cached jit factory `{fn.name}` calls "
+                        f"`{target.rsplit('.', 2)[-1]}` which reaches an "
+                        f"env read ({witness} via `{owner}`) — the value "
+                        "selects the compiled program but is not part of "
+                        "the cache key"))
         if not enclosing:
             return
         outer_bound = set()

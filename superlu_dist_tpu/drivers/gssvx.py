@@ -340,6 +340,8 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
         stats = Stats()
     options = lu.options
     plan = lu.plan
+    from superlu_dist_tpu.numeric.stream import RETRACE_SENTINEL
+    retr0 = RETRACE_SENTINEL.total
     dtype = options.factor_dtype or default_factor_dtype()
     if np.issubdtype(np.asarray(bvals).dtype, np.complexfloating):
         dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
@@ -371,6 +373,9 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
                 up.block_until_ready()
     stats.ops["FACT"] += plan.flops
     stats.tiny_pivots += numeric.tiny_pivots
+    # retrace sentinel (runtime SLU106): unexpected recompiles during
+    # THIS factorization, surfaced on the same Stats the report prints
+    stats.retraces += RETRACE_SENTINEL.total - retr0
     # memory observability (dQuerySpace_dist analog, SRC/dmemory_dist.c:73)
     from superlu_dist_tpu.numeric.factor import query_space
     space = query_space(numeric)

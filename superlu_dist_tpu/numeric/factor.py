@@ -32,7 +32,7 @@ from superlu_dist_tpu.ops.dense import group_partial_factor
 
 def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
                children, front_sharding=None, pivot_sharding=None,
-               replicated=None):
+               replicated=None, pivot="blocked"):
     """One (level, bucket) group: assemble + factor + write back.
 
     dims = (batch, m, w, u) static; `children` is a list of
@@ -70,7 +70,7 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
         f = wsc(f, front_sharding)
     lpanel, upanel, schur, counts = group_partial_factor(
         f, thresh, w, front_sharding=front_sharding,
-        pivot_sharding=pivot_sharding)
+        pivot_sharding=pivot_sharding, pivot=pivot)
     # counts is (batch, w) per-column tiny flags; identity-padding columns
     # (col >= ws, incl. whole padded batch slots with ws == 0) are unit
     # pivots — don't let a thresh > 1 count them as tiny
@@ -174,6 +174,11 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
         pool_sharding = pool_spec(mesh, pool_partition)
         replicated = NamedSharding(mesh, P(None, None))
     arrays = [_group_arrays(grp) for grp in plan.groups]
+    # SLU_TPU_PIVOT_KERNEL resolved HERE, in the uncached factory, and
+    # closed over as a constant — get_executor keys the fused executor on
+    # it, and the traced body must not read env (slulint SLU102/SLU105)
+    from superlu_dist_tpu.ops.dense import pivot_kernel
+    pivot = pivot_kernel()
 
     def fn(avals, thresh):
         avals = avals.astype(dtype)
@@ -188,7 +193,7 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
                 (grp.batch, grp.m, grp.w, grp.u), avals, pool, thresh,
                 a_slot, a_flat, a_src, ws, off, children,
                 front_sharding=sharding, pivot_sharding=pivot_sharding,
-                replicated=replicated)
+                replicated=replicated, pivot=pivot)
             if mesh is not None:
                 pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
             fronts.append(packed)

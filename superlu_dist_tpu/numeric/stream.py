@@ -46,6 +46,40 @@ from superlu_dist_tpu.utils.options import env_flag, env_float, env_int
 # Env SLU_TPU_OFFLOAD_LAG (default 8), latched per StreamExecutor.
 
 
+class RetraceSentinel:
+    """Runtime recompile watchdog — the dynamic counterpart of slulint's
+    SLU105 cache-key rule (part of the SLU106 runtime tier).
+
+    The streamed executor's compile count is bounded by distinct shape
+    keys, all built on the FIRST call; a warmed executor re-running the
+    same plan must build ZERO new kernels.  Any rebuild after warmup
+    means a cache-key input changed mid-run — an env knob
+    (SLU_TPU_PIVOT_KERNEL), a mesh identity, a dtype — which is exactly
+    the silent recompile axis SLU105 polices statically.  Rebuilds are
+    counted process-wide, reported to stderr, surfaced as a `verify`
+    trace span, and accumulated into Stats.retraces by the driver
+    (drivers/gssvx.factorize_numeric)."""
+
+    def __init__(self):
+        self.total = 0            # unexpected rebuilds, process-wide
+        self.events = []          # (factory, builds), bounded window
+
+    def record(self, factory: str, builds: int, tracer=None) -> None:
+        self.total += builds
+        self.events = (self.events + [(factory, int(builds))])[-32:]
+        print(f"[SLU106] retrace sentinel: {builds} unexpected jit kernel "
+              f"build(s) in {factory} after warmup — a cache-key input "
+              "(env knob, mesh identity, dtype) changed mid-run; a warmed "
+              "executor expects 0 recompiles", file=sys.stderr, flush=True)
+        if tracer is not None and tracer.enabled:
+            tracer.complete("retrace-sentinel", "verify",
+                            time.perf_counter(), 0.0,
+                            factory=factory, builds=int(builds))
+
+
+RETRACE_SENTINEL = RetraceSentinel()
+
+
 def _bucket_len(n: int, lo: int = 8, base: float = 2.0) -> int:
     """Next power of `base` (min lo) — pads arrays so shapes repeat.
     base=4 for index arrays whose padding costs only a cheap gather:
@@ -92,7 +126,7 @@ def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh,
                                      a_slot, a_flat, a_src, ws, off, children,
                                      front_sharding=front_sharding,
                                      pivot_sharding=pivot_sharding,
-                                     replicated=replicated)
+                                     replicated=replicated, pivot=pivot)
         if pool_sharding is not None:
             pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
         return out, pool, tiny
@@ -162,6 +196,11 @@ class StreamExecutor:
         # isfinite-checked so a breakdown aborts the stream at the
         # offending supernode instead of NaN-ing the remaining levels
         self.check_finite = False
+        # retrace sentinel state (see RetraceSentinel): first call warms
+        # the kernel caches; later calls must build nothing new
+        self._warmed = False
+        self.last_kernel_builds = 0
+        self.last_retraces = 0
 
         # Host-share split (the reference's CPU/GPU work division:
         # gemm_division_cpu_gpu + the N_GEMM flops threshold,
@@ -247,8 +286,9 @@ class StreamExecutor:
         """One jitted program running every group of `level` (index maps
         are closed over — jit hoists them to constants)."""
         from superlu_dist_tpu.ops.dense import pivot_kernel
-        fn = self._level_fns.get((level, pivot_kernel()))
-        if fn is not None:
+        pivot = pivot_kernel()    # resolved OUTSIDE the traced body: the
+        fn = self._level_fns.get((level, pivot))   # choice is the cache
+        if fn is not None:                         # key (slulint SLU105)
             return fn
         from superlu_dist_tpu.numeric.factor import pool_spec
         psh = (pool_spec(self.mesh, self.pool_partition)
@@ -276,7 +316,8 @@ class StreamExecutor:
                 out, pool, t = group_step(
                     dims, avals, pool, thresh, *a, children,
                     front_sharding=front_sharding,
-                    pivot_sharding=pivot_sharding, replicated=replicated)
+                    pivot_sharding=pivot_sharding, replicated=replicated,
+                    pivot=pivot)
                 outs.append(out)
                 tiny = tiny + t
             if psh is not None:
@@ -284,7 +325,7 @@ class StreamExecutor:
             return outs, pool, tiny
 
         fn = jax.jit(run, donate_argnums=(1,))
-        self._level_fns[(level, pivot_kernel())] = fn
+        self._level_fns[(level, pivot)] = fn
         return fn
 
     def __call__(self, avals, thresh):
@@ -315,8 +356,9 @@ class StreamExecutor:
         progress = env_int("SLU_TPU_PROGRESS")
         self._progress = max(progress, 0)
         self._offload_wait = 0.0
+        builds0 = self._retrace_begin()
         if self.granularity == "level":
-            return self._call_levels(avals, pool, thresh, profile)
+            return self._call_levels(avals, pool, thresh, profile, builds0)
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
         t_issue0 = time.perf_counter()
@@ -374,7 +416,26 @@ class StreamExecutor:
         # dispatch-bound (Python + transfer overhead), not compute-bound.
         self.last_dispatch_seconds = time.perf_counter() - t_issue0
         self.last_offload_wait_seconds = self._offload_wait
+        self._retrace_end(builds0)
         return self._finalize_fronts(fronts), tiny
+
+    def _retrace_begin(self) -> int:
+        """Kernel-build counter snapshot (per granularity's cache)."""
+        if self.granularity == "level":
+            return len(self._level_fns)
+        return _kernel.cache_info().misses
+
+    def _retrace_end(self, before: int) -> None:
+        built = self._retrace_begin() - before
+        self.last_kernel_builds = built
+        self.last_retraces = 0
+        if self._warmed and built:
+            # a warmed executor re-ran the same plan and still compiled:
+            # some cache-key input changed under us (dynamic SLU105)
+            self.last_retraces = built
+            RETRACE_SENTINEL.record(f"StreamExecutor[{self.granularity}]",
+                                    built, self._tracer)
+        self._warmed = True
 
     def _trace_kernel(self, t0, dt, level, b, m, w, u, nreal, host,
                       aggregate=False, executed=None, structural=None):
@@ -482,7 +543,7 @@ class StreamExecutor:
                 for i, (lp, up) in enumerate(fronts)]
         return tuple(fronts)
 
-    def _call_levels(self, avals, pool, thresh, profile):
+    def _call_levels(self, avals, pool, thresh, profile, builds0=0):
         """Level-granularity execution: one dispatch per elimination
         level (see __init__)."""
         import itertools
@@ -547,4 +608,5 @@ class StreamExecutor:
             for (grp, (_, _, _, nreal, g_host)), (lp, up) in zip(chunk, outs):
                 self._emit_front(fronts, lp, up, nreal, g_host)
         self.last_offload_wait_seconds = self._offload_wait
+        self._retrace_end(builds0)
         return self._finalize_fronts(fronts), tiny + tiny_host

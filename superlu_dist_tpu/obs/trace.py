@@ -39,8 +39,12 @@ import os
 import threading
 import time
 
-#: Span categories (the ``cat`` field of every record).
-CATEGORIES = ("phase", "dispatch", "kernel", "comm", "host-offload")
+#: Span categories (the ``cat`` field of every record).  "verify" spans
+#: come from the runtime SLU106 tier: collective-lockstep mismatches
+#: (parallel/treecomm.LockstepVerifier) and unexpected-recompile events
+#: (numeric/stream.RetraceSentinel).
+CATEGORIES = ("phase", "dispatch", "kernel", "comm", "host-offload",
+              "verify")
 
 
 class _NullSpan:

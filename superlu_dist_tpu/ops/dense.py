@@ -267,7 +267,7 @@ def partial_front_factor(f, thresh, w):
 
 
 def group_partial_factor(fronts, thresh, w, front_sharding=None,
-                         pivot_sharding=None):
+                         pivot_sharding=None, pivot="blocked"):
     """Partial factorization of a batch of fronts with explicit shardings.
 
     Group-level formulation of partial_front_factor: the pivot-block LU is
@@ -292,8 +292,12 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
     from jax.lax import with_sharding_constraint as wsc
     m = fronts.shape[-1]
     b = fronts.shape[0]
+    # `pivot` is the caller-resolved SLU_TPU_PIVOT_KERNEL choice: this
+    # function runs inside cached jitted factories, so the env read must
+    # happen in the (uncached) factory wrapper that puts the choice in
+    # its cache key — never here at trace time (slulint SLU105)
     if (front_sharding is None and pivot_sharding is None
-            and pivot_kernel() == "blocked"):
+            and pivot == "blocked"):
         # unsharded: the compile-bounded blocked kernel (see
         # _blocked_partial_factor).  Sharded runs keep the recursive
         # path — its scatter-free masked core is what the SPMD

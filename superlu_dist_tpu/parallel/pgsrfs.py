@@ -13,7 +13,13 @@ the factor-owning root and is tree-broadcast back.
 This is the host multi-process tier of the refinement stack; on an
 accelerator the single-process DeviceSpMV path (drivers/gssvx.py) is
 used instead.  Every rank calls `pgsrfs` collectively and receives the
-full refined solution.
+full refined solution.  The per-iteration collective sequence
+(allreduce residual -> allreduce denominator -> [bcast dx]) must stay
+identical on every rank — the convergence test uses the allreduced
+berr, never per-rank values, so all ranks break the loop together;
+SLU_TPU_VERIFY_COLLECTIVES=1 (runtime SLU106, docs/ANALYSIS.md) checks
+exactly this lockstep at runtime and names divergent call sites
+instead of deadlocking.
 """
 
 from __future__ import annotations

@@ -25,6 +25,15 @@ Payloads larger than the tree domain's max_len stream through in chunks
 (TreeComm.bcast_any/reduce_sum_any); integer index arrays travel on the
 f64 mantissa (exact below 2^53 — dimensions and nnz counts are far
 below).
+
+Collective discipline: every rank must reach the same TreeComm
+collective sequence.  slulint SLU101 verifies this statically
+(interprocedurally since v2 — wrappers like bcast_result count as the
+collectives they reach), and SLU_TPU_VERIFY_COLLECTIVES=1 verifies it
+at runtime: each collective below then cross-checks a (call-site, op,
+shape/dtype, seq) digest across ranks and raises
+CollectiveMismatchError naming the divergent sites instead of
+deadlocking (docs/ANALYSIS.md, rule SLU106).
 """
 
 from __future__ import annotations

@@ -20,6 +20,7 @@ from __future__ import annotations
 import contextlib
 import ctypes
 import os
+import sys
 import time
 
 import numpy as np
@@ -27,6 +28,170 @@ import numpy as np
 from superlu_dist_tpu import native
 from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.utils.stats import CommStats
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+class LockstepVerifier:
+    """Runtime collective-lockstep verification (slulint rule SLU106).
+
+    With ``SLU_TPU_VERIFY_COLLECTIVES=1`` every public TreeComm
+    collective is preceded by a digest exchange: each rank contributes a
+    fixed-layout record of (sequence number, op kind, payload
+    shape/dtype, root, call site) into its own slot of an
+    ``n_ranks × REC`` matrix, summed over a SIBLING tree domain
+    (``<name>.vfy`` — same native transport, its own segment so digests
+    never perturb payload slots) and broadcast back.  Because the digest
+    exchange has the identical native-leg structure on every rank
+    regardless of WHICH public op the rank is entering, ranks that have
+    diverged into different collectives still complete the exchange —
+    and then every rank sees every rank's record, detects the mismatch,
+    and raises :class:`CollectiveMismatchError` naming the divergent
+    call sites, instead of hanging inside mismatched payload legs (the
+    MUST-style deadlock-to-diagnosis conversion; the reference's
+    collectives offer no such guard).
+
+    Composite ops verify ONCE at their public entry (``depth`` guards
+    the inner legs), so the digest carries the caller's intent —
+    ``allreduce_sum_any`` with the real payload shape/dtype — not the
+    transport decomposition.  Limitation: a rank that stops calling
+    collectives altogether still hangs its peers inside the digest
+    exchange (nothing to cross-check against); divergence in *what* is
+    called is what this converts into an error.
+    """
+
+    SHAPE_SLOTS = 3
+    DTYPE_CHARS = 12
+    SITE_CHARS = 48
+    REC = 5 + SHAPE_SLOTS + DTYPE_CHARS + SITE_CHARS
+
+    _OPCODES = {op: i + 1 for i, op in enumerate((
+        "bcast", "reduce", "reduce_sum", "allreduce", "allreduce_sum",
+        "bcast_any", "reduce_sum_any", "allreduce_sum_any",
+        "bcast_bytes", "bcast_obj"))}
+
+    def __init__(self, lib, name: bytes, n_ranks: int, rank: int,
+                 create: bool):
+        self._lib = lib
+        self.name = bytes(name) + b".vfy"
+        self.n_ranks = int(n_ranks)
+        self.rank = int(rank)
+        self.seq = 0
+        self.depth = 0
+        self.checks = 0
+        self._h = lib.slu_tree_attach(self.name, self.n_ranks,
+                                      self.n_ranks * self.REC, self.rank,
+                                      1 if create else 0)
+        if not self._h:
+            raise OSError(f"slu_tree_attach failed for verifier domain "
+                          f"{self.name!r}")
+        self._created = bool(create)
+
+    # ---- lifecycle -----------------------------------------------------
+    def close(self, unlink: bool | None = None):
+        if self._h:
+            if unlink is None:
+                unlink = self._created
+            self._lib.slu_tree_detach(self._h, self.name,
+                                      1 if unlink else 0)
+            self._h = None
+
+    # ---- the check -----------------------------------------------------
+    @contextlib.contextmanager
+    def guard(self, op, shape, dtype, root):
+        """Verify once at the outermost public op; inner legs (composite
+        decomposition, chunking, fault-injection retries) are exempt —
+        their structure is a deterministic function of the verified
+        public op."""
+        if self.depth == 0:
+            self.check(op, shape, dtype, root)
+        self.depth += 1
+        try:
+            yield
+        finally:
+            self.depth -= 1
+
+    def check(self, op, shape, dtype, root):
+        rec = self._encode(op, shape, dtype, root, _call_site())
+        buf = np.zeros(self.n_ranks * self.REC, dtype=np.float64)
+        buf[self.rank * self.REC:(self.rank + 1) * self.REC] = rec
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        # digest allreduce over the sibling domain: identical native-leg
+        # structure for every public op, so it completes even when the
+        # public sequences have diverged
+        self._lib.slu_tree_reduce_sum(self._h, 0, ptr, buf.size)
+        self._lib.slu_tree_bcast(self._h, 0, ptr, buf.size)
+        self.seq += 1
+        self.checks += 1
+        mat = buf.reshape(self.n_ranks, self.REC)
+        # the call-site chars are informational (SPMD peers legitimately
+        # reach the SAME collective from different source lines — owner
+        # vs worker driver code); semantic lockstep is (seq, op, root,
+        # shape, dtype)
+        sem = mat[:, :5 + self.SHAPE_SLOTS + self.DTYPE_CHARS]
+        if (sem == sem[0]).all():
+            return
+        from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+        records = [self._decode(r, mat[r]) for r in range(self.n_ranks)]
+        tr = get_tracer()
+        if tr.enabled:
+            t0 = time.perf_counter()
+            tr.complete("collective-mismatch", "verify", t0, 0.0,
+                        rank=self.rank, seq=self.seq - 1,
+                        sites=";".join(x["site"] for x in records))
+        raise CollectiveMismatchError(records, rank=self.rank)
+
+    # ---- record layout --------------------------------------------------
+    def _encode(self, op, shape, dtype, root, site):
+        rec = np.zeros(self.REC, dtype=np.float64)
+        shape = tuple(int(s) for s in tuple(shape)[:self.SHAPE_SLOTS])
+        rec[0] = self.seq
+        rec[1] = self._OPCODES.get(op, 0)
+        rec[2] = int(root)
+        rec[3] = len(shape)
+        rec[4] = float(np.prod(shape, dtype=np.float64)) if shape else 0.0
+        rec[5:5 + len(shape)] = shape
+        base = 5 + self.SHAPE_SLOTS
+        for i, ch in enumerate(str(dtype)[:self.DTYPE_CHARS]):
+            rec[base + i] = ord(ch)
+        base += self.DTYPE_CHARS
+        for i, ch in enumerate(site[-self.SITE_CHARS:]):
+            rec[base + i] = ord(ch)
+        return rec
+
+    def _decode(self, rank, rec):
+        ndim = int(rec[3])
+        base = 5 + self.SHAPE_SLOTS
+        names = {v: k for k, v in self._OPCODES.items()}
+        chars = (lambda lo, n: "".join(
+            chr(int(c)) for c in rec[lo:lo + n] if int(c) > 0))
+        return {
+            "rank": rank,
+            "seq": int(rec[0]),
+            "op": names.get(int(rec[1]), f"op#{int(rec[1])}"),
+            "root": int(rec[2]),
+            "shape": tuple(int(s) for s in
+                           rec[5:5 + min(ndim, self.SHAPE_SLOTS)]),
+            "dtype": chars(base, self.DTYPE_CHARS),
+            "site": chars(base + self.DTYPE_CHARS, self.SITE_CHARS),
+        }
+
+
+def _call_site() -> str:
+    """First stack frame outside this module (and outside contextlib —
+    the guard is a generator context manager, so its immediate caller is
+    ``contextlib.__enter__``): the caller-level source location the
+    mismatch report names, kept to the trailing two path components so
+    records fit the fixed digest slot."""
+    skip = {os.path.abspath(__file__),
+            os.path.abspath(contextlib.__file__)}
+    f = sys._getframe(1)
+    while f is not None and os.path.abspath(f.f_code.co_filename) in skip:
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    parts = f.f_code.co_filename.replace(os.sep, "/").split("/")
+    return "/".join(parts[-2:]) + f":{f.f_lineno}"
 
 
 class TreeComm:
@@ -71,6 +236,22 @@ class TreeComm:
         # caller's intent, not the transport decomposition
         self.comm_stats = CommStats()
         self._op_label = None
+        # lockstep-verify mode (runtime SLU106): OFF means NO verifier
+        # state at all — self._verifier stays None and the collective
+        # path pays one attribute test (see _verified)
+        from superlu_dist_tpu.utils.options import env_flag
+        self._verifier = None
+        if env_flag("SLU_TPU_VERIFY_COLLECTIVES"):
+            self._verifier = LockstepVerifier(lib, self.name, self.n_ranks,
+                                              self.rank, bool(create))
+
+    def _verified(self, op: str, shape, dtype, root: int):
+        """Context manager entering the lockstep check for one public
+        collective (no-op singleton when verification is off)."""
+        v = self._verifier
+        if v is None:
+            return _NULL_CTX
+        return v.guard(op, shape, str(dtype), root)
 
     def _account(self, op: str, nbytes: int, t0: float, root: int):
         """One collective leg completed: count it, and emit a comm span
@@ -96,11 +277,13 @@ class TreeComm:
         otherwise the result lives in the returned copy."""
         buf = self._prep(buf)
         op = self._op_label or "bcast"
-        t0 = time.perf_counter()
-        self._lib.slu_tree_bcast(
-            self._h, int(root),
-            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
-        self._account(op, buf.nbytes, t0, root)
+        with self._verified("bcast", buf.shape, buf.dtype, root):
+            t0 = time.perf_counter()
+            self._lib.slu_tree_bcast(
+                self._h, int(root),
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                buf.size)
+            self._account(op, buf.nbytes, t0, root)
         return buf
 
     def reduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
@@ -108,11 +291,13 @@ class TreeComm:
         on the root; see bcast for the in-place caveat)."""
         buf = self._prep(buf)
         op = self._op_label or "reduce"
-        t0 = time.perf_counter()
-        self._lib.slu_tree_reduce_sum(
-            self._h, int(root),
-            buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), buf.size)
-        self._account(op, buf.nbytes, t0, root)
+        with self._verified("reduce_sum", buf.shape, buf.dtype, root):
+            t0 = time.perf_counter()
+            self._lib.slu_tree_reduce_sum(
+                self._h, int(root),
+                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                buf.size)
+            self._account(op, buf.nbytes, t0, root)
         return buf
 
     @contextlib.contextmanager
@@ -129,9 +314,11 @@ class TreeComm:
     def allreduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
         """reduce_sum then bcast — the composite the reference builds from
         its RdTree + BcTree pair per supernode."""
-        with self._labeled("allreduce"):
-            buf = self.reduce_sum(buf, root)
-            return self.bcast(buf, root)
+        with self._verified("allreduce_sum", np.shape(buf),
+                            getattr(buf, "dtype", "float64"), root):
+            with self._labeled("allreduce"):
+                buf = self.reduce_sum(buf, root)
+                return self.bcast(buf, root)
 
     # ---- typed payload layer -------------------------------------------
     # The native segment is f64 (the reference's trees are likewise typed,
@@ -163,14 +350,21 @@ class TreeComm:
 
     def bcast_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         """Broadcast a payload of any dtype/shape (returns a new array)."""
-        return self._payload_op(arr, root, self.bcast)
+        arr = np.asarray(arr)
+        with self._verified("bcast_any", arr.shape, arr.dtype, root):
+            return self._payload_op(arr, root, self.bcast)
 
     def reduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         """Sum-reduce a payload of any dtype/shape onto root."""
-        return self._payload_op(arr, root, self.reduce_sum)
+        arr = np.asarray(arr)
+        with self._verified("reduce_sum_any", arr.shape, arr.dtype, root):
+            return self._payload_op(arr, root, self.reduce_sum)
 
     def allreduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
-        return self._payload_op(arr, root, self.allreduce_sum)
+        arr = np.asarray(arr)
+        with self._verified("allreduce_sum_any", arr.shape, arr.dtype,
+                            root):
+            return self._payload_op(arr, root, self.allreduce_sum)
 
     # ---- byte / object layer -------------------------------------------
     # The native bcast is a pure memcpy through the f64 slots, so raw
@@ -179,8 +373,11 @@ class TreeComm:
 
     def bcast_bytes(self, data: bytes | None, root: int = 0) -> bytes:
         """Broadcast a byte string from root (non-root passes None)."""
-        with self._labeled("bcast_bytes"):
-            return self._bcast_bytes(data, root)
+        # digest carries op/site/seq only: non-root ranks don't know the
+        # length yet (the inner length bcast is depth-exempt)
+        with self._verified("bcast_bytes", (), "bytes", root):
+            with self._labeled("bcast_bytes"):
+                return self._bcast_bytes(data, root)
 
     def _bcast_bytes(self, data: bytes | None, root: int = 0) -> bytes:
         if self.rank == root:
@@ -205,15 +402,18 @@ class TreeComm:
         The root gets its ORIGINAL object back (no redundant second copy
         through pickle on the rank whose memory matters most)."""
         import pickle
-        blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL) \
-            if self.rank == root else None
-        data = self.bcast_bytes(blob, root=root)
-        return obj if self.rank == root else pickle.loads(data)
+        with self._verified("bcast_obj", (), "obj", root):
+            blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL) \
+                if self.rank == root else None
+            data = self.bcast_bytes(blob, root=root)
+            return obj if self.rank == root else pickle.loads(data)
 
     def close(self, unlink: bool | None = None):
         if self._h:
             if unlink is None:
                 unlink = self._created
+            if self._verifier is not None:
+                self._verifier.close(unlink)
             self._lib.slu_tree_detach(self._h, self.name,
                                       1 if unlink else 0)
             self._h = None

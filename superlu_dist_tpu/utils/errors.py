@@ -39,3 +39,37 @@ class NumericBreakdownError(SuperLUError):
         super().__init__(
             f"non-finite values detected{stage}{loc}; the system is "
             "numerically broken down (overflow or NaN input)")
+
+
+class CollectiveMismatchError(SuperLUError):
+    """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
+    runtime rule SLU106) detected ranks entering DIFFERENT collectives:
+    the digest exchange that precedes every TreeComm collective came back
+    with divergent (call-site, op, payload shape/dtype, sequence) records.
+    Without verification this is the classic silent distributed deadlock —
+    each rank blocks forever inside a collective its peers never entered;
+    with it, every rank raises this error naming the divergent call sites
+    (the MUST-style conversion of a hang into a diagnosis).
+
+    ``records`` holds one dict per rank: {rank, seq, op, shape, dtype,
+    root, site}."""
+
+    def __init__(self, records, rank: int = -1):
+        self.records = list(records)
+        self.rank = int(rank)
+        by_site = {}
+        for r in self.records:
+            key = (r.get("site", "?"), r.get("op", "?"),
+                   tuple(r.get("shape", ())), str(r.get("dtype", "?")))
+            by_site.setdefault(key, []).append(r.get("rank"))
+        parts = []
+        for (site, op, shape, dtype), ranks in sorted(by_site.items()):
+            rs = ",".join(str(x) for x in ranks)
+            parts.append(f"rank(s) {rs}: {op}{list(shape)}:{dtype} "
+                         f"at {site}")
+        super().__init__(
+            "collective lockstep mismatch (SLU106): ranks entered "
+            "divergent collectives — " + "; ".join(parts)
+            + " — every rank must reach the same TreeComm collective "
+              "sequence (this would have deadlocked without "
+              "SLU_TPU_VERIFY_COLLECTIVES)")

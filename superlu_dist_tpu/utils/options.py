@@ -92,6 +92,11 @@ def _register_all() -> None:
     r("SLU_TPU_FAULTS", "str", "",
       "fault-injection spec for TreeComm (e.g. 'drop=0.2,seed=7')",
       group="parallel")
+    r("SLU_TPU_VERIFY_COLLECTIVES", "flag", False,
+      "TreeComm lockstep-verify mode: cross-check every collective's "
+      "(call-site, op, shape/dtype, seq) digest across ranks and raise "
+      "CollectiveMismatchError instead of deadlocking (runtime SLU106)",
+      group="parallel")
     # --- index width -------------------------------------------------------
     r("SLU_TPU_INT64", "flag", False,
       "64-bit pattern indices (reference XSDK_INDEX_SIZE=64 analog)")

@@ -133,6 +133,8 @@ class Stats:
     ops: dict = field(default_factory=lambda: {p: 0.0 for p in PHASES})
     tiny_pivots: int = 0          # reference: stat->TinyPivots (pdgstrf2.c:226)
     refine_steps: int = 0         # reference: stat->RefineSteps
+    retraces: int = 0             # unexpected jit recompiles flagged by the
+                                  # stream retrace sentinel (runtime SLU106)
     peak_memory_bytes: int = 0
     current_memory_bytes: int = 0
     for_lu_bytes: int = 0         # dQuerySpace_dist analog: packed L+U
@@ -238,6 +240,9 @@ class Stats:
                     f"    {p} flops {self.ops[p]:.6e}\tMflops {self.gflops(p) * 1e3:10.2f}")
         if self.tiny_pivots:
             lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
+        if self.retraces:
+            lines.append(f"    UNEXPECTED jit retraces: {self.retraces} "
+                         "(cache-key input changed mid-run — SLU106)")
         if self.refine_steps:
             lines.append(f"    refinement steps: {self.refine_steps}")
         if self.solve_report is not None:

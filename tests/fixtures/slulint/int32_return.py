@@ -1,0 +1,36 @@
+"""slulint v2 acceptance fixture: int32-ness flowing through returns
+and temporaries into accumulators.
+
+PR-3's lexical SLU103 only matched a 32-bit constructor written
+directly on the accumulator assignment; both shapes here keep the
+constructor out of lexical sight.  The v2 dataflow pass follows the
+taint — through ``_alloc``'s return via the call graph, and through the
+``tmp`` temporary via the forward pass.  NOT scanned by the CI gate;
+tests/test_analysis.py runs both rule tiers over this file.
+"""
+
+import numpy as np
+
+
+def _alloc(n):
+    # fine on its own: "indices-width" arrays may be 32-bit — it is the
+    # ACCUMULATOR use at the caller that overflows
+    return np.zeros(n + 1, dtype=np.int32)
+
+
+def build_indptr(counts):
+    indptr = _alloc(len(counts))        # v2 SLU103: i32 through the return
+    np.add.at(indptr, np.arange(len(counts)) + 1, counts)
+    return indptr
+
+
+def build_via_temp(n):
+    tmp = np.empty(n + 1, dtype=np.int32)
+    indptr = tmp                        # v2 SLU103: i32 through a temporary
+    return indptr
+
+
+def build_promoted(counts):
+    tmp = np.asarray(counts, dtype=np.int32)
+    indptr = np.cumsum(tmp.astype(np.int64))   # promotion clears the taint
+    return indptr

@@ -1,0 +1,33 @@
+"""slulint v2 acceptance fixture: a collective hidden behind a wrapper.
+
+``broadcast_result`` calls ``_ship`` — whose body performs the
+``bcast_any`` — from inside a rank-conditioned branch.  PR-3's lexical
+SLU101 sees no collective call in the branch and stays silent; the v2
+interprocedural rule resolves ``_ship`` through the call graph, sees it
+reaches a collective, and flags the call site.  NOT scanned by the CI
+gate (tests/ is outside the scan scope); tests/test_analysis.py runs
+both rule tiers over this file to prove the v1/v2 difference.
+"""
+
+
+def _ship(tc, x, root):
+    # fine on its own: every rank that CALLS _ship reaches the collective
+    return tc.bcast_any(x, root=root)
+
+
+def _ship_deeper(tc, x, root):
+    # two levels of indirection — reachability, not one-step lookup
+    return _ship(tc, x, root)
+
+
+def broadcast_result(tc, x, root=0):
+    if tc.rank == root:
+        x = _ship(tc, x, root)          # v2 SLU101: wrapper reaches bcast_any
+    return x
+
+
+def gather_sizes(tc, sizes, root=0):
+    r = tc.rank                          # rank taint through a temporary
+    if r != root:
+        return None                      # rank-conditioned early exit...
+    return _ship_deeper(tc, sizes, root)  # ...before a transitive collective

@@ -408,11 +408,16 @@ def test_cli_exit_codes_and_json(tmp_path):
 
 
 def test_cli_repo_tree_is_clean():
-    """The acceptance gate: the shipped tree scans clean (committed
-    baseline is empty; any finding is inline-suppressed with a
-    justification)."""
-    r = _run_cli(["superlu_dist_tpu/", "scripts/", "bench.py"])
+    """The acceptance gate: the shipped tree — package, scripts, bench
+    AND examples (the default scan scope) — scans clean under the full
+    interprocedural tier (committed baseline is empty; any finding is
+    inline-suppressed with a justification)."""
+    r = _run_cli([])        # default paths: package, scripts, bench, examples
     assert r.returncode == 0, r.stdout + r.stderr
+    # examples/ really is in the default scope: ~90 files, not the ~74
+    # of the package-only era
+    n_files = int(r.stdout.rsplit(" in ", 1)[1].split()[0])
+    assert n_files >= 90, r.stdout
     base = json.load(open(os.path.join(REPO, ".slulint-baseline.json")))
     assert base["findings"] == []
 
@@ -506,3 +511,193 @@ def test_supernode_nnz_past_int32():
     assert tri == 50_000 * 50_001 // 2
     with np.errstate(over="ignore"):
         assert int((w * u)[0]) != 2_500_000_000   # int32 product wraps
+
+
+# --------------------------------------------------------------------------
+# v2: interprocedural dataflow tier (callgraph.py + dataflow.py)
+# --------------------------------------------------------------------------
+
+FIXDIR = os.path.join(REPO, "tests", "fixtures", "slulint")
+
+
+def _lexical_rules():
+    """The PR-3 tier: same rules with the interprocedural pass off."""
+    from superlu_dist_tpu.analysis.rules_collective import CollectiveRule
+    from superlu_dist_tpu.analysis.rules_index import IndexWidthRule
+    from superlu_dist_tpu.analysis.rules_trace import JitCacheKeyRule
+    return [CollectiveRule(interprocedural=False),
+            IndexWidthRule(interprocedural=False),
+            JitCacheKeyRule(interprocedural=False)]
+
+
+def test_slu101_interprocedural_fixture_lexical_v1_misses():
+    """Acceptance: the committed wrapper-indirected-collective fixture is
+    flagged by v2 and provably missed by the PR-3 lexical tier."""
+    from superlu_dist_tpu.analysis import analyze_paths
+    path = os.path.join(FIXDIR, "wrapped_collective.py")
+    v2 = analyze_paths([path])
+    assert [f.rule for f in v2] == ["SLU101", "SLU101"]
+    msgs = " ".join(f.message for f in v2)
+    assert "reaches collective" in msgs and "bcast_any" in msgs
+    assert "rank-dependent control flow" in msgs
+    assert "early exit" in msgs          # via the rank-tainted temporary
+    v1 = analyze_paths([path], _lexical_rules())
+    assert v1 == []
+
+
+def test_slu103_interprocedural_fixture_lexical_v1_misses():
+    from superlu_dist_tpu.analysis import analyze_paths
+    path = os.path.join(FIXDIR, "int32_return.py")
+    v2 = analyze_paths([path])
+    assert [f.rule for f in v2] == ["SLU103", "SLU103"]
+    msgs = " ".join(f.message for f in v2)
+    assert "return of" in msgs            # i32 through _alloc's return
+    assert "int32-typed value" in msgs
+    # build_promoted's .astype(np.int64) cleared the taint
+    assert all("cumsum" not in f.message for f in v2)
+    v1 = analyze_paths([path], _lexical_rules())
+    assert v1 == []
+
+
+SLU101_RANK_TEMP = """
+def solve(tc, x, root):
+    r = tc.rank
+    if r == root:
+        x = tc.bcast_any(x, root=root)
+    return x
+"""
+
+SLU101_RANK_PREDICATE = """
+def is_root(tc):
+    return tc.rank == 0
+
+def ship(tc, x):
+    if is_root(tc):
+        x = tc.bcast_any(x)
+    return x
+"""
+
+
+def test_slu101_rank_taint_through_temporary():
+    fs = run_rules(SLU101_RANK_TEMP)
+    assert [f.rule for f in fs] == ["SLU101"]
+    assert analyze_source(SLU101_RANK_TEMP, "fixture.py",
+                          _lexical_rules()) == []
+
+
+def test_slu101_rank_taint_through_predicate_function():
+    fs = run_rules(SLU101_RANK_PREDICATE)
+    assert [f.rule for f in fs] == ["SLU101"]
+    assert analyze_source(SLU101_RANK_PREDICATE, "fixture.py",
+                          _lexical_rules()) == []
+
+
+SLU105_ENV_HELPER = """
+import functools
+import os
+import jax
+
+def _resolve():
+    return os.environ.get("SLU_TPU_PRECISION", "highest")
+
+@functools.lru_cache(maxsize=None)
+def make_kernel(m):
+    passes = _resolve()
+    def kern(x):
+        return x * len(passes)
+    return jax.jit(kern)
+"""
+
+SLU105_LATCHED = """
+import functools
+import os
+import jax
+
+@functools.lru_cache(maxsize=None)
+def _precision():
+    return os.environ.get("SLU_TPU_PRECISION", "highest")
+
+@functools.lru_cache(maxsize=None)
+def make_kernel(m):
+    p = _precision()
+    def kern(x):
+        return x * len(p)
+    return jax.jit(kern)
+"""
+
+
+def test_slu105_env_through_helper_call():
+    fs = run_rules(SLU105_ENV_HELPER)
+    assert [f.rule for f in fs] == ["SLU105"]
+    assert "reaches an env read" in fs[0].message
+    assert analyze_source(SLU105_ENV_HELPER, "fixture.py",
+                          _lexical_rules()) == []
+
+
+def test_slu105_latched_constant_exempt():
+    """A zero-arg lru_cached env reader is a read-once process constant
+    (ops/dense._precision): baking it in without a key is sound."""
+    assert rule_ids(SLU105_LATCHED) == []
+
+
+def test_callgraph_resolves_methods_and_returns():
+    from superlu_dist_tpu.analysis.callgraph import (build_project,
+                                                     module_name_for_path)
+    assert module_name_for_path(
+        os.path.join("superlu_dist_tpu", "numeric", "stream.py")) \
+        == "superlu_dist_tpu.numeric.stream"
+    src = """
+class Comm:
+    def leg(self):
+        return 1
+    def composite(self):
+        return self.leg()
+
+def make():
+    return Comm()
+
+def use(c: Comm):
+    c.composite()
+
+def use_factory():
+    c = make()
+    c.leg()
+"""
+    proj = build_project({"m.py": src})
+    fns = proj.functions
+    assert "m.Comm.composite" in fns
+    assert fns["m.Comm.composite"].calls == ["m.Comm.leg"]
+    assert fns["m.use"].calls == ["m.Comm.composite"]     # annotation
+    assert "m.Comm.leg" in fns["m.use_factory"].calls     # return class
+
+
+def test_update_baseline_prunes_stale_entries(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(SLU103_CUMSUM)
+    bp = str(tmp_path / "b.json")
+    r = _run_cli([str(mod), "--baseline", bp, "--write-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert json.load(open(bp))["findings"]
+    # fix the finding; --update-baseline prunes it and reports the drift
+    mod.write_text(SLU103_CLEAN)
+    r = _run_cli([str(mod), "--baseline", bp, "--update-baseline"])
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "stale pruned" in r.stdout
+    assert json.load(open(bp))["findings"] == []
+    # NEW findings are never added by --update-baseline (that is
+    # --write-baseline's deliberate act)
+    mod.write_text(SLU103_CUMSUM)
+    r = _run_cli([str(mod), "--baseline", bp, "--update-baseline"])
+    assert r.returncode == 0
+    assert "NEW finding" in r.stdout
+    assert json.load(open(bp))["findings"] == []
+
+
+def test_no_dataflow_flag_restores_v1():
+    """--no-dataflow measures what the interprocedural tier adds."""
+    path = os.path.join("tests", "fixtures", "slulint",
+                        "wrapped_collective.py")
+    r = _run_cli([path, "--no-baseline"])
+    assert r.returncode == 1, r.stdout
+    r = _run_cli([path, "--no-baseline", "--no-dataflow"])
+    assert r.returncode == 0, r.stdout

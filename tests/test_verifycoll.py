@@ -1,0 +1,259 @@
+"""Runtime SLU106 tests: TreeComm collective-lockstep verification
+(SLU_TPU_VERIFY_COLLECTIVES=1) and the stream-executor retrace sentinel.
+
+The 2-rank steering test is the acceptance case: two ranks driven into
+DIVERGENT collective sequences must both raise CollectiveMismatchError
+naming both call sites, instead of deadlocking in the shared-memory
+tree.  The off-path tests pin the zero-overhead contract: with the knob
+unset the collective path allocates no verifier state at all.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+
+pytestmark = pytest.mark.verifycoll
+
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native library unavailable")
+
+
+# ---------------------------------------------------------------------------
+# disabled path: no verifier state
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_verify_off_allocates_no_verifier_state(monkeypatch):
+    monkeypatch.delenv("SLU_TPU_VERIFY_COLLECTIVES", raising=False)
+    from superlu_dist_tpu.parallel import treecomm
+    name = f"/slu_vc_off_{os.getpid()}"
+    with treecomm.TreeComm(name, 1, 0, max_len=16, create=True) as tc:
+        assert tc._verifier is None
+        # the guard is the reused no-op singleton — nothing allocated
+        assert tc._verified("bcast", (4,), "float64", 0) \
+            is treecomm._NULL_CTX
+        b = np.arange(4.0)
+        tc.bcast(b)
+        tc.allreduce_sum(b)
+        assert tc._verifier is None
+
+
+@needs_native
+def test_verify_on_counts_checks_and_round_trips(monkeypatch):
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    name = f"/slu_vc_on_{os.getpid()}"
+    with TreeComm(name, 1, 0, max_len=32, create=True) as tc:
+        assert tc._verifier is not None
+        payload = np.arange(40.0).reshape(5, 8)
+        got = tc.bcast_any(payload.copy())
+        np.testing.assert_array_equal(got, payload)
+        got = tc.allreduce_sum_any(payload.copy())
+        np.testing.assert_array_equal(got, payload)
+        blob = b"\x00\xffverify" * 11
+        assert tc.bcast_bytes(blob) == blob
+        assert tc.bcast_obj({"k": 3})["k"] == 3
+        # one check per PUBLIC op — composites/chunks verify once
+        assert tc._verifier.checks == 4
+        assert tc._verifier.seq == 4
+
+
+# ---------------------------------------------------------------------------
+# 2-rank steering: divergence -> structured error naming both sites
+# ---------------------------------------------------------------------------
+
+def _divergent_worker(name, q):
+    # import inside the child: must not inherit initialized JAX state
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+    tc = TreeComm(name, 2, 1, max_len=64, create=False)
+    try:
+        x = np.ones(8)
+        tc.allreduce_sum_any(x)                  # matched prologue
+        tc.reduce_sum_any(x)                     # DIVERGES from the owner
+        q.put(("no-error", None))
+    except CollectiveMismatchError as exc:
+        q.put(("mismatch", (str(exc), exc.records)))
+    finally:
+        tc.close()
+
+
+@needs_native
+def test_two_rank_divergence_raises_naming_both_sites(monkeypatch):
+    """Acceptance: ranks steered into divergent collective sequences get
+    a CollectiveMismatchError citing BOTH call sites — the would-be
+    deadlock becomes a diagnosis on every rank."""
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+    name = f"/slu_vc_div_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_divergent_worker, args=(name, q))
+    p.start()
+    try:
+        x = np.ones(8)
+        owner.allreduce_sum_any(x)               # matched prologue
+        with pytest.raises(CollectiveMismatchError) as ei:
+            owner.bcast_any(x)                   # diverges from the worker
+        kind, payload = q.get(timeout=60)
+        p.join(timeout=60)
+        assert kind == "mismatch", kind
+        worker_msg, worker_records = payload
+        for msg in (str(ei.value), worker_msg):
+            assert "bcast_any" in msg and "reduce_sum_any" in msg
+            assert "test_verifycoll.py" in msg
+        # both ranks reconstructed both records, with distinct call sites
+        for records in (ei.value.records, worker_records):
+            assert len(records) == 2
+            sites = {r["site"] for r in records}
+            assert len(sites) == 2
+            assert all("test_verifycoll.py:" in s for s in sites)
+            assert {r["op"] for r in records} == {"bcast_any",
+                                                  "reduce_sum_any"}
+    finally:
+        owner.close(unlink=True)
+
+
+def _shape_worker(name, q):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+    tc = TreeComm(name, 2, 1, max_len=64, create=False)
+    try:
+        tc.bcast_any(np.ones((4,)))              # same op, WRONG shape
+        q.put(("no-error", None))
+    except CollectiveMismatchError as exc:
+        q.put(("mismatch", str(exc)))
+    finally:
+        tc.close()
+
+
+@needs_native
+def test_two_rank_shape_mismatch_detected(monkeypatch):
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+    name = f"/slu_vc_shape_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_shape_worker, args=(name, q))
+    p.start()
+    try:
+        with pytest.raises(CollectiveMismatchError):
+            owner.bcast_any(np.ones((8,)))
+        kind, msg = q.get(timeout=60)
+        p.join(timeout=60)
+        assert kind == "mismatch"
+        assert "[8]" in msg and "[4]" in msg
+    finally:
+        owner.close(unlink=True)
+
+
+def _matched_worker(name, q):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    tc = TreeComm(name, 2, 1, max_len=64, create=False)
+    try:
+        x = np.full(8, 2.0)
+        s = tc.allreduce_sum_any(x)
+        tc.bcast_any(np.zeros(3))
+        got = tc.bcast_obj(None, root=0)
+        q.put((float(s[0]), got["tag"], tc._verifier.checks))
+    finally:
+        tc.close()
+
+
+@needs_native
+def test_two_rank_matched_sequence_passes(monkeypatch):
+    """Verification must be invisible on correct programs: a matched
+    sequence (reached from DIFFERENT source lines on each rank) passes
+    and payloads stay bit-exact."""
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    name = f"/slu_vc_ok_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_matched_worker, args=(name, q))
+    p.start()
+    try:
+        s = owner.allreduce_sum_any(np.full(8, 2.0))
+        owner.bcast_any(np.zeros(3))
+        owner.bcast_obj({"tag": "ok"}, root=0)
+        w_sum, w_tag, w_checks = q.get(timeout=60)
+        p.join(timeout=60)
+        assert float(s[0]) == 4.0 and w_sum == 4.0
+        assert w_tag == "ok"
+        assert owner._verifier.checks == 3 and w_checks == 3
+    finally:
+        owner.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# retrace sentinel (the dynamic SLU105 counterpart; no native needed)
+# ---------------------------------------------------------------------------
+
+def _small_executor():
+    import jax.numpy as jnp
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.drivers.gssvx import analyze
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    from superlu_dist_tpu.utils.stats import Stats
+    a = poisson2d(10)
+    lu, bvals, _ = analyze(slu.Options(), a, stats=Stats())
+    ex = StreamExecutor(lu.plan, "float32")
+    return ex, jnp.asarray(bvals), jnp.asarray(0.0, jnp.float32)
+
+
+def test_retrace_sentinel_quiet_on_warm_rerun(monkeypatch):
+    monkeypatch.delenv("SLU_TPU_PIVOT_KERNEL", raising=False)
+    ex, avals, thresh = _small_executor()
+    ex(avals, thresh)
+    assert ex.last_kernel_builds >= 1        # cold compiles are expected
+    assert ex.last_retraces == 0
+    ex(avals, thresh)
+    assert ex.last_kernel_builds == 0        # warmed: nothing rebuilt
+    assert ex.last_retraces == 0
+
+
+def test_retrace_sentinel_flags_real_recompile(monkeypatch, capsys):
+    """Provoke a REAL recompile: flip SLU_TPU_PIVOT_KERNEL between two
+    calls of a warmed executor — every shape key changes, jit compiles
+    fresh kernels, and the sentinel flags exactly that."""
+    from superlu_dist_tpu.numeric.stream import RETRACE_SENTINEL
+    from superlu_dist_tpu.obs import trace
+    monkeypatch.delenv("SLU_TPU_PIVOT_KERNEL", raising=False)
+    ex, avals, thresh = _small_executor()
+    ex(avals, thresh)
+    total0 = RETRACE_SENTINEL.total
+    tracer = trace.Tracer(os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), f"retrace_{os.getpid()}.json"))
+    prev = trace.install(tracer)
+    try:
+        monkeypatch.setenv("SLU_TPU_PIVOT_KERNEL", "recursive")
+        ex(avals, thresh)
+    finally:
+        trace.install(prev)
+        tracer.close()
+    assert ex.last_retraces >= 1
+    assert RETRACE_SENTINEL.total == total0 + ex.last_retraces
+    assert ("retrace sentinel" in capsys.readouterr().err)
+    # surfaced as a `verify` trace span
+    spans = [e for e in tracer._events if e["cat"] == "verify"]
+    assert spans and spans[0]["name"] == "retrace-sentinel"
+    assert spans[0]["args"]["builds"] == ex.last_retraces
+
+
+def test_retraces_reported_in_stats():
+    from superlu_dist_tpu.utils.stats import Stats
+    s = Stats()
+    s.retraces = 3
+    s.utime["FACT"] = 1.0
+    assert "UNEXPECTED jit retraces: 3" in s.report()
+    assert "retraces" not in Stats().report().lower()  # quiet when clean
