@@ -214,7 +214,9 @@ def main():
               "BENCH_MAXSUPER", "BENCH_RELAX", "BENCH_MINBUCKET",
               "BENCH_GROWTH", "BENCH_AMALG", "BENCH_MATRIX",
               "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
-              "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV")
+              "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV",
+              "SLU_TPU_SCHEDULE", "SLU_TPU_SCHED_WINDOW",
+              "SLU_TPU_SCHED_ALIGN")
     # BENCH_NX=48 is exactly the default size, so an explicit "48" (the
     # hardware session's nx48_default config) still counts as the default
     # kernel set — its successful run must warm the default marker
@@ -318,9 +320,18 @@ def main():
     n = a.n_rows
     RESULT["metric"] = f"lu_factor_gflops_{MATRIX}_n{n}_{DTYPE}"
     RESULT["flops"] = plan.flops
-    RESULT["n_groups"] = len(plan.groups)
-    _log(f"prepared n={n} groups={len(plan.groups)} "
-         f"flops={plan.flops / 1e9:.0f} GF")
+    # dispatch-schedule telemetry (numeric/plan.py): scheduler name,
+    # group count before/after dataflow aggregation, mean fronts per
+    # dispatch and the dependent-group critical path
+    sched = plan.schedule_stats()
+    RESULT["schedule"] = sched["schedule"]
+    RESULT["n_groups"] = sched["n_groups"]
+    RESULT["n_level_groups"] = sched["n_level_groups"]
+    RESULT["occupancy"] = sched["occupancy"]
+    RESULT["critical_path"] = sched["critical_path"]
+    _log(f"prepared n={n} schedule={sched['schedule']} "
+         f"groups={sched['n_groups']} (level {sched['n_level_groups']}) "
+         f"occupancy={sched['occupancy']} flops={plan.flops / 1e9:.0f} GF")
 
     tracer.complete("prepare", "phase", t_phase,
                     time.perf_counter() - t_phase, n=n,

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Schedule-equivalence gate: SLU_TPU_SCHEDULE=level vs dataflow must
+produce BITWISE-identical L/U.
+
+The dataflow scheduler (numeric/plan.py) may only change WHEN a front
+is factored — batch membership, dispatch count, pool layout — never the
+arithmetic within a front.  This gate factors the same analyzed
+structures under both schedules (both executors for the main case) and
+compares every supernode's real L/U sub-blocks with np.array_equal (no
+tolerance), then asserts the dataflow group count never exceeds the
+level partition's.
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
+entry point); a few seconds on CPU.  Gate contract (shared with
+run_slulint.sh, check_nan_guards.sh, check_trace_overhead.py and
+check_verify_overhead.py): any regression — a bitwise mismatch, a
+group-count blowup, a child failure — raises/asserts, which exits
+non-zero with the diagnostic on stderr.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _analyzed(a):
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.utils.options import Options
+
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(Options(), a, sym)
+    sf = symbolic_factorize(sym, col_order)
+    return sf, sym.data[sf.value_perm], a.norm_max()
+
+
+def _real_blocks(plan, fact, s, wr, ur):
+    g, slot = int(plan.sn_group[s]), int(plan.sn_slot[s])
+    grp = plan.groups[g]
+    lp = np.asarray(fact.fronts[g][0][slot])
+    up = np.asarray(fact.fronts[g][1][slot])
+    return (np.concatenate([lp[:wr, :wr], lp[grp.w:grp.w + ur, :wr]]),
+            up[:wr, :ur])
+
+
+def check(name, a, executors=("fused",)):
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    sf, vals, anorm = _analyzed(a)
+    widths = np.diff(sf.sn_start)
+    us = np.array([len(r) for r in sf.sn_rows])
+    plan_l = build_plan(sf, schedule="level")
+    plan_d = build_plan(sf, schedule="dataflow")
+    assert len(plan_d.groups) <= len(plan_l.groups), (
+        f"{name}: dataflow produced MORE groups "
+        f"({len(plan_d.groups)} > {len(plan_l.groups)})")
+    for ex in executors:
+        f_l = numeric_factorize(plan_l, vals, anorm, executor=ex)
+        f_d = numeric_factorize(plan_d, vals, anorm, executor=ex)
+        for s in range(sf.n_supernodes):
+            La, Ua = _real_blocks(plan_l, f_l, s, int(widths[s]),
+                                  int(us[s]))
+            Lb, Ub = _real_blocks(plan_d, f_d, s, int(widths[s]),
+                                  int(us[s]))
+            assert np.array_equal(La, Lb) and np.array_equal(Ua, Ub), (
+                f"{name}/{ex}: supernode {s} L/U differ between "
+                "level and dataflow schedules (bitwise)")
+    print(f"[schedule-equiv] {name}: OK "
+          f"(groups {len(plan_l.groups)} -> {len(plan_d.groups)}, "
+          f"{sf.n_supernodes} supernodes, executors {list(executors)})")
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from superlu_dist_tpu.models.gallery import (
+        hilbert, poisson2d, rank_deficient_arrowhead)
+
+    check("poisson2d(16)", poisson2d(16), executors=("fused", "stream"))
+    check("hilbert(48)", hilbert(48))
+    check("rank_deficient_arrowhead(40)", rank_deficient_arrowhead(40))
+    print("[schedule-equiv] all checks passed")
+
+
+if __name__ == "__main__":
+    main()

@@ -14,6 +14,9 @@
 #   verify-overhead scripts/check_verify_overhead.py  SLU106 lockstep
 #                   verifier: disabled path allocates no verifier state,
 #                   enabled path round-trips and counts checks
+#   schedule-equiv  scripts/check_schedule_equiv.py   level vs dataflow
+#                   dispatch schedules produce bitwise-identical L/U;
+#                   dataflow never exceeds the level group count
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -33,8 +36,9 @@ declare -A GATES=(
   [nan-guards]="scripts/check_nan_guards.sh"
   [trace-overhead]="python scripts/check_trace_overhead.py"
   [verify-overhead]="python scripts/check_verify_overhead.py"
+  [schedule-equiv]="python scripts/check_schedule_equiv.py"
 )
-ORDER=(slulint verify-overhead trace-overhead nan-guards)
+ORDER=(slulint verify-overhead schedule-equiv trace-overhead nan-guards)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

@@ -304,7 +304,10 @@ def analyze(options: Options, a: SparseCSR,
             plan = lu.plan
         else:
             plan = build_plan(sf, min_bucket=options.min_bucket,
-                              growth=options.bucket_growth)
+                              growth=options.bucket_growth,
+                              schedule=options.schedule,
+                              window=options.sched_window,
+                              align=options.sched_align)
         pattern_mismatch = sym.nnz != len(sf.value_perm)
         if not pattern_mismatch and reuse_symbolic:
             # nnz equality is not enough: a moved entry with equal count
@@ -373,6 +376,9 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
                 up.block_until_ready()
     stats.ops["FACT"] += plan.flops
     stats.tiny_pivots += numeric.tiny_pivots
+    # dispatch-schedule telemetry (numeric/plan.py): surfaced on the
+    # same Stats the PStatPrint-analog report prints
+    stats.sched = plan.schedule_stats()
     # retrace sentinel (runtime SLU106): unexpected recompiles during
     # THIS factorization, surfaced on the same Stats the report prints
     stats.retraces += RETRACE_SENTINEL.total - retr0

@@ -306,6 +306,14 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     dtype = jnp.dtype(dtype)
     real_dtype = jnp.dtype(dtype).type(0).real.dtype
     eps = jnp.finfo(real_dtype).eps
+    tracer = get_tracer()
+    if tracer.enabled:
+        # schedule telemetry span: what the dispatch stream below is
+        # shaped like (groups before/after aggregation, occupancy,
+        # padding, critical path) — the same block Stats.report prints
+        import time
+        tracer.complete("schedule", "phase", time.perf_counter(), 0.0,
+                        **plan.schedule_stats())
     thresh = jnp.asarray(
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)

@@ -15,6 +15,14 @@ that let the numeric factorization run as a short sequence of XLA ops per
   factor:     batched partial LU (ops.dense)  (the pdgstrf hot loop)
   write-back: pool[off[slot]] = Schur block   (strided, device-computed)
 
+Dispatch groups are formed by an earliest-ready DATAFLOW scheduler by
+default (the reference's elimination-tree task parallelism + pipelined
+look-ahead, SRC/pdgstrf.c:624-697): ready supernodes sharing a (m, w, u)
+bucket shape pack into maximal batches across elimination levels, bounded
+by the SLU_TPU_SCHED_WINDOW look-ahead so pool liveness stays bounded.
+SLU_TPU_SCHEDULE=level restores strict level lockstep; both schedules
+produce bitwise-identical factors (docs/PERFORMANCE.md).
+
 Fronts are square (symmetrized pattern): index set = supernode columns +
 below-diagonal rows, padded to bucket sizes (W for the pivot block, M = W+U
 total).  Children's Schur blocks live in a device pool as zero-padded U×U
@@ -79,16 +87,46 @@ class FactorPlan:
     sf: SymbolicFact
     pattern_indptr: np.ndarray     # permuted symmetrized pattern (CSR)
     pattern_indices: np.ndarray
-    groups: list                   # Groups in level-ascending order
+    groups: list                   # Groups in dispatch (topological) order
     pool_size: int                 # peak live Schur-pool entries
     sn_group: np.ndarray           # (ns,) group index of each supernode
     sn_slot: np.ndarray            # (ns,) slot within its group
     flops: float
     front_bytes: int               # total padded front storage (per dtype unit)
+    schedule: str = "level"        # "level" | "dataflow" (build_plan)
+    sched_window: int = 0          # dataflow look-ahead window (levels)
+    n_level_groups: int = 0        # groups a pure level schedule yields
+    critical_path: int = 0         # longest chain of dependent groups
 
     @property
     def n_levels(self) -> int:
         return int(self.sf.sn_level.max()) + 1 if len(self.sf.sn_level) else 0
+
+    @property
+    def mean_occupancy(self) -> float:
+        """Mean real fronts per dispatch group — the batching quality the
+        dataflow scheduler optimizes (level lockstep leaves deep-tree
+        tails at occupancy ~1)."""
+        return (self.sf.n_supernodes / len(self.groups)
+                if self.groups else 0.0)
+
+    def schedule_stats(self) -> dict:
+        """Schedule telemetry block shared by Stats.report, the trace
+        span (numeric.factor.numeric_factorize) and the bench JSON row:
+        dispatch-group count before/after aggregation, mean batch
+        occupancy, shape-padding factor (executed/structural flops, batch
+        padding excluded) and the dependent-group critical-path length."""
+        from superlu_dist_tpu.symbolic.symbfact import _front_flops
+        executed = float(sum(g.batch * _front_flops(g.w, g.u)
+                             for g in self.groups))
+        return {
+            "schedule": self.schedule,
+            "n_groups": len(self.groups),
+            "n_level_groups": self.n_level_groups,
+            "occupancy": round(self.mean_occupancy, 2),
+            "padding_factor": round(executed / max(self.flops, 1.0), 4),
+            "critical_path": self.critical_path,
+        }
 
     def __getstate__(self):
         """Drop the volatile executor cache (factor.make_factor_fn hangs
@@ -125,9 +163,203 @@ def _bucket_sizes(max_needed: int, min_bucket: int, growth: float):
     return np.unique(np.array(sizes, dtype=np.int64))
 
 
+def _align_shape_keys(sn_W, sn_U, tol: float):
+    """Schedule-aware shape-key coalescing (the interleaved-batching
+    enabler, arXiv:1909.04539): greedily merge (W, U) bucket keys —
+    promoting the smaller key's members to the merged (max W, max U)
+    padding — while the merged members' executed flops stay within
+    `tol`x the ORIGINAL constituent flops (the amalgamation budget
+    discipline, symbfact.amalgamate_supernodes: chained merges never
+    compound past tol).  Fine bucket rungs (growth ~1.05 leaves
+    same-width cells 5% apart in U) otherwise scatter the supernodes
+    over so many distinct shapes that no scheduler can batch them:
+    the bench matrix at n=32768 has 83 distinct keys over 101 level
+    cells.  Runs BEFORE the schedule branch so level and dataflow see
+    identical per-supernode padding — the bitwise level/dataflow
+    equivalence rests on it (padding is NOT arithmetic-neutral: a wider
+    GEMM K retiles the real partial-sum reduction).
+
+    Returns (sn_W, sn_U) with coalesced assignments; tol <= 1 disables.
+    """
+    from superlu_dist_tpu.symbolic.symbfact import _front_flops
+    if not tol or tol <= 1.0 or len(sn_W) == 0:
+        return sn_W, sn_U
+    pairs = np.stack([sn_W, sn_U], axis=1)
+    keys, inv, cnt = np.unique(pairs, axis=0, return_inverse=True,
+                               return_counts=True)
+    k = len(keys)
+    W = keys[:, 0].astype(np.int64).copy()
+    U = keys[:, 1].astype(np.int64).copy()
+    n_mem = cnt.astype(np.int64).copy()
+    base = n_mem * _front_flops(W, U)     # original constituent flops
+    rep = np.arange(k)
+    alive = np.ones(k, dtype=bool)
+    while alive.sum() > 1:
+        ai = np.flatnonzero(alive)
+        Wm = np.maximum.outer(W[ai], W[ai])
+        Um = np.maximum.outer(U[ai], U[ai])
+        tot = n_mem[ai][:, None] + n_mem[ai][None, :]
+        ratio = tot * _front_flops(Wm, Um) / (base[ai][:, None]
+                                              + base[ai][None, :])
+        np.fill_diagonal(ratio, np.inf)
+        i, j = np.unravel_index(np.argmin(ratio), ratio.shape)
+        if ratio[i, j] > tol:
+            break
+        a, b = int(ai[i]), int(ai[j])
+        a, b = min(a, b), max(a, b)       # deterministic representative
+        W[a], U[a] = max(W[a], W[b]), max(U[a], U[b])
+        n_mem[a] += n_mem[b]
+        base[a] += base[b]
+        alive[b] = False
+        rep[b] = a
+    # path-compress representatives, then map supernodes through
+    for i in range(k):
+        r = i
+        while rep[r] != r:
+            r = rep[r]
+        rep[i] = r
+    return W[rep[inv]], U[rep[inv]]
+
+
+def _level_batches(sf: SymbolicFact, sn_W, sn_U) -> list:
+    """The classic level-lockstep partition: one batch per distinct
+    (elimination level, W, U) triple, level-ascending then shape-key
+    ascending.  Returns [(level, sns ndarray), ...] in dispatch order."""
+    ns = sf.n_supernodes
+    key_order = np.lexsort((sn_U, sn_W, sf.sn_level))
+    out = []
+    i = 0
+    while i < ns:
+        s0 = key_order[i]
+        lvl, W, U = int(sf.sn_level[s0]), int(sn_W[s0]), int(sn_U[s0])
+        j = i
+        members = []
+        while (j < ns and sf.sn_level[key_order[j]] == lvl
+               and sn_W[key_order[j]] == W and sn_U[key_order[j]] == U):
+            members.append(key_order[j])
+            j += 1
+        out.append((lvl, np.array(members, dtype=np.int64)))
+        i = j
+    return out
+
+
+def _dataflow_batches(sf: SymbolicFact, sn_W, sn_U, window: int) -> list:
+    """Earliest-ready dataflow schedule (the reference's elimination-tree
+    task parallelism + look-ahead, SRC/pdgstrf.c:624-697, recast for
+    batched dispatch; arXiv:2406.10511 medium-granularity dataflow,
+    arXiv:1909.04539 interleaved small-problem batching).
+
+    A supernode is READY once every child that extend-adds into its
+    front has been dispatched in an earlier batch (the Schur-scatter
+    dependency = the supernode etree, symbfact.dispatch_dependencies).
+    A (key, level) cell — the unit the level scheduler dispatches — is
+    CLOSED once all its members are ready.  Each step dispatches, among
+    shape keys with undispatched members at the oldest incomplete level
+    `base`, the key whose closed cells inside the look-ahead window
+    [base, base + window) hold the most members, as ONE batch (window
+    <= 0 means unbounded).  Merging whole closed cells (never a ready
+    subset of a cell) guarantees the group count is <= the level
+    partition's — eager partial dispatch would FRAGMENT cells the level
+    schedule batches together — while cross-level cells of the same key
+    collapse whenever readiness allows.  Progress is guaranteed: every
+    base-level cell is closed, so some key is always dispatchable.
+
+    window=1 degenerates to the level partition (only base-level cells
+    are eligible).  Batch membership only changes WHEN a front is
+    factored, never the arithmetic within it, so any schedule produced
+    here yields bitwise-identical L/U to the level partition
+    (tests/test_schedule.py pins this).
+
+    Returns [(wave, sns ndarray), ...]; wave = base at emission time is
+    monotonically non-decreasing, so the stream executor's
+    granularity="level" groupby stays contiguous.
+    """
+    from superlu_dist_tpu.symbolic.symbfact import dispatch_dependencies
+    ns = sf.n_supernodes
+    if ns == 0:
+        return []
+    lvl = sf.sn_level
+    par = sf.sn_parent
+    n_levels = int(lvl.max()) + 1
+    pending = dispatch_dependencies(par)    # undispatched children per sn
+    level_left = np.bincount(lvl, minlength=n_levels)
+    # per (key, level) cell: undispatched member count and the ready
+    # members; bucketing by level keeps each step O(keys * window)
+    keys = [(int(sn_W[s]), int(sn_U[s])) for s in range(ns)]
+    remaining: dict = {}
+    ready: dict = {}
+    for s in range(ns):
+        cell = remaining.setdefault(keys[s], {})
+        cell[int(lvl[s])] = cell.get(int(lvl[s]), 0) + 1
+    for s in np.flatnonzero(pending == 0):
+        s = int(s)
+        ready.setdefault(keys[s], {}).setdefault(int(lvl[s]), []).append(s)
+    out = []
+    left = ns
+    base = 0
+    while left:
+        while base < n_levels and level_left[base] == 0:
+            base += 1
+        limit = base + window if window >= 1 else n_levels
+        best_key, best_cnt = None, 0
+        for key, by_lvl in ready.items():
+            if not by_lvl.get(base):
+                continue        # keys absent at base defer and accumulate
+            cnt = sum(len(m) for l, m in by_lvl.items()
+                      if l < limit and len(m) == remaining[key][l])
+            if cnt > best_cnt or (cnt == best_cnt and key < best_key):
+                best_key, best_cnt = key, cnt
+        assert best_cnt > 0, "scheduler stalled (cyclic dependency?)"
+        by_lvl = ready[best_key]
+        members = []
+        for l in sorted(l for l, m in by_lvl.items()
+                        if l < limit and len(m) == remaining[best_key][l]):
+            members.extend(by_lvl.pop(l))
+            del remaining[best_key][l]
+        if not by_lvl:
+            del ready[best_key]
+        # slot order sorted by supernode id: batch membership is greedy
+        # but the per-front arithmetic ordering stays schedule-invariant
+        members.sort()
+        out.append((base, np.array(members, dtype=np.int64)))
+        left -= len(members)
+        for s in members:
+            level_left[lvl[s]] -= 1
+            p = int(par[s])
+            if p >= 0:
+                pending[p] -= 1
+                if pending[p] == 0:
+                    ready.setdefault(keys[p], {}).setdefault(
+                        int(lvl[p]), []).append(p)
+    return out
+
+
 def build_plan(sf: SymbolicFact, min_bucket: int = 8,
-               growth: float = 1.5) -> FactorPlan:
-    """Precompute all index maps.  Pure numpy; cost is O(nnz(A) + nnz(L))."""
+               growth: float = 1.5, schedule: str | None = None,
+               window: int | None = None,
+               align: float | None = None) -> FactorPlan:
+    """Precompute all index maps.  Pure numpy; cost is O(nnz(A) + nnz(L)).
+
+    schedule selects the dispatch-group former: "dataflow" (default via
+    SLU_TPU_SCHEDULE) packs ready supernodes into maximal same-shape
+    batches across elimination levels (_dataflow_batches); "level" keeps
+    the strict level-lockstep partition for A/B.  window is the dataflow
+    look-ahead span in levels (SLU_TPU_SCHED_WINDOW; 1 = level order,
+    0 = unbounded).  align is the shape-key coalescing flop tolerance
+    (SLU_TPU_SCHED_ALIGN; <= 1 disables), applied before the schedule
+    branch so both schedules pad every supernode identically.  Both
+    schedules produce bitwise-identical factors — only dispatch count
+    and batch occupancy differ."""
+    from superlu_dist_tpu.utils.options import env_float, env_int, env_str
+    if schedule is None:
+        schedule = env_str("SLU_TPU_SCHEDULE")
+    if schedule not in ("level", "dataflow"):
+        raise ValueError(f"SLU_TPU_SCHEDULE must be 'level' or 'dataflow', "
+                         f"got {schedule!r}")
+    if window is None:
+        window = env_int("SLU_TPU_SCHED_WINDOW")
+    if align is None:
+        align = env_float("SLU_TPU_SCHED_ALIGN")
     n = sf.n
     ns = sf.n_supernodes
     indptr, indices = sf.pattern_indptr, sf.pattern_indices
@@ -141,31 +373,28 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
     sn_W = w_sizes[np.searchsorted(w_sizes, np.maximum(widths, 1))]
     sn_U = np.where(us == 0, 0,
                     u_sizes[np.searchsorted(u_sizes, np.maximum(us, 1))])
+    sn_W, sn_U = _align_shape_keys(sn_W, sn_U, float(align))
 
-    # group supernodes by (level, W, U)
-    key_order = np.lexsort((sn_U, sn_W, sf.sn_level))
+    if schedule == "dataflow":
+        batches = _dataflow_batches(sf, sn_W, sn_U, int(window))
+        n_level_groups = len(_level_batches(sf, sn_W, sn_U))
+    else:
+        batches = _level_batches(sf, sn_W, sn_U)
+        n_level_groups = len(batches)
+
     groups: list[Group] = []
     sn_group = np.empty(ns, dtype=np.int64)
     sn_slot = np.empty(ns, dtype=np.int64)
-    i = 0
-    while i < ns:
-        s0 = key_order[i]
-        lvl, W, U = int(sf.sn_level[s0]), int(sn_W[s0]), int(sn_U[s0])
-        j = i
-        members = []
-        while (j < ns and sf.sn_level[key_order[j]] == lvl
-               and sn_W[key_order[j]] == W and sn_U[key_order[j]] == U):
-            members.append(key_order[j])
-            j += 1
-        sns = np.array(members, dtype=np.int64)
+    for lvl, sns in batches:
+        s0 = int(sns[0])
+        W, U = int(sn_W[s0]), int(sn_U[s0])
         for slot, s in enumerate(sns):
             sn_group[s] = len(groups)
             sn_slot[s] = slot
-        groups.append(Group(level=lvl, m=W + U, w=W, u=U, batch=len(sns),
-                            sns=sns, ws=widths[sns], off=None,
-                            a_slot=None, a_flat=None, a_src=None,
+        groups.append(Group(level=int(lvl), m=W + U, w=W, u=U,
+                            batch=len(sns), sns=sns, ws=widths[sns],
+                            off=None, a_slot=None, a_flat=None, a_src=None,
                             children=[]))
-        i = j
 
     # position helpers: global index x within the front of supernode s.
     # The vectorized form answers ALL (s, x) queries with one searchsorted
@@ -274,6 +503,11 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
         grp.a_slot, grp.a_flat, grp.a_src = ga_slot[g], ga_flat[g], ga_src[g]
         grp.off = np.where(us[grp.sns] > 0, sn_off[grp.sns], pool_size)
         for ub, lst in sorted(grp_children[g].items()):
+            # child-id order, not dispatch order: the scatter-add rows a
+            # parent front accumulates must be sequenced identically
+            # under every schedule or the bitwise level/dataflow
+            # equivalence guarantee breaks on ties
+            lst.sort()
             C = len(lst)
             cs = np.fromiter((c for c, _ in lst), dtype=np.int64, count=C)
             ps = np.fromiter((p for _, p in lst), dtype=np.int64, count=C)
@@ -292,7 +526,23 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
                                          child_slot=child_slot, rel=rel))
         front_bytes += grp.batch * grp.m * grp.m
 
+    # dependent-group critical path: the longest chain of groups where a
+    # later group consumes a member's child from an earlier one — the
+    # serial depth of the schedule (level lockstep: == n_levels)
+    pdepth = np.zeros(ns, dtype=np.int64)
+    critical_path = 0
+    for grp in groups:
+        d = int(pdepth[grp.sns].max(initial=0)) + 1
+        critical_path = max(critical_path, d)
+        pg = sf.sn_parent[grp.sns]
+        valid = pg >= 0
+        if valid.any():
+            np.maximum.at(pdepth, pg[valid], d)
+
     return FactorPlan(n=n, sf=sf, pattern_indptr=indptr,
                       pattern_indices=indices, groups=groups,
                       pool_size=pool_size, sn_group=sn_group, sn_slot=sn_slot,
-                      flops=sf.flops, front_bytes=front_bytes)
+                      flops=sf.flops, front_bytes=front_bytes,
+                      schedule=schedule, sched_window=int(window),
+                      n_level_groups=n_level_groups,
+                      critical_path=critical_path)

@@ -162,12 +162,15 @@ class StreamExecutor:
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
         self.pool_partition = bool(pool_partition and mesh is not None)
-        # granularity="level" traces all of one elimination level's
-        # bucket groups into ONE jitted program (they are independent —
-        # the etree task parallelism of the reference's static schedule):
-        # dispatch count drops from #groups to #levels, at the cost of
-        # per-level (mostly unique) compiles.  "group" keeps the bounded
-        # compile count of one kernel per distinct shape key.
+        # granularity="level" traces all bucket groups sharing one
+        # schedule wave (Group.level: the elimination level under
+        # SLU_TPU_SCHEDULE=level, the monotone dispatch wave under the
+        # dataflow scheduler — consecutive either way) into ONE jitted
+        # program; group_step calls thread the pool sequentially, so
+        # intra-wave dependencies the dataflow packer allows are still
+        # honored.  Dispatch count drops from #groups to #waves, at the
+        # cost of per-wave (mostly unique) compiles.  "group" keeps the
+        # bounded compile count of one kernel per distinct shape key.
         if granularity not in ("group", "level"):
             raise ValueError(f"granularity must be 'group' or 'level', "
                              f"got {granularity!r}")

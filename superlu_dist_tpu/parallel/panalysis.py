@@ -281,16 +281,17 @@ def _coarse_bisect(n, indptr, indices, vwgt, nparts):
             # disconnected: split whole components across the two rank
             # halves by weight, no separator needed
             rest = nodes[~np.isin(nodes, comp)]
+            # len(ranks) >= 2 here (singleton handled above), so both
+            # halves are non-empty; ranks[half:] is the LARGER half when
+            # the count is odd and must take the heavier component
             half = len(ranks) // 2
             wc, wr = vwgt[comp].sum(), vwgt[rest].sum()
             if wc >= wr:
-                work.append((comp, ranks[:max(half, 1)], depth, anc))
-                work.append((rest, ranks[max(half, 1):] or ranks[:1],
-                             depth, anc))
+                work.append((comp, ranks[half:], depth, anc))
+                work.append((rest, ranks[:half], depth, anc))
             else:
-                work.append((rest, ranks[:max(half, 1)], depth, anc))
-                work.append((comp, ranks[max(half, 1):] or ranks[:1],
-                             depth, anc))
+                work.append((rest, ranks[half:], depth, anc))
+                work.append((comp, ranks[:half], depth, anc))
             continue
         # pseudo-peripheral restart for a better diameter
         levels = _bfs_order(indptr, indices, nodes, int(levels[-1][0]))
@@ -732,8 +733,16 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
         sf, bvals = _assemble_root(ctx, n, P, lab, sr0, sc0, sv0,
                                    options, vdtype)
         with stats.timer("DIST"):
+            # the same scheduler as the serial analysis: per-rank plans
+            # are this one root-built skeleton broadcast to every rank,
+            # so schedule/window/align must come from the SAME options
+            # (a rank-varying env knob would desynchronize the SPMD
+            # dispatch sequence)
             plan = build_plan(sf, min_bucket=options.min_bucket,
-                              growth=options.bucket_growth)
+                              growth=options.bucket_growth,
+                              schedule=options.schedule,
+                              window=options.sched_window,
+                              align=options.sched_align)
         return LUFactorization(
             n=n, options=options, equed=equed, dr=dr, dc=dc, r1=r1,
             c1=c1, row_order=row_order, col_order=None, sf=sf,

@@ -413,7 +413,8 @@ class DeviceSolver:
                        for _, firsts, rows, ws in self._groups]
                 x, lsum = fwd(x, lsum, self.fronts, idx, self._invs)
                 return bwd(x, self.fronts, idx, self._invs)
-            # forward, levels ascending (groups are in level order)
+            # forward in dispatch order (topological: every descendant's
+            # group precedes its ancestors' under either scheduler)
             for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
                     self._groups, self.fronts, self._invs):
                 kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,

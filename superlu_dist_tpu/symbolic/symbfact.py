@@ -172,6 +172,14 @@ def build_supernodes_py(n, indptr, indices, parent, relax, max_supernode,
     root_iter = iter(relaxed_roots)
     next_root = next(root_iter, None)
     while j < n:
+        if not strict:
+            # skip roots whose subtree window we already walked past
+            # (non-postordered labels make windows overlap) BEFORE the
+            # append: advancing after it re-appended the same j and
+            # manufactured a zero-width duplicate supernode
+            while (next_root is not None
+                   and next_root - cnt[next_root] + 1 < j):
+                next_root = next(root_iter, None)
         starts.append(j)
         if next_root is not None and next_root - cnt[next_root] + 1 == j:
             j = int(next_root) + 1
@@ -181,9 +189,6 @@ def build_supernodes_py(n, indptr, indices, parent, relax, max_supernode,
                 assert (next_root is None
                         or j < next_root - cnt[next_root] + 1), \
                     "relaxed subtrees must be contiguous and disjoint"
-            elif next_root is not None and j >= next_root:
-                next_root = next(root_iter, None)
-                continue
             j += 1
     starts.append(n)
     first = np.array(starts[:-1], dtype=np.int64)
@@ -384,6 +389,24 @@ def supernode_nnz(widths, us) -> tuple:
     w = np.asarray(widths, dtype=np.int64)
     u = np.asarray(us, dtype=np.int64)
     return (int(np.sum(w * (w + 1) // 2)), int(np.sum(w * u)))
+
+
+def dispatch_dependencies(sn_parent) -> np.ndarray:
+    """Per-supernode count of direct dispatch dependencies for the
+    dataflow scheduler (numeric/plan.py): supernode s may be dispatched
+    once every child that extend-adds a Schur block into s's front has
+    been dispatched in an earlier group.  Under the multifrontal
+    structure every below-diagonal row of a child lies in an ancestor's
+    column range and the Schur scatter targets exactly the PARENT front
+    (the dscatter.c:111 analog in plan.ChildSet), so the dependency
+    graph over Schur scatter targets is precisely the supernode etree —
+    reachability beyond the parent is transitive through it.  Returns
+    the in-degree (number of children) of each supernode."""
+    sn_parent = np.asarray(sn_parent, dtype=np.int64)
+    deps = np.zeros(len(sn_parent), dtype=np.int64)
+    has_p = sn_parent >= 0
+    np.add.at(deps, sn_parent[has_p], 1)
+    return deps
 
 
 def _finish(n, perm, parent, sn_start, col_to_sn, sn_rows, sn_parent,

@@ -113,10 +113,33 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         pass
 
 
-def current_cache_dir() -> str | None:
-    """The cache dir jax is currently configured with (None if unset)."""
+def disable_compile_cache() -> None:
+    """Turn the persistent compile cache OFF (jax falls back to purely
+    in-memory compilation).  The throwaway-cache pattern
+    (__graft_entry__.dryrun_multichip) needs this when the caller had no
+    cache configured: leaving the temp directory active after its rmtree
+    would let a later same-process compile silently resurrect it and
+    write/reload XLA:CPU AOT entries — the exact entry class the
+    throwaway opted out of.  Never raises (same contract as enable)."""
     import jax
     try:
-        return jax.config.read("jax_compilation_cache_dir")
+        jax.config.update("jax_compilation_cache_dir", None)
     except Exception:
-        return None
+        pass
+
+
+def current_cache_dir() -> str | None:
+    """The cache dir jax is currently configured with (None if unset).
+
+    Read via attribute access first: on current jax, ``config.read()``
+    raises AttributeError for flags that have a context manager (this
+    one does), which silently reported None here and defeated the
+    dryrun's restore-the-caller's-cache contract."""
+    import jax
+    try:
+        return jax.config.jax_compilation_cache_dir
+    except Exception:
+        try:
+            return jax.config.read("jax_compilation_cache_dir")
+        except Exception:
+            return None

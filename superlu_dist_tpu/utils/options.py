@@ -80,6 +80,16 @@ def _register_all() -> None:
     r("SLU_TPU_HOST_FLOPS", "float", 0.0,
       "run leading levels below this flop count on the host CPU (0=off)",
       group="numeric")
+    r("SLU_TPU_SCHEDULE", "str", "dataflow",
+      "factor-group scheduler: earliest-ready dataflow batching or "
+      "strict elimination-level lockstep", group="numeric",
+      choices=("dataflow", "level"))
+    r("SLU_TPU_SCHED_WINDOW", "int", 8,
+      "dataflow look-ahead window in elimination levels (1 degenerates "
+      "to the level partition, 0 = unbounded)", group="numeric")
+    r("SLU_TPU_SCHED_ALIGN", "float", 1.1,
+      "shape-key coalescing flop tolerance for batch packing "
+      "(<= 1 disables)", group="numeric")
     r("SLU_TPU_DIAG_INV", "flag", False,
       "precompute inverted diagonal blocks (reference DiagInv)",
       group="numeric")
@@ -430,6 +440,24 @@ class Options:
                                       # size buckets (static-shape batching)
     min_bucket: int = dataclasses.field(   # smallest padded front dimension
         default_factory=lambda: _env_int("SLU_TPU_MIN_BUCKET", 8))
+    # factor-group scheduler (numeric/plan.py): "dataflow" packs ready
+    # supernodes into maximal same-shape batches across elimination
+    # levels (dispatch-count collapse); "level" is the strict
+    # level-lockstep partition kept selectable for A/B — the two produce
+    # bitwise-identical L/U (tests/test_schedule.py)
+    schedule: str = dataclasses.field(
+        default_factory=lambda: env_str("SLU_TPU_SCHEDULE"))
+    # dataflow look-ahead window in elimination levels: bounds how far
+    # past the oldest incomplete level ready work may be pulled forward,
+    # so Schur-pool liveness stays bounded (1 = level order, 0 = unbounded)
+    sched_window: int = dataclasses.field(
+        default_factory=lambda: env_int("SLU_TPU_SCHED_WINDOW"))
+    # shape-key coalescing tolerance: merged batches may execute up to
+    # this factor of their members' original padded flops (<= 1
+    # disables).  Applied before the schedule branch, so "level" and
+    # "dataflow" pad identically and stay bitwise-comparable.
+    sched_align: float = dataclasses.field(
+        default_factory=lambda: env_float("SLU_TPU_SCHED_ALIGN"))
     # shard the Schur update pool across ALL mesh devices (the n≈1M
     # memory path; only meaningful with a grid) — SLU_TPU_POOL_PARTITION=1
     pool_partition: bool = dataclasses.field(

@@ -141,6 +141,11 @@ class Stats:
     pool_bytes: int = 0           # transient Schur update pool
     solve_report: object = None   # SolveReport of the last driver solve
     comm: dict = field(default_factory=dict)   # CommStats.totals() snapshot
+    sched: dict = field(default_factory=dict)  # FactorPlan.schedule_stats()
+                                  # of the last factorization (dispatch
+                                  # groups before/after aggregation, mean
+                                  # batch occupancy, padding factor,
+                                  # critical-path length)
     _timer_depth: dict = field(default_factory=dict, repr=False,
                                compare=False)
 
@@ -238,6 +243,18 @@ class Stats:
             if self.ops.get(p, 0.0) > 0:
                 lines.append(
                     f"    {p} flops {self.ops[p]:.6e}\tMflops {self.gflops(p) * 1e3:10.2f}")
+        if self.sched:
+            # dispatch-schedule telemetry (numeric/plan.py scheduler):
+            # group count vs the level-lockstep partition, mean fronts
+            # per dispatch, executed/structural padding, serial depth
+            s = self.sched
+            lines.append(
+                f"    schedule {s.get('schedule', '?'):<9s} "
+                f"groups {s.get('n_groups', 0):4d} "
+                f"(level {s.get('n_level_groups', 0)})  "
+                f"occupancy {s.get('occupancy', 0.0):6.2f}  "
+                f"padding {s.get('padding_factor', 0.0):5.2f}x  "
+                f"critical path {s.get('critical_path', 0)}")
         if self.tiny_pivots:
             lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
         if self.retraces:

@@ -102,3 +102,44 @@ def test_machine_fingerprint_stable_and_scoped(tmp_path, monkeypatch):
     fp2 = jc.machine_fingerprint()
     monkeypatch.setattr(jc, "_FP_CACHE", None)
     assert fp2 != fp
+
+
+def test_dryrun_throwaway_cache_never_outlives_its_directory(monkeypatch,
+                                                             tmp_path):
+    """dryrun_multichip uses a deliberately throwaway compile cache; on
+    exit it must restore the caller's policy EXACTLY.  With a prior
+    cache configured, that cache comes back; with none, the cache must
+    end up DISABLED — the historical bug left the rmtree'd temp dir
+    active, so a later same-process compile silently resurrected it and
+    wrote/reloaded XLA:CPU AOT entries (ADVICE round 5)."""
+    import importlib.util
+    import os
+
+    import superlu_dist_tpu.utils.jaxcache as jc
+
+    path = os.path.join(os.path.dirname(__file__), "..",
+                        "__graft_entry__.py")
+    spec = importlib.util.spec_from_file_location("__graft_entry__", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    # the cache policy is what's under test, not the dryrun body
+    monkeypatch.setattr(mod, "_dryrun_body", lambda n: None)
+
+    prior = jc.current_cache_dir()
+    try:
+        # case 1: no prior cache -> disabled afterwards (and NOT the
+        # temp dir, which no longer exists)
+        jc.disable_compile_cache()
+        mod.dryrun_multichip(2)
+        after = jc.current_cache_dir()
+        assert not after, after
+        # case 2: a prior cache -> restored verbatim
+        mine = str(tmp_path / "prior-cache")
+        jc.enable_compile_cache(mine)
+        mod.dryrun_multichip(2)
+        assert jc.current_cache_dir() == mine
+    finally:
+        if prior:
+            jc.enable_compile_cache(prior)
+        else:
+            jc.disable_compile_cache()

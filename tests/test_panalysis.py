@@ -51,6 +51,91 @@ def test_coarse_bisect_separates():
         assert sum(sizes) + (labels < 0).sum() == n
 
 
+def test_coarse_bisect_odd_ranks_heavier_component_gets_more_ranks():
+    """Disconnected coarse graph + odd rank count: the heavier component
+    must take the LARGER rank half (ranks[half:]) — the historical slice
+    order handed it the smaller one, inverting the weight balance for
+    non-power-of-2 rank counts (ADVICE round 5)."""
+    from superlu_dist_tpu.parallel.panalysis import _coarse_bisect
+    from superlu_dist_tpu.sparse.formats import coo_to_csr
+
+    # two disconnected paths: heavy (10 vertices, contains vertex 0, so
+    # BFS from nodes[0] finds it first) and light (3 vertices)
+    heavy, light = np.arange(10), np.arange(10, 13)
+    n = 13
+    r = np.concatenate([heavy[:-1], heavy[1:], light[:-1], light[1:]])
+    c = np.concatenate([heavy[1:], heavy[:-1], light[1:], light[:-1]])
+    g = coo_to_csr(n, n, r, c, np.zeros(len(r)))
+    for nparts in (3, 5):
+        labels, _nsep, part_anc = _coarse_bisect(
+            n, g.indptr, g.indices, np.ones(n), nparts)
+        heavy_parts = {int(p) for p in labels[heavy] if p >= 0}
+        light_parts = {int(p) for p in labels[light] if p >= 0}
+        assert heavy_parts.isdisjoint(light_parts)
+        # the heavy component's rank share strictly exceeds the light's
+        assert len(heavy_parts) > len(light_parts), (
+            nparts, heavy_parts, light_parts)
+        assert set(part_anc) == set(range(nparts))
+
+
+def test_cross_part_edge_raises_collectively_on_all_ranks():
+    """The cross-part-edge invariant in _part_symbolic must fail via the
+    allreduce-flag + collective SuperLUError pattern: EVERY rank raises
+    (a bare assert would fire on a rank subset and strand the peers in
+    the gather collectives — and vanish under python -O)."""
+    import multiprocessing as _mp
+
+    from superlu_dist_tpu.parallel.panalysis import _part_symbolic
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import SuperLUError
+    from superlu_dist_tpu.utils.options import Options
+
+    n, P = 8, 2
+    # labels: vertices 0-3 -> part 0, 4-7 -> part 1; NO separator.  A
+    # direct edge (1, 5) crosses the parts — only rank 0 observes it.
+    lab = np.array([0, 0, 0, 0, 1, 1, 1, 1], dtype=np.int64)
+
+    def run(rank, q):
+        with TreeComm(name, P, rank, max_len=1 << 14,
+                      create=False) as tc:
+            if rank == 0:
+                pr = np.array([0, 1, 1], dtype=np.int64)
+                pc = np.array([1, 0, 5], dtype=np.int64)   # 1-5 crosses
+            else:
+                pr = np.array([4, 5], dtype=np.int64)
+                pc = np.array([5, 4], dtype=np.int64)
+            pv = np.ones(len(pr), dtype=np.float64)
+            try:
+                _part_symbolic(tc, n, P, lab, pr, pc, pv, Options(),
+                               np.float64)
+                q.put((rank, "no-error"))
+            except SuperLUError:
+                q.put((rank, "superlu-error"))
+
+    name = f"/slu_xedge_{os.getpid()}"
+    owner = TreeComm(name, P, 0, max_len=1 << 14, create=True)
+    try:
+        ctx = _mp.get_context("fork")
+        q = ctx.Queue()
+        proc = ctx.Process(target=run, args=(1, q))
+        proc.start()
+        try:
+            pr = np.array([0, 1, 1], dtype=np.int64)
+            pc = np.array([1, 0, 5], dtype=np.int64)
+            pv = np.ones(len(pr), dtype=np.float64)
+            with pytest.raises(SuperLUError):
+                _part_symbolic(owner, n, P, lab, pr, pc, pv, Options(),
+                               np.float64)
+        finally:
+            rank, outcome = q.get(timeout=120)
+            proc.join(timeout=60)
+        assert outcome == "superlu-error", outcome
+    finally:
+        if proc.is_alive():
+            proc.kill()
+        owner.close()
+
+
 # ---------------------------------------------------------------------------
 # unit: bordered symbolic, empty border == serial supernodal fill
 # ---------------------------------------------------------------------------

@@ -1,0 +1,359 @@
+"""Dataflow-aggregated scheduling (numeric/plan.py).
+
+The scheduler contract under test is the one the reference's
+elimination-tree pipeline rests on (SRC/pdgstrf.c:624-697): batch
+membership only changes WHEN a front is factored, never the arithmetic
+within it, so the dataflow schedule must produce bitwise-identical L/U
+to the level-lockstep schedule — on both executors — while strictly
+reducing dispatch-group count on schedules with mergeable cells.
+"""
+
+import multiprocessing as mp
+import os
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+
+pytestmark = pytest.mark.schedule
+
+
+def _analyzed(a, **symb_kw):
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.utils.options import Options
+
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(Options(), a, sym)
+    sf = symbolic_factorize(sym, col_order, **symb_kw)
+    return sf, sym.data[sf.value_perm], a.norm_max()
+
+
+def _real_blocks(plan, fact, s, wr, ur):
+    """The unpadded (real) L and U sub-blocks of supernode s: pivot-block
+    rows [0, wr), below-diagonal rows [W, W + ur) of the padded front."""
+    g, slot = int(plan.sn_group[s]), int(plan.sn_slot[s])
+    grp = plan.groups[g]
+    lp = np.asarray(fact.fronts[g][0][slot])
+    up = np.asarray(fact.fronts[g][1][slot])
+    L = np.concatenate([lp[:wr, :wr], lp[grp.w:grp.w + ur, :wr]])
+    return L, up[:wr, :ur]
+
+
+def _assert_bitwise(sf, plan_a, fact_a, plan_b, fact_b):
+    widths = np.diff(sf.sn_start)
+    us = np.array([len(r) for r in sf.sn_rows])
+    for s in range(sf.n_supernodes):
+        La, Ua = _real_blocks(plan_a, fact_a, s, int(widths[s]), int(us[s]))
+        Lb, Ub = _real_blocks(plan_b, fact_b, s, int(widths[s]), int(us[s]))
+        assert np.array_equal(La, Lb), f"L mismatch at supernode {s}"
+        assert np.array_equal(Ua, Ub), f"U mismatch at supernode {s}"
+
+
+@pytest.mark.parametrize("case", ["poisson", "hilbert", "arrowhead"])
+@pytest.mark.parametrize("executor", ["fused", "stream"])
+def test_bitwise_equivalence_level_vs_dataflow(case, executor):
+    """Same symbolic structure, level vs dataflow plans: the factored
+    L/U real blocks must match BITWISE (np.array_equal, no tolerance)
+    on both executors — the scheduler only reorders dispatch, never
+    front arithmetic.  Gallery coverage includes the ill-conditioned
+    (hilbert) and structurally singular (rank_deficient_arrowhead,
+    ReplaceTinyPivot path) cases."""
+    from superlu_dist_tpu.models.gallery import (
+        hilbert, poisson2d, rank_deficient_arrowhead)
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    a = {"poisson": lambda: poisson2d(16),
+         "hilbert": lambda: hilbert(48),
+         "arrowhead": lambda: rank_deficient_arrowhead(40)}[case]()
+    sf, vals, anorm = _analyzed(a)
+    plan_l = build_plan(sf, schedule="level")
+    plan_d = build_plan(sf, schedule="dataflow")
+    assert plan_l.schedule == "level" and plan_d.schedule == "dataflow"
+    f_l = numeric_factorize(plan_l, vals, anorm, executor=executor)
+    f_d = numeric_factorize(plan_d, vals, anorm, executor=executor)
+    assert f_l.tiny_pivots == f_d.tiny_pivots
+    _assert_bitwise(sf, plan_l, f_l, plan_d, f_d)
+
+
+def test_window_one_degenerates_to_level_partition():
+    """SLU_TPU_SCHED_WINDOW=1 restricts eligibility to the oldest
+    incomplete level, whose cells are always fully ready — the dataflow
+    partition must then equal the level partition exactly (same member
+    sets, same per-group shapes)."""
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    sf, _, _ = _analyzed(poisson2d(20))
+    plan_l = build_plan(sf, schedule="level")
+    plan_1 = build_plan(sf, schedule="dataflow", window=1)
+    part_l = {frozenset(g.sns.tolist()): (g.m, g.w, g.u)
+              for g in plan_l.groups}
+    part_1 = {frozenset(g.sns.tolist()): (g.m, g.w, g.u)
+              for g in plan_1.groups}
+    assert part_l == part_1
+    assert len(plan_1.groups) == plan_1.n_level_groups
+
+
+def _deep_tree_sf(depth=8, k_width=12):
+    """Synthetic deep-tree SymbolicFact with independent same-shape
+    roots at EVERY level — the deep-tail regime where level lockstep
+    yields singleton batches.  For l in 1..depth: a width-1 chain
+    x_{l,0}..x_{l,l-1} (shape key (8, 8)) topped by a width-`k_width`
+    root K_l (key (16, 0)).  The K_l are pairwise independent yet sit at
+    levels 1..depth, so only a cross-level scheduler can batch them."""
+    from superlu_dist_tpu.sparse.formats import coo_to_csr
+    from superlu_dist_tpu.symbolic.symbfact import _finish
+
+    sn_widths, sn_rows_first, sn_parent, sn_level = [], [], [], []
+    col = 0
+    first_cols = []       # first column of each supernode
+    for l in range(1, depth + 1):
+        chain = []
+        for j in range(l):
+            sn_widths.append(1)
+            first_cols.append(col)
+            sn_level.append(j)
+            chain.append(len(sn_widths) - 1)
+            col += 1
+        k_id = len(sn_widths)
+        sn_widths.append(k_width)
+        first_cols.append(col)
+        sn_level.append(l)
+        col += k_width
+        for j, s in enumerate(chain):
+            sn_parent.append(s + 1 if j + 1 < l else k_id)
+        sn_parent.append(-1)          # K_l is a root
+    n = col
+    ns = len(sn_widths)
+    sn_start = np.zeros(ns + 1, dtype=np.int64)
+    np.cumsum(sn_widths, out=sn_start[1:])
+    col_to_sn = np.repeat(np.arange(ns), sn_widths)
+    sn_parent = np.array(sn_parent, dtype=np.int64)
+    sn_level = np.array(sn_level, dtype=np.int64)
+    sn_rows = [np.array([sn_start[p]], dtype=np.int64) if p >= 0
+               else np.empty(0, dtype=np.int64)
+               for p in sn_parent]
+    us = np.array([len(r) for r in sn_rows], dtype=np.int64)
+    # pattern: SPD-ish diagonal plus the child->parent couplings
+    r = list(range(n))
+    c = list(range(n))
+    v = [4.0] * n
+    for s, p in enumerate(sn_parent):
+        if p >= 0:
+            i, j = int(sn_start[s]), int(sn_start[p])
+            r += [i, j]
+            c += [j, i]
+            v += [-1.0, -1.0]
+    pat = coo_to_csr(n, n, np.array(r), np.array(c),
+                     np.array(v, dtype=np.float64))
+    sf = _finish(n, np.arange(n), np.full(n, -1, dtype=np.int64), sn_start,
+                 col_to_sn, sn_rows, sn_parent, sn_level, us,
+                 pat.indptr, pat.indices, None)
+    return sf, np.asarray(pat.data), 6.0
+
+
+def test_occupancy_strictly_improves_on_deep_tree():
+    """On the synthetic deep tree the dataflow scheduler batches the
+    independent per-level roots that level lockstep dispatches one by
+    one: strictly fewer groups, strictly higher mean occupancy, and the
+    factors stay bitwise identical."""
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    sf, vals, anorm = _deep_tree_sf(depth=8)
+    plan_l = build_plan(sf, schedule="level", align=0)
+    plan_d = build_plan(sf, schedule="dataflow", window=8, align=0)
+    assert len(plan_d.groups) < len(plan_l.groups)
+    assert plan_d.mean_occupancy > plan_l.mean_occupancy
+    assert plan_d.n_level_groups == len(plan_l.groups)
+    f_l = numeric_factorize(plan_l, vals, anorm, executor="fused")
+    f_d = numeric_factorize(plan_d, vals, anorm, executor="fused")
+    _assert_bitwise(sf, plan_l, f_l, plan_d, f_d)
+
+
+def test_dataflow_never_exceeds_level_group_count():
+    """The closed-cell policy merges whole (key, level) cells only, so
+    the dataflow group count is bounded by the level partition's on any
+    structure and at any window."""
+    from superlu_dist_tpu.models.gallery import poisson2d, random_sparse
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    for a in (poisson2d(24), random_sparse(300, density=0.02, seed=3)):
+        sf, _, _ = _analyzed(a)
+        n_level = len(build_plan(sf, schedule="level").groups)
+        for w in (0, 1, 2, 4, 16):
+            plan = build_plan(sf, schedule="dataflow", window=w)
+            assert len(plan.groups) <= n_level, (w, len(plan.groups))
+
+
+def test_schedule_topological_and_telemetry():
+    """Every schedule keeps children in strictly earlier groups than
+    their parents (the pool free-list and the solve sweeps rest on it),
+    waves are monotone for the level-granularity executor, and the
+    telemetry block carries the documented fields."""
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    sf, _, _ = _analyzed(poisson2d(20))
+    for schedule in ("level", "dataflow"):
+        plan = build_plan(sf, schedule=schedule)
+        for s in range(sf.n_supernodes):
+            p = int(sf.sn_parent[s])
+            if p >= 0:
+                assert plan.sn_group[p] > plan.sn_group[s]
+        waves = [g.level for g in plan.groups]
+        assert waves == sorted(waves)
+        stats = plan.schedule_stats()
+        assert stats["schedule"] == schedule
+        assert set(stats) == {"schedule", "n_groups", "n_level_groups",
+                              "occupancy", "padding_factor",
+                              "critical_path"}
+        assert stats["critical_path"] >= 1
+        assert stats["n_groups"] == len(plan.groups)
+
+
+def test_env_knobs_drive_build_plan(monkeypatch):
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.plan import build_plan
+
+    sf, _, _ = _analyzed(poisson2d(12))
+    monkeypatch.setenv("SLU_TPU_SCHEDULE", "level")
+    assert build_plan(sf).schedule == "level"
+    monkeypatch.setenv("SLU_TPU_SCHEDULE", "dataflow")
+    monkeypatch.setenv("SLU_TPU_SCHED_WINDOW", "3")
+    plan = build_plan(sf)
+    assert plan.schedule == "dataflow" and plan.sched_window == 3
+    monkeypatch.setenv("SLU_TPU_SCHEDULE", "bogus")
+    with pytest.raises(ValueError):
+        build_plan(sf)
+
+
+def test_shape_alignment_budget():
+    """Shape-key coalescing must respect its flop budget: total executed
+    (shape-padded) flops stay within tol of the unaligned schedule's,
+    and tol<=1 disables the pass entirely."""
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.symbolic.symbfact import _front_flops
+
+    sf, _, _ = _analyzed(poisson3d(10))
+
+    def executed(plan):
+        return float(sum(g.batch * _front_flops(g.w, g.u)
+                         for g in plan.groups))
+
+    base = build_plan(sf, schedule="level", align=0)
+    for tol in (1.1, 1.3):
+        aligned = build_plan(sf, schedule="level", align=tol)
+        assert executed(aligned) <= tol * executed(base) * (1 + 1e-12)
+        assert len(aligned.groups) <= len(base.groups)
+    assert len(build_plan(sf, schedule="level", align=1.0).groups) \
+        == len(base.groups)
+
+
+def test_driver_stats_carry_schedule_block():
+    """The driver path (analyze + factorize_numeric) surfaces the
+    schedule telemetry on Stats and in the PStatPrint-analog report."""
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.models.gallery import poisson2d
+
+    a = poisson2d(12)
+    b = a.matvec(np.ones(a.n_rows))
+    x, lu, stats, info = slu.gssvx(slu.Options(), a, b)
+    assert info == 0
+    assert stats.sched["schedule"] in ("level", "dataflow")
+    assert stats.sched["n_groups"] == len(lu.plan.groups)
+    assert stats.sched["n_level_groups"] >= stats.sched["n_groups"]
+    assert "schedule" in stats.report()
+
+
+def test_schedule_trace_span(tmp_path, monkeypatch):
+    """With tracing armed, the factorization emits a schedule span
+    carrying the telemetry attributes."""
+    import json
+
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.obs import trace as trace_mod
+
+    sf, vals, anorm = _analyzed(poisson2d(10))
+    plan = build_plan(sf, schedule="dataflow")
+    path = tmp_path / "sched_trace.json"
+    monkeypatch.setenv("SLU_TPU_TRACE", str(path))
+    trace_mod._reset()
+    try:
+        numeric_factorize(plan, vals, anorm, executor="fused")
+        trace_mod.get_tracer().close()
+    finally:
+        trace_mod._reset()
+    events = json.loads(path.read_text())
+    if isinstance(events, dict):
+        events = events.get("traceEvents", [])
+    sched = [e for e in events if e.get("name") == "schedule"]
+    assert sched, "schedule span missing from trace"
+    args = sched[0].get("args", {})
+    assert args.get("schedule") == "dataflow"
+    assert args.get("n_groups") == len(plan.groups)
+    assert "occupancy" in args and "critical_path" in args
+
+
+# ---------------------------------------------------------------------------
+# 2-rank: the broadcast skeleton's schedule stays collective-clean
+# ---------------------------------------------------------------------------
+
+def _verify_worker(name, n_ranks, rank, part, b_loc, q):
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from superlu_dist_tpu.parallel.pgssvx import pgssvx
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.options import Options
+    with TreeComm(name, n_ranks, rank, max_len=2048, create=False) as tc:
+        x, info = pgssvx(tc, Options(), part, b_loc)
+        q.put((rank, info, x))
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library unavailable")
+def test_two_rank_dataflow_collective_clean(monkeypatch):
+    """A 2-rank pgssvx solve on a dataflow-scheduled plan under
+    SLU_TPU_VERIFY_COLLECTIVES=1: the lockstep verifier (runtime SLU106)
+    digests every collective across ranks, so any schedule divergence
+    between the ranks' dispatch sequences would raise
+    CollectiveMismatchError instead of finishing."""
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.parallel.dist import distribute_rows
+    from superlu_dist_tpu.parallel.pgssvx import pgssvx
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.options import Options
+
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    monkeypatch.setenv("SLU_TPU_SCHEDULE", "dataflow")
+    a = poisson2d(12)
+    n = a.n_rows
+    xtrue = np.random.default_rng(5).standard_normal(n)
+    b = a.matvec(xtrue)
+    parts = distribute_rows(a, 2)
+    b_blocks = [b[p.fst_row:p.fst_row + p.m_loc] for p in parts]
+    name = f"/slu_sched_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=2048, create=True)
+    try:
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+        proc = ctx.Process(target=_verify_worker,
+                           args=(name, 2, 1, parts[1], b_blocks[1], q))
+        proc.start()
+        x, info = pgssvx(owner, Options(), parts[0], b_blocks[0])
+        assert info == 0
+        rank, info1, x1 = q.get(timeout=300)
+        proc.join(timeout=300)
+        assert proc.exitcode == 0 and info1 == 0
+        np.testing.assert_allclose(x1, x, rtol=0, atol=1e-12)
+    finally:
+        owner.close(unlink=True)
+    resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
+    assert resid < 1e-12, resid

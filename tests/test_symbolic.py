@@ -101,3 +101,31 @@ def test_relaxation_reduces_supernode_count():
     sf1 = symbolic_factorize(s, np.arange(100), relax=1, max_supernode=64)
     sf8 = symbolic_factorize(s, np.arange(100), relax=8, max_supernode=64)
     assert sf8.n_supernodes <= sf1.n_supernodes
+
+
+def test_relaxed_overlapping_windows_no_zero_width_supernode():
+    """build_supernodes_py with strict=False and non-postordered labels:
+    relaxed-root subtree windows may OVERLAP (parent=[3,-1,3,-1] with
+    relax=3 puts root 3's window [1,3] across root 1's [1,1]).  The walk
+    must degrade overlapped windows to singleton starts — the historical
+    bug re-appended the same start after skipping a stale root, creating
+    a zero-width duplicate supernode (ADVICE round 5)."""
+    from superlu_dist_tpu.sparse.formats import coo_to_csr
+    from superlu_dist_tpu.symbolic.symbfact import build_supernodes_py
+
+    n = 4
+    parent = np.array([3, -1, 3, -1], dtype=np.int64)
+    r = np.array([0, 1, 2, 3, 0, 3, 2, 3])
+    c = np.array([0, 1, 2, 3, 3, 0, 3, 2])
+    a = coo_to_csr(n, n, r, c, np.zeros(len(r)))
+    sn_start, col_to_sn, sn_rows, sn_parent = build_supernodes_py(
+        n, a.indptr, a.indices, parent, relax=3, max_supernode=64,
+        strict=False)
+    widths = np.diff(sn_start)
+    assert np.all(widths > 0), widths
+    assert sn_start[0] == 0 and sn_start[-1] == n
+    assert len(col_to_sn) == n
+    assert np.all(np.diff(col_to_sn) >= 0)
+    # parents stay strictly ahead of children (or roots)
+    for s, p in enumerate(sn_parent):
+        assert p == -1 or p > s
