@@ -74,11 +74,46 @@ from superlu_dist_tpu.utils.options import env_float  # noqa: E402
 
 DEADLINE = env_float("BENCH_DEADLINE_S")
 
+_PHASE_T = [T0]
+
+
+def _set_phase(name: str):
+    """Advance RESULT["phase"], folding the previous phase's elapsed
+    wall time into RESULT["phase_seconds"] — so a watchdog fire reports
+    where the budget WENT, not just where the run died (the BENCH_r02
+    n=110592 lesson: 'died in factor-compile' with no breakdown)."""
+    now = time.perf_counter()
+    prev = RESULT.get("phase")
+    secs = RESULT.setdefault("phase_seconds", {})
+    if prev is not None:
+        secs[prev] = round(secs.get(prev, 0.0) + now - _PHASE_T[0], 3)
+    RESULT["phase"] = name
+    _PHASE_T[0] = now
+
 
 def _watchdog():
     time.sleep(DEADLINE)
     _log(f"watchdog fired in phase '{RESULT.get('phase')}' — emitting "
          "partial result")
+    try:
+        # fold the in-progress phase's elapsed time in, attach the
+        # compile census collected so far, and leave the flight-recorder
+        # postmortem (none of this may block the JSON line)
+        _set_phase(RESULT.get("phase"))
+        from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+        blk = COMPILE_STATS.block(top=16)
+        RESULT.setdefault("compile_seconds", blk["seconds"])
+        RESULT.setdefault("compile_census", blk["census"])
+        from superlu_dist_tpu.obs.flightrec import get_flightrec
+        fr = get_flightrec()
+        if fr.enabled:
+            p = fr.dump("bench-watchdog",
+                        detail=f"phase={RESULT.get('phase')}",
+                        extra={"phase_seconds": RESULT.get("phase_seconds"),
+                               "metric": RESULT.get("metric")})
+            _log(f"flight-recorder postmortem: {p}")
+    except Exception as e:                          # pragma: no cover
+        _log(f"watchdog telemetry failed: {type(e).__name__}: {e}")
     try:
         _emit(final=False)
     finally:
@@ -122,7 +157,7 @@ def main():
         # whether from a dead tunnel or a silent platform fallback — is
         # noise, not data; report and stop (the driver's official run
         # does NOT set this, so it still gets the fallback number)
-        RESULT["phase"] = "tpu-unreachable"
+        _set_phase("tpu-unreachable")
         _emit(final=True)
         return
     if not os.environ.get("BENCH_NO_PROBE") and probed is None:
@@ -178,13 +213,28 @@ def main():
     from superlu_dist_tpu.utils.jaxcache import enable_compile_cache
     enable_compile_cache()
 
+    # flight recorder (obs/flightrec.py): the bench flies it ALWAYS ON —
+    # a watchdog kill or mid-factor breakdown must leave a postmortem
+    # (last events, phase stack, compile census) instead of nothing (the
+    # BENCH_r02 outcome).  SLU_TPU_FLIGHTREC overrides the dump path;
+    # installed BEFORE the first get_tracer() so the tracer composition
+    # feeds the ring from every existing instrumentation site.
+    from superlu_dist_tpu.obs import flightrec
+    fr = flightrec.get_flightrec()
+    if not fr.enabled:
+        fr = flightrec.FlightRecorder(os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), ".cache",
+            "bench_flightrec_%p.json"))
+        flightrec.install(fr, arm_signals=True)
+    RESULT["flightrec"] = fr.dump_path
+
     # structured tracing (obs/trace.py): SLU_TPU_TRACE=<path> turns this
     # run into one self-describing artifact — phase spans from this
     # function, dispatch/kernel-shape spans from the executors, comm
     # spans for the host<->device transfers (docs/OBSERVABILITY.md)
     from superlu_dist_tpu.obs.trace import get_tracer
     tracer = get_tracer()
-    if tracer.enabled:
+    if tracer.enabled and tracer.path:
         RESULT["trace"] = tracer.path
 
     from superlu_dist_tpu.models.gallery import poisson3d
@@ -277,12 +327,12 @@ def main():
         # closes the BENCH_NO_PROBE hole: with the probe skipped the
         # earlier require-check can't fire, so verify the resolved
         # backend itself — a TPU-only sweep must never record a CPU row
-        RESULT["phase"] = "tpu-unreachable"
+        _set_phase("tpu-unreachable")
         _log("BENCH_REQUIRE_TPU set but the backend resolved to cpu — "
              "refusing to record a CPU row")
         _emit(final=True)
         return
-    RESULT["phase"] = "prepare"
+    _set_phase("prepare")
     t_phase = time.perf_counter()
 
     # BENCH_MATRIX=geo3d swaps in the irregular FEM-like family
@@ -336,8 +386,13 @@ def main():
     tracer.complete("prepare", "phase", t_phase,
                     time.perf_counter() - t_phase, n=n,
                     groups=len(plan.groups))
-    RESULT["phase"] = "factor-compile"
+    _set_phase("factor-compile")
     t_phase = time.perf_counter()
+    # compile census window (obs/compilestats.py): everything the warm
+    # call below builds lands in compile_seconds + the per-bucket census
+    # — the ROADMAP item 3 acceptance fields
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+    _comp0 = COMPILE_STATS.marker()
     # BENCH_GRANULARITY: "group" (one kernel per shape key, streamed),
     # "level" (one program per elimination level), or "fused" (the WHOLE
     # factorization as one XLA program — viable again now that
@@ -387,11 +442,17 @@ def main():
                         bytes=int(avals_np.nbytes + thresh_np.nbytes))
     out = ex(avals, thresh)
     jax.block_until_ready(out[0])
+    _blk = COMPILE_STATS.block(since=_comp0, top=16)
+    RESULT["compile_seconds"] = _blk["seconds"]
+    RESULT["compile_census"] = _blk["census"]
+    RESULT["compile_persistent_hits"] = _blk["persistent_hits"]
     tracer.complete("factor-compile", "phase", t_phase,
                     time.perf_counter() - t_phase,
-                    kernels=ex.n_kernels, offload=ex.offload)
+                    kernels=ex.n_kernels, offload=ex.offload,
+                    compile_seconds=_blk["seconds"])
     _log(f"warm (compile) done, kernels={ex.n_kernels}, "
-         f"offload={ex.offload}")
+         f"offload={ex.offload}, compile {_blk['seconds']:.1f}s "
+         f"({_blk['builds']} builds, {_blk['persistent_hits']} disk hits)")
     if _default_cfg and NX == 48 and backend != "cpu":
         # default NX=48 set is now in .cache/jax: future default runs
         # need not downsize (self-healing, same marker the hardware
@@ -409,7 +470,7 @@ def main():
         os.makedirs(os.path.dirname(mk), exist_ok=True)
         open(mk, "a").close()
 
-    RESULT["phase"] = "factor-time"
+    _set_phase("factor-time")
     times = []
     for rep in range(REPS):
         t0 = time.perf_counter()
@@ -449,7 +510,7 @@ def main():
     # Everything past this point (solve, residual, CPU baseline) must not
     # be able to zero the factor GFLOPS: each phase degrades independently
     # and the JSON line always prints.
-    RESULT["phase"] = "solve-residual"
+    _set_phase("solve-residual")
     t_phase = time.perf_counter()
     try:
         numeric = NumericFactorization(plan=plan, fronts=list(fronts),
@@ -495,7 +556,7 @@ def main():
 
     # Baseline: serial SuperLU (same code family as the reference) with
     # host CPU BLAS, factoring the identical matrix
-    RESULT["phase"] = "cpu-baseline"
+    _set_phase("cpu-baseline")
     t_phase = time.perf_counter()
     try:
         import scipy.sparse as sp
@@ -516,7 +577,7 @@ def main():
 
     tracer.complete("cpu-baseline", "phase", t_phase,
                     time.perf_counter() - t_phase)
-    RESULT["phase"] = "done"
+    _set_phase("done")
     # flush explicitly: the watchdog's os._exit skips atexit, so the
     # artifact must be on disk before the final line prints
     tracer.close()

@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Bench-history JSONL DB: append, list, and summarize bench rows.
+
+The bench trajectory has so far lived in loose ``BENCH_r0*.json``
+snapshots with no comparison tooling.  This script owns the append-only
+JSONL database the perf-regression gate (``check_perf_regress.py``)
+reads: one bench JSON row per line, stamped with ``recorded_unix`` and
+a derived ``history_key`` so rows are only ever compared within the
+same (metric, backend, executor, schedule, blocking) configuration.
+
+Usage:
+  scripts/bench_history.py add <row.json | ->      append one bench row
+  scripts/bench_history.py list [SUBSTR]           rows (key filter)
+  scripts/bench_history.py summary                 per-key min/median/max
+
+DB path: ``SLU_TPU_BENCH_HISTORY`` (registered knob), default
+``.cache/bench_history.jsonl`` under the repo (gitignored — the history
+is machine-local; rows from different machines are not comparable).
+Pure text processing plus the knob registry; no jax import.
+"""
+
+import json
+import os
+import statistics
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from superlu_dist_tpu.utils.options import env_str  # noqa: E402
+
+
+def history_path() -> str:
+    p = env_str("SLU_TPU_BENCH_HISTORY").strip()
+    return p or os.path.join(REPO, ".cache", "bench_history.jsonl")
+
+
+def row_key(row: dict) -> str:
+    """The comparability key: rows are baselined only against rows of
+    the same metric + backend + executor configuration."""
+    blocking = row.get("blocking")
+    return "|".join(str(x) for x in (
+        row.get("metric", "?"),
+        row.get("backend", "?"),
+        row.get("granularity", "?"),
+        row.get("schedule", "?"),
+        ",".join(str(b) for b in blocking) if blocking else "?",
+    ))
+
+
+def load_history(path: str | None = None) -> list:
+    path = path or history_path()
+    rows = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except json.JSONDecodeError:
+                    pass               # a torn tail line never kills the DB
+    except FileNotFoundError:
+        pass
+    return rows
+
+
+def append_row(row: dict, path: str | None = None, **extra) -> dict:
+    """Stamp + append one row; returns the stamped record."""
+    path = path or history_path()
+    rec = dict(row)
+    rec["recorded_unix"] = round(time.time(), 3)
+    rec["history_key"] = row_key(row)
+    rec.update(extra)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    return rec
+
+
+def _read_row(arg: str) -> dict:
+    text = sys.stdin.read() if arg == "-" else open(arg).read()
+    lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+    return json.loads(lines[-1])       # tolerate bench stderr noise above
+
+
+def main(argv) -> int:
+    if len(argv) < 1 or argv[0] not in ("add", "list", "summary"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    cmd = argv[0]
+    if cmd == "add":
+        row = _read_row(argv[1] if len(argv) > 1 else "-")
+        rec = append_row(row)
+        print(f"appended [{rec['history_key']}] value={rec.get('value')} "
+              f"-> {history_path()}")
+        return 0
+    rows = load_history()
+    if not rows:
+        print(f"no history at {history_path()!r} (seed it with "
+              "'bench_history.py add')", file=sys.stderr)
+        return 1
+    if cmd == "list":
+        sub = argv[1] if len(argv) > 1 else ""
+        for r in rows:
+            key = r.get("history_key", row_key(r))
+            if sub and sub not in key:
+                continue
+            flag = " GATE-FAIL" if r.get("gate_fail") else ""
+            print(f"{r.get('recorded_unix', 0):14.0f}  "
+                  f"{r.get('value')!s:>8}  "
+                  f"compile {r.get('compile_seconds', '?')!s:>8}  "
+                  f"[{key}]{flag}")
+        return 0
+    # summary: per-key distribution of the headline value
+    by_key: dict[str, list] = {}
+    for r in rows:
+        if r.get("value") is None or r.get("gate_fail"):
+            continue
+        by_key.setdefault(r.get("history_key", row_key(r)), []).append(
+            float(r["value"]))
+    for key in sorted(by_key):
+        vals = by_key[key]
+        print(f"{len(vals):4d} rows  min {min(vals):8.2f}  "
+              f"median {statistics.median(vals):8.2f}  "
+              f"max {max(vals):8.2f}  [{key}]")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

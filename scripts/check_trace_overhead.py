@@ -1,21 +1,28 @@
 #!/usr/bin/env python
-"""Trace-overhead smoke run (check_nan_guards.sh style).
+"""Trace/metrics/flight-recorder overhead smoke run (check_nan_guards
+style).
 
-Runs a small factor+solve twice in fresh subprocesses:
+Runs a small factor+solve in fresh subprocesses:
 
-* tracing OFF  — asserts the disabled path never allocates a Tracer
-  (the process-global stays the NULL_TRACER singleton, its span object
-  is the reused no-op) and that no artifact file appears;
+* everything OFF — asserts the disabled paths allocate NO per-event
+  telemetry objects: the process-global tracer stays the NULL_TRACER
+  singleton (reused no-op span), ``obs.metrics.get_metrics()`` stays
+  the NULL_METRICS singleton (no counter dict entries), and
+  ``obs.flightrec.get_flightrec()`` stays the NULL_FLIGHTREC singleton
+  (no ring, no signal handler, no artifact file);
 * tracing ON   — validates the artifacts: the Chrome trace JSON loads,
-  carries phase + kernel spans whose timestamps are monotone per
-  thread, the kernel spans inside each FACT phase sum to its duration
-  (within a slack factor — Python glue around tiny test kernels), and
-  the JSONL sidecar parses line by line.
+  carries phase + kernel + compile spans whose timestamps are monotone
+  per thread, the kernel spans inside each FACT phase sum to its
+  duration (within a slack factor), and the JSONL sidecar parses line
+  by line;
+* metrics + flight recorder ON — asserts the registry fills (scheduler
+  gauges from the factorization) and a provoked dump leaves a
+  well-formed postmortem (reason, anchor, events, compile census).
 
 Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
 entry point); a few seconds on CPU.  Gate contract (shared with
 run_slulint.sh, check_nan_guards.sh and check_verify_overhead.py): any
-regression — a child failure, a tracer allocated on the disabled path,
+regression — a child failure, telemetry allocated on a disabled path,
 a malformed artifact — raises/asserts, which exits non-zero.
 """
 
@@ -34,7 +41,7 @@ import json, os, sys
 import numpy as np
 import superlu_dist_tpu as slu
 from superlu_dist_tpu.models.gallery import poisson2d
-from superlu_dist_tpu.obs import trace
+from superlu_dist_tpu.obs import flightrec, metrics, trace
 
 a = poisson2d(10)
 b = np.ones(a.n_rows)
@@ -43,18 +50,32 @@ assert info == 0, info
 res = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
 assert res < 1e-8, res
 t = trace.get_tracer()
-print(json.dumps({
+m = metrics.get_metrics()
+fr = flightrec.get_flightrec()
+snap = m.snapshot()
+out = {
     "tracer": type(t).__name__,
     "null_singleton": t is trace.NULL_TRACER,
     "span_reused": t.span("a") is t.span("b"),
     "fact_seconds": stats.utime["FACT"],
-}))
+    "compile_builds": stats.compile.get("builds", 0),
+    "metrics": type(m).__name__,
+    "metrics_null": m is metrics.NULL_METRICS,
+    "metrics_series": sum(len(v) for v in snap.values()) if snap else 0,
+    "flightrec": type(fr).__name__,
+    "flightrec_null": fr is flightrec.NULL_FLIGHTREC,
+    "flightrec_ring": getattr(fr, "_ring", None) is not None,
+}
+if fr.enabled:
+    out["dump"] = fr.dump("overhead-gate", detail="on-path check")
+print(json.dumps(out))
 """
 
 
 def run_child(extra_env):
     env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
-    env.pop("SLU_TPU_TRACE", None)
+    for k in ("SLU_TPU_TRACE", "SLU_TPU_METRICS", "SLU_TPU_FLIGHTREC"):
+        env.pop(k, None)
     env.update(extra_env)
     r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
                        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
@@ -74,7 +95,7 @@ def main():
     trace_path = os.path.join(tmp, "t.json")
     jsonl_path = os.path.join(tmp, "t.jsonl")
 
-    # ---- off path: no tracer allocated, no artifact ----------------------
+    # ---- off path: no telemetry objects, no artifacts --------------------
     off = run_child({})
     if off["tracer"] != "NullTracer" or not off["null_singleton"]:
         fail(f"disabled path allocated a tracer: {off}")
@@ -82,7 +103,16 @@ def main():
         fail("disabled path did not reuse the no-op span object")
     if os.path.exists(trace_path) or os.path.exists(jsonl_path):
         fail("disabled path created a trace artifact")
-    print(f"off: null tracer, no artifact, FACT {off['fact_seconds']:.3f}s")
+    if off["metrics"] != "NullMetrics" or not off["metrics_null"]:
+        fail(f"disabled path allocated a metrics registry: {off}")
+    if off["metrics_series"] != 0:
+        fail(f"disabled path accumulated metric series: {off}")
+    if off["flightrec"] != "NullFlightRecorder" or not off["flightrec_null"]:
+        fail(f"disabled path allocated a flight recorder: {off}")
+    if off["flightrec_ring"]:
+        fail("disabled path allocated a flight-recorder ring")
+    print(f"off: null tracer/metrics/flightrec, no artifact, "
+          f"FACT {off['fact_seconds']:.3f}s")
 
     # ---- on path: artifact exists and is well-formed ---------------------
     on = run_child({"SLU_TPU_TRACE": trace_path})
@@ -131,8 +161,34 @@ def main():
             n_rows += 1
     if n_rows != len(events):
         fail(f"JSONL rows ({n_rows}) != traceEvents ({len(events)})")
+    # compile census: a fresh process builds its kernels, so the trace
+    # must carry compile spans and the Stats block must count them
+    if "compile" not in cats:
+        fail(f"no compile-census spans in a cold run: {sorted(cats)}")
+    if on["compile_builds"] < 1:
+        fail(f"stats.compile recorded no builds: {on['compile_builds']}")
+    anchors = [e for e in events if e["name"] == "clock-anchor"]
+    if len(anchors) != 1 or "unix_time" not in anchors[0].get("args", {}):
+        fail("missing/malformed wall-clock anchor event")
     print(f"on: {len(events)} spans, categories {sorted(cats)}, "
-          f"artifact + sidecar well-formed")
+          f"artifact + sidecar well-formed, "
+          f"{on['compile_builds']} censused builds")
+
+    # ---- metrics + flight recorder on: registry fills, dump well-formed --
+    fr_path = os.path.join(tmp, "fr.json")
+    live = run_child({"SLU_TPU_METRICS": "1", "SLU_TPU_FLIGHTREC": fr_path})
+    if live["metrics"] != "Metrics" or live["metrics_series"] < 1:
+        fail(f"SLU_TPU_METRICS=1 did not fill the registry: {live}")
+    if live["flightrec"] != "FlightRecorder" or live.get("dump") != fr_path:
+        fail(f"SLU_TPU_FLIGHTREC did not install/dump: {live}")
+    doc = json.load(open(fr_path))
+    for key in ("reason", "anchor", "events", "compile", "phase_stack"):
+        if key not in doc:
+            fail(f"flight dump missing {key!r}: {sorted(doc)}")
+    if not doc["events"]:
+        fail("flight dump carries no events")
+    print(f"metrics+flightrec on: {live['metrics_series']} series, "
+          f"dump with {len(doc['events'])} events")
     print("trace overhead smoke: PASS")
 
 

@@ -17,6 +17,9 @@
 #   schedule-equiv  scripts/check_schedule_equiv.py   level vs dataflow
 #                   dispatch schedules produce bitwise-identical L/U;
 #                   dataflow never exceeds the level group count
+#   perf-regress    scripts/check_perf_regress.py     micro-bench factor
+#                   GFLOP/s vs the bench-history median (noise-tolerant,
+#                   self-seeding on an empty history)
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -37,8 +40,10 @@ declare -A GATES=(
   [trace-overhead]="python scripts/check_trace_overhead.py"
   [verify-overhead]="python scripts/check_verify_overhead.py"
   [schedule-equiv]="python scripts/check_schedule_equiv.py"
+  [perf-regress]="python scripts/check_perf_regress.py"
 )
-ORDER=(slulint verify-overhead schedule-equiv trace-overhead nan-guards)
+ORDER=(slulint verify-overhead schedule-equiv trace-overhead nan-guards
+       perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Compile census report: which shape-key buckets dominate cold compile.
+
+The diagnostic ROADMAP item 3 needs before anyone attempts the bucketed
+mega-kernel: the n=110592 TPU factor died inside factor-compile
+(BENCH_r02, 119 kernels / 455 groups) with no record of which buckets
+ate the budget.  This script aggregates compile-census evidence from
+any of the artifacts the telemetry layer now produces, or measures the
+exact trace/lower/compile stage split live.
+
+Usage:
+  compile_census.py ARTIFACT [ARTIFACT ...]
+      Aggregate ``compile`` records from any mix of:
+        * obs trace artifacts (Chrome trace JSON or the JSONL sidecar,
+          SLU_TPU_TRACE) — the ``compile``-category spans;
+        * bench JSON rows — the ``compile_census`` field;
+        * flight-recorder dumps — the embedded ``compile`` block.
+  compile_census.py --live [NX]
+      Build the bench plan for a poisson3d grid of edge NX (default 8)
+      on the CPU backend and AOT-stage every distinct streamed-executor
+      shape key, timing jaxpr trace, StableHLO lowering, and XLA
+      compile SEPARATELY per bucket (the exact split the in-band census
+      approximates with first-call wall time).  CPU compile cost ranks
+      buckets the same way the TPU tunnel does, ~proportionally.
+
+Output: per-bucket ranked table (seconds, share, builds, disk hits) and
+the totals line.  Exit 1 when no census evidence is found.
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# artifact parsing
+# ---------------------------------------------------------------------------
+
+def _iter_events(text: str):
+    """Trace events from a Chrome trace JSON or JSONL sidecar, or None."""
+    text = text.strip()
+    if not text:
+        return None
+    if text.startswith("{"):
+        try:
+            doc = json.loads(text)
+        except json.JSONDecodeError:
+            doc = None
+        if isinstance(doc, dict) and isinstance(doc.get("traceEvents"),
+                                                list):
+            return doc["traceEvents"]
+        if isinstance(doc, dict):
+            return None                # handled by the dict sniffers
+    events = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            ev = json.loads(line)
+        except json.JSONDecodeError:
+            return None
+        if not isinstance(ev, dict) or "cat" not in ev:
+            return None
+        events.append(ev)
+    return events or None
+
+
+def rows_from_artifact(path: str) -> list:
+    """[{site, key, seconds, builds, persistent_hits}] from one file, []
+    when the file carries no census evidence."""
+    try:
+        text = open(path).read()
+    except OSError as e:
+        print(f"compile_census: cannot read {path!r}: {e}",
+              file=sys.stderr)
+        return []
+    # bench row / flight dump: a single JSON dict with a census block
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        doc = None
+    if isinstance(doc, dict):
+        census = doc.get("compile_census")
+        if census is None and isinstance(doc.get("compile"), dict):
+            census = doc["compile"].get("census")
+        if isinstance(census, list):
+            return [dict(site=r.get("site", "?"), key=r.get("key", "?"),
+                         seconds=float(r.get("seconds", 0.0)),
+                         builds=int(r.get("builds", r.get("n", 1))),
+                         persistent_hits=int(r.get("persistent_hits", 0)))
+                    for r in census]
+    # trace artifact: compile-category spans
+    events = _iter_events(text)
+    if events is None:
+        return []
+    rows = []
+    for ev in events:
+        if ev.get("cat") != "compile":
+            continue
+        args = ev.get("args") or {}
+        rows.append(dict(
+            site=str(ev.get("name", "?")).replace("compile ", "", 1),
+            key=str(args.get("key", "?")),
+            seconds=float(ev.get("dur", 0.0)) / 1e6,   # trace dur is us
+            builds=int(args.get("builds", 1)),
+            persistent_hits=1 if args.get("persistent_hit") else 0))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# live AOT staging
+# ---------------------------------------------------------------------------
+
+def live_rows(nx: int) -> list:
+    """AOT-stage every distinct streamed shape key of the bench plan and
+    time trace / lower / compile separately (CPU backend; double work is
+    fine offline — the in-band census never does this)."""
+    import time
+
+    import numpy as np
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from jax import ShapeDtypeStruct as Sds
+    import jax.numpy as jnp
+
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.numeric import stream
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.utils.options import Options
+
+    a = poisson3d(nx)
+    sym = symmetrize_pattern(a)
+    sf = symbolic_factorize(sym, get_perm_c(Options(), a, sym),
+                            relax=128, max_supernode=256, amalg_tol=1.05)
+    plan = build_plan(sf, min_bucket=16, growth=1.05)
+    ex = stream.StreamExecutor(plan, "float32")
+    n_avals = len(plan.pattern_indices)
+    print(f"live census: n={a.n_rows}, {len(plan.groups)} groups, "
+          f"{ex.n_kernels} distinct shape keys")
+
+    rows, seen = [], set()
+    f32 = jnp.dtype("float32")
+    i64 = jnp.dtype("int64")
+    for key, _, child_arrs, _, _ in ex._steps:
+        if key in seen:
+            continue
+        seen.add(key)
+        (b, m, w, u), la, child_shapes, pool_size, dtype = key
+        # the step signature of stream._kernel, as ShapeDtypeStructs
+        args = [Sds((n_avals,), f32), Sds((pool_size,), f32),
+                Sds((), f32),
+                Sds((la,), i64), Sds((la,), i64), Sds((la,), i64),
+                Sds((b,), i64), Sds((b,), i64)]
+        for (ub, c) in child_shapes:
+            args += [Sds((c,), i64), Sds((c,), i64), Sds((c, ub), i64)]
+        kern = stream._kernel(key[0], la, child_shapes, pool_size, dtype,
+                              None, False, "blocked")
+        t0 = time.perf_counter()
+        try:
+            traced = kern.trace(*args)       # jaxpr trace (jax >= 0.4.31)
+            t1 = time.perf_counter()
+            lowered = traced.lower()
+        except AttributeError:
+            t1 = t0                          # older jax: trace+lower fused
+            lowered = kern.lower(*args)
+        t2 = time.perf_counter()
+        lowered.compile()
+        t3 = time.perf_counter()
+        rows.append(dict(site="stream._kernel",
+                         key=f"lu b{b} m{m} w{w} u{u}",
+                         seconds=t3 - t0, builds=1, persistent_hits=0,
+                         trace_s=t1 - t0, lower_s=t2 - t1,
+                         compile_s=t3 - t2))
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def report(rows: list, staged: bool) -> int:
+    if not rows:
+        print("compile_census: no census evidence found (pass a trace "
+              "artifact, bench row, or flight dump — or use --live)",
+              file=sys.stderr)
+        return 1
+    agg: dict[tuple, dict] = {}
+    for r in rows:
+        row = agg.setdefault((r["site"], r["key"]), dict(
+            site=r["site"], key=r["key"], seconds=0.0, builds=0,
+            persistent_hits=0, trace_s=0.0, lower_s=0.0, compile_s=0.0))
+        row["seconds"] += r["seconds"]
+        row["builds"] += r.get("builds", 1)
+        row["persistent_hits"] += r.get("persistent_hits", 0)
+        for k in ("trace_s", "lower_s", "compile_s"):
+            row[k] += r.get(k, 0.0)
+    ranked = sorted(agg.values(), key=lambda row: -row["seconds"])
+    total = sum(row["seconds"] for row in ranked) or 1e-12
+    builds = sum(row["builds"] for row in ranked)
+    hits = sum(row["persistent_hits"] for row in ranked)
+    print(f"\n== compile census: {builds} builds, {total:.2f} s total, "
+          f"{hits} persistent-cache hits ==")
+    hdr = "   seconds  share  builds  hits  site                key"
+    if staged:
+        hdr += "                        trace/lower/compile"
+    print(hdr)
+    for row in ranked:
+        line = (f"  {row['seconds']:8.3f}  {100 * row['seconds'] / total:4.1f}%"
+                f"  {row['builds']:6d}  {row['persistent_hits']:4d}"
+                f"  {row['site']:<18s}  {row['key']:<24s}")
+        if staged:
+            line += (f"  {row['trace_s']:.3f}/{row['lower_s']:.3f}"
+                     f"/{row['compile_s']:.3f} s")
+        print(line)
+    top = ranked[0]
+    print(f"\ndominant bucket: {top['key']} ({top['site']}) — "
+          f"{100 * top['seconds'] / total:.1f}% of compile time")
+    return 0
+
+
+def main(argv) -> int:
+    if argv and argv[0] == "--live":
+        nx = int(argv[1]) if len(argv) > 1 else 8
+        return report(live_rows(nx), staged=True)
+    if not argv:
+        print(__doc__, file=sys.stderr)
+        return 2
+    rows = []
+    for path in argv:
+        rows.extend(rows_from_artifact(path))
+    return report(rows, staged=False)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

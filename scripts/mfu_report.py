@@ -18,9 +18,12 @@ anything else falls back to the legacy regex.  Missing or empty inputs
 produce an explicit "no trace rows found" diagnostic and exit 1 instead
 of a silently empty report.
 
-Prints: ranked result table, dispatch-vs-compute split, and the top
+Prints: ranked result table, dispatch-vs-compute split, the top
 kernel-time sinks — the "top-3 MFU thieves" evidence VERDICT r2 #9 asks
-for.  Pure text processing; safe to run anywhere.
+for — and, when the trace carries ``compile``-category spans (the
+compile census, obs/compilestats.py), a compile-time section ranking
+the shape-key buckets that dominated cold compile.  Pure text
+processing; safe to run anywhere.
 """
 
 import json
@@ -84,6 +87,30 @@ def load_trace_kernels(path: str):
         rows.append((ms, gfs, int(args.get("level", -1)),
                      int(args.get("batch", 0)), int(args.get("m", 0)),
                      int(args.get("w", 0)), int(args.get("u", 0))))
+    return rows
+
+
+def load_trace_compiles(path: str):
+    """Compile-census rows [(seconds, site, key, persistent_hit), ...]
+    from an obs trace artifact's ``compile``-category spans, or None
+    when `path` is missing / not a trace file."""
+    try:
+        with open(path) as f:
+            text = f.read()
+    except OSError:
+        return None
+    events = _iter_trace_events(text)
+    if events is None:
+        return None
+    rows = []
+    for ev in events:
+        if ev.get("cat") != "compile":
+            continue
+        args = ev.get("args") or {}
+        rows.append((float(ev.get("dur", 0.0)) / 1e6,   # us -> s
+                     str(ev.get("name", "?")).replace("compile ", "", 1),
+                     str(args.get("key", "?")),
+                     bool(args.get("persistent_hit"))))
     return rows
 
 
@@ -156,7 +183,21 @@ def main():
             print(f"  {ms:8.2f} ms {gfs:8.1f} GF/s  lvl={lvl:<3d} B={B:<5d} "
                   f"m={mm:<5d} w={w:<5d} u={u:<5d}  {100 * ms / total:4.1f}%")
 
-    if not rows and not kernels:
+    # compile census (obs/compilestats.py): where COLD time went — the
+    # BENCH_r02 question ("died in factor-compile, which buckets?")
+    compiles = load_trace_compiles(err)
+    if compiles:
+        ctot = sum(c[0] for c in compiles)
+        hits = sum(1 for c in compiles if c[3])
+        print(f"\n== compile census: {len(compiles)} builds, "
+              f"{ctot:.2f} s, {hits} persistent-cache hits ==")
+        print("top builds (s, site, bucket key, % of compile):")
+        for s, site, key, hit in sorted(compiles)[::-1][:12]:
+            tag = " [disk hit]" if hit else ""
+            print(f"  {s:8.3f} s  {site:<18s} {key:<26s} "
+                  f"{100 * s / max(ctot, 1e-12):4.1f}%{tag}")
+
+    if not rows and not kernels and not compiles:
         # the one failure mode this script must never have: silence
         detail = (f" (missing: {', '.join(missing)})" if missing
                   else " (inputs present but empty)")

@@ -344,7 +344,9 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     options = lu.options
     plan = lu.plan
     from superlu_dist_tpu.numeric.stream import RETRACE_SENTINEL
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
     retr0 = RETRACE_SENTINEL.total
+    comp0 = COMPILE_STATS.marker()
     dtype = options.factor_dtype or default_factor_dtype()
     if np.issubdtype(np.asarray(bvals).dtype, np.complexfloating):
         dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
@@ -382,6 +384,22 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     # retrace sentinel (runtime SLU106): unexpected recompiles during
     # THIS factorization, surfaced on the same Stats the report prints
     stats.retraces += RETRACE_SENTINEL.total - retr0
+    # compile census (obs/compilestats.py): the jit builds THIS
+    # factorization paid, as a stats.compile block in the same report
+    stats.compile = COMPILE_STATS.block(since=comp0)
+    from superlu_dist_tpu.obs.metrics import get_metrics
+    m = get_metrics()
+    if m.enabled:
+        sched = stats.sched
+        m.inc("slu_factorizations_total", 1.0,
+              schedule=sched.get("schedule", "?"))
+        m.set("slu_schedule_groups", sched.get("n_groups", 0))
+        m.set("slu_schedule_occupancy", sched.get("occupancy", 0.0))
+        m.set("slu_schedule_critical_path", sched.get("critical_path", 0))
+        m.inc("slu_compile_builds_total",
+              float(stats.compile.get("builds", 0)))
+        m.inc("slu_compile_seconds_total",
+              float(stats.compile.get("seconds", 0.0)))
     # memory observability (dQuerySpace_dist analog, SRC/dmemory_dist.c:73)
     from superlu_dist_tpu.numeric.factor import query_space
     space = query_space(numeric)
@@ -546,6 +564,7 @@ def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
     import time
 
     recovery = options.recovery
+    rungs0 = len(report.rungs)
     cur_x = np.asarray(x)
     cur_berr = report.berr if report.berr is not None else float("inf")
     if not np.all(np.isfinite(cur_x)):
@@ -662,6 +681,15 @@ def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
                 berr_before=cur_berr,
                 seconds=time.perf_counter() - t0))
 
+    # serving metrics: one rung-transition counter per ladder action
+    # this solve took (labeled by rung and whether it was adopted)
+    from superlu_dist_tpu.obs.metrics import get_metrics
+    m = get_metrics()
+    if m.enabled:
+        for r in report.rungs[rungs0:]:
+            m.inc("slu_recovery_rungs_total", 1.0, rung=r.name,
+                  improved=str(r.berr_after < r.berr_before).lower())
+            m.observe("slu_recovery_rung_seconds", r.seconds, rung=r.name)
     return cur_x, lu_eff, solve_fn, residual_dtype
 
 

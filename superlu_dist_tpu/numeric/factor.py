@@ -207,28 +207,45 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
     executed = float(sum(g.batch * _front_flops(g.w, g.u)
                          for g in plan.groups))
 
+    built = []
+
     def traced(avals, thresh):
         """Kernel-shape telemetry for the one-program executor: the whole
         factorization is a single dispatch, so it records one issue span
-        plus one aggregate kernel span (blocking only when tracing is
-        enabled — the disabled path returns the async jitted call
-        untouched)."""
+        plus one aggregate kernel span (blocking only when a profiling
+        tracer is on — the warm disabled path returns the async jitted
+        call untouched).  The FIRST call additionally lands in the
+        compile census: jit compiles synchronously inside it, so its
+        wall time IS the build cost of the fused program."""
         tracer = get_tracer()
-        if not tracer.enabled:
+        cold = not built
+        if not (tracer.enabled or cold):
             return jfn(avals, thresh)
         import time
+
+        from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
         t0 = time.perf_counter()
         out = jfn(avals, thresh)
-        tracer.complete("issue fused", "dispatch", t0,
-                        time.perf_counter() - t0, groups=len(plan.groups))
-        jax.block_until_ready(out[0])
-        tracer.complete("factor-fused", "kernel", t0,
-                        time.perf_counter() - t0,
-                        n_groups=len(plan.groups), aggregate=True,
-                        executed_flops=executed,
-                        structural_flops=float(plan.flops),
-                        padding=round(executed / max(float(plan.flops),
-                                                     1.0), 4))
+        t_issue = time.perf_counter() - t0
+        if cold:
+            built.append(True)
+            COMPILE_STATS.record(
+                "make_factor_fn",
+                f"fused g{len(plan.groups)} {str(dtype)}", t0, t_issue,
+                n_args=2)
+        if not tracer.enabled:
+            return out
+        tracer.complete("issue fused", "dispatch", t0, t_issue,
+                        groups=len(plan.groups))
+        if tracer.profiling:
+            jax.block_until_ready(out[0])
+            tracer.complete("factor-fused", "kernel", t0,
+                            time.perf_counter() - t0,
+                            n_groups=len(plan.groups), aggregate=True,
+                            executed_flops=executed,
+                            structural_flops=float(plan.flops),
+                            padding=round(executed / max(float(plan.flops),
+                                                         1.0), 4))
         return out
 
     return traced

@@ -30,9 +30,15 @@ import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.plan import FactorPlan
 from superlu_dist_tpu.numeric.factor import group_step
+from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+from superlu_dist_tpu.obs.metrics import get_metrics
 from superlu_dist_tpu.obs.trace import NULL_TRACER, get_tracer
 from superlu_dist_tpu.symbolic.symbfact import _front_flops
 from superlu_dist_tpu.utils.options import env_flag, env_float, env_int
+
+#: Shape keys whose first (compiling) invocation the compile census has
+#: already accounted — process-wide, mirroring the lru cache on _kernel.
+_CENSUSED_KEYS = set()
 
 
 # Look-ahead window (the num_lookaheads analog, reference
@@ -75,6 +81,9 @@ class RetraceSentinel:
             tracer.complete("retrace-sentinel", "verify",
                             time.perf_counter(), 0.0,
                             factory=factory, builds=int(builds))
+        m = get_metrics()
+        if m.enabled:
+            m.inc("slu_retraces_total", float(builds), factory=factory)
 
 
 RETRACE_SENTINEL = RetraceSentinel()
@@ -350,7 +359,11 @@ class StreamExecutor:
         # profiling for the same reason: its kernel spans must sum to the
         # factor wall time, which only per-group blocking guarantees.
         self._tracer = tracer = get_tracer()
-        profile = env_flag("SLU_TPU_PROFILE") or tracer.enabled
+        # per-kernel blocking timing: file tracing implies it (kernel
+        # spans must sum to the FACT wall time); the flight recorder
+        # alone does NOT (tracer.profiling False) — its ring must not
+        # serialize the async dispatch stream
+        profile = env_flag("SLU_TPU_PROFILE") or tracer.profiling
         if profile:
             self.last_profile = []
         # SLU_TPU_PROGRESS=K: log every K groups/levels issued (async
@@ -384,13 +397,25 @@ class StreamExecutor:
                 avals, thresh = avals_dev, thresh_dev
                 on_host_now = False
             kern = _kernel(*key, self.mesh, self.pool_partition, pivot)
+            # compile census: the FIRST invocation per shape key runs the
+            # synchronous trace+lower+compile inside the dispatch — time
+            # it (no extra blocking; execution stays async)
+            ck = ("group", key, self.mesh, self.pool_partition, pivot)
+            cold = ck not in _CENSUSED_KEYS
             if self._progress and gi % self._progress == 0:
                 print(f"[stream] issuing group {gi}/{len(self._steps)} "
                       f"(+{time.perf_counter() - t_issue0:.1f}s)",
                       file=sys.stderr, flush=True)
-            if profile or tracer.enabled:
+            if cold or profile or tracer.enabled:
                 t0 = time.perf_counter()
             (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)
+            if cold:
+                _CENSUSED_KEYS.add(ck)
+                (b, m, w, u) = key[0]
+                COMPILE_STATS.record(
+                    "stream._kernel", f"lu b{b} m{m} w{w} u{u}", t0,
+                    time.perf_counter() - t0,
+                    n_args=8 + len(child_arrs))
             if tracer.enabled:
                 # async-issue span: how long the DISPATCH took (Python +
                 # transfer setup), before any blocking — the
@@ -568,15 +593,24 @@ class StreamExecutor:
                 tiny = jnp.zeros((), jnp.int32)
                 avals, thresh = avals_dev, thresh_dev
                 on_host_now = False
+            n_fns = len(self._level_fns)
             fn = self._level_fn(level, entries)
+            # a fresh jitted program means the next call compiles it —
+            # account the build in the compile census (sync compile
+            # inside the dispatch, execution stays async)
+            cold = len(self._level_fns) > n_fns
             if self._progress:
                 print(f"[stream] issuing level {level} "
                       f"({len(entries)} groups)", file=sys.stderr,
                       flush=True)
             tracer = self._tracer
-            if profile or tracer.enabled:
+            if cold or profile or tracer.enabled:
                 t0 = time.perf_counter()
             outs, pool, t = fn(avals, pool, thresh)
+            if cold:
+                COMPILE_STATS.record(
+                    "stream._level_fn", f"level{level} g{len(entries)}",
+                    t0, time.perf_counter() - t0, n_args=3)
             tiny = tiny + t
             if tracer.enabled:
                 tracer.complete(f"issue lvl{level}", "dispatch", t0,

@@ -4,8 +4,19 @@ One layer owns all measurement machinery:
 
 * ``obs.trace``   — structured span tracer (``SLU_TPU_TRACE=<path>``):
   nested spans with categories (phase / dispatch / kernel / comm /
-  host-offload), emitted as Chrome trace-event JSON (Perfetto-loadable)
-  plus a crash-safe JSONL sidecar;
+  host-offload / verify / compile), emitted as Chrome trace-event JSON
+  (Perfetto-loadable) plus a crash-safe JSONL sidecar, with a
+  wall-clock anchor event for cross-rank alignment;
+* ``obs.compilestats`` — the compile census: per-shape-key build
+  records from every jit build site (trace/lower/compile seconds,
+  persistent-cache hit/miss, bucket key, param count);
+* ``obs.flightrec`` — the always-on-able flight recorder
+  (``SLU_TPU_FLIGHTREC``): a bounded ring of recent spans dumped as a
+  postmortem JSON artifact on structured errors, the bench watchdog,
+  and SIGTERM;
+* ``obs.metrics`` — serving-grade labeled counters/gauges/histograms
+  (``SLU_TPU_METRICS``) with JSON + Prometheus exports and cross-rank
+  aggregation;
 * comm telemetry  — per-op counters on the tree collectives
   (``parallel/treecomm.py`` → ``utils.stats.CommStats``), the
   PROFlevel≥1 comm split;
@@ -20,5 +31,5 @@ Perfetto example.
 """
 
 from superlu_dist_tpu.obs.trace import (      # noqa: F401
-    CATEGORIES, NULL_SPAN, NULL_TRACER, NullTracer, Tracer,
+    CATEGORIES, NULL_SPAN, NULL_TRACER, NullTracer, TeeTracer, Tracer,
     complete, enabled, get_tracer, install, span)

@@ -1,0 +1,188 @@
+"""Compile census — per-shape-key accounting of every jit build.
+
+The n=110592 TPU factor died inside ``factor-compile`` after the 1350 s
+watchdog (BENCH_r02: 119 kernels / 455 groups) and left no artifact
+saying WHICH shape-key buckets ate the time.  This registry is that
+artifact's source: every jit build site (``numeric/stream.py`` kernel
+factories, the fused ``make_factor_fn`` program, ``solve/device.py``
+sweep kernels) records one :class:`CompileRecord` per build — site,
+bucket key, build seconds, arg count, and whether the persistent
+XLA compile cache (``utils/jaxcache.py``) satisfied it from disk.
+
+Measurement model: ``jax.jit`` compiles synchronously inside the FIRST
+invocation for a given signature, so the executors time that first
+dispatch (which they already know is a build via their own key caches)
+and report it here — no second compile, no AOT staging on the hot path.
+The recorded ``seconds`` therefore include trace+lower+compile plus the
+(async) issue, which compile dominates by orders of magnitude on any
+build that matters.  ``scripts/compile_census.py --live`` provides the
+exact trace/lower/compile stage split offline, where double work is
+acceptable; records carry the split when a caller measured it.
+
+Persistent-cache attribution: ``jaxcache.enable_compile_cache`` notes
+the cache directory here; each record then checks whether the build
+appended a new entry file (disk MISS — XLA compiled and wrote) or not
+(disk HIT — loaded).  Without a configured cache dir the flag is None.
+
+The registry is always on: compiles are rare (O(#distinct kernels) per
+process), so unlike span/metric events there is no per-event hot-path
+cost to gate.  Consumers: the ``compile`` trace category
+(obs/trace.py), the ``stats.compile`` block in the PStatPrint-analog
+report (utils/stats.py via drivers/gssvx.factorize_numeric), the
+``compile_seconds`` / ``compile_census`` fields of the bench JSON row,
+flight-recorder postmortems (obs/flightrec.py), and
+``scripts/compile_census.py``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+
+@dataclass
+class CompileRecord:
+    """One jit build: where, what bucket, how long, and whether the
+    persistent compile cache served it from disk."""
+
+    site: str                 # build site, e.g. "stream._kernel"
+    key: str                  # bucket key, e.g. "lu b16 m32 w16 u16"
+    seconds: float            # first-invocation wall time (see module doc)
+    t0: float = 0.0           # time.perf_counter() at build start
+    n_args: int = 0           # kernel parameter count
+    builds: int = 1           # jit programs built inside this record
+    persistent_hit: bool | None = None   # disk-cache hit (None: no cache)
+    trace_seconds: float | None = None   # exact stage split when the
+    lower_seconds: float | None = None   # caller staged explicitly
+    compile_seconds: float | None = None # (scripts/compile_census.py)
+
+
+class CompileStats:
+    """Process-wide compile census (module singleton ``COMPILE_STATS``).
+
+    ``marker()`` + ``block(since=...)`` let callers account a window
+    (bench's factor-compile phase, one factorize_numeric call) without
+    resetting global state.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.records: list[CompileRecord] = []
+        self._cache_dir: str | None = None
+        self._cache_entries: int | None = None
+
+    # ---- persistent-cache boundary (utils/jaxcache.py) -----------------
+    def note_cache_dir(self, path: str | None) -> None:
+        """jaxcache.enable_compile_cache announces the active persistent
+        cache directory; subsequent records attribute disk hit/miss by
+        entry-count delta."""
+        with self._lock:
+            self._cache_dir = path
+            self._cache_entries = self._count_entries(path)
+
+    @staticmethod
+    def _count_entries(path: str | None) -> int | None:
+        if not path:
+            return None
+        try:
+            return len(os.listdir(path))
+        except OSError:
+            return None          # dir not created yet (first-ever compile)
+
+    # ---- recording -----------------------------------------------------
+    def record(self, site: str, key: str, t0: float, seconds: float,
+               n_args: int = 0, builds: int = 1,
+               trace_seconds: float | None = None,
+               lower_seconds: float | None = None,
+               compile_seconds: float | None = None) -> CompileRecord:
+        """Account one build and emit a ``compile`` trace span (when
+        tracing is on).  ``t0`` is the ``time.perf_counter()`` at build
+        start so the span lands at the right trace position."""
+        hit = None
+        with self._lock:
+            n = self._count_entries(self._cache_dir)
+            if n is not None:
+                if self._cache_entries is not None:
+                    # no new entry file while a cache dir is live: the
+                    # executable came off disk, not out of the compiler
+                    hit = n <= self._cache_entries
+                self._cache_entries = n
+            rec = CompileRecord(site=site, key=key, seconds=float(seconds),
+                                t0=float(t0), n_args=int(n_args),
+                                builds=int(builds), persistent_hit=hit,
+                                trace_seconds=trace_seconds,
+                                lower_seconds=lower_seconds,
+                                compile_seconds=compile_seconds)
+            self.records.append(rec)
+        from superlu_dist_tpu.obs.trace import get_tracer
+        tr = get_tracer()
+        if tr.enabled:
+            tr.complete(f"compile {site}", "compile", t0, seconds,
+                        key=key, n_args=int(n_args), builds=int(builds),
+                        persistent_hit=hit)
+        return rec
+
+    # ---- querying ------------------------------------------------------
+    def marker(self) -> int:
+        """Opaque position marker for windowed accounting."""
+        return len(self.records)
+
+    def total_seconds(self, since: int = 0) -> float:
+        return float(sum(r.seconds for r in self.records[since:]))
+
+    def census(self, since: int = 0) -> list[dict]:
+        """Per-(site, key) aggregation of the records after ``since``,
+        sorted by total seconds descending — the "which buckets dominate
+        cold-compile" table."""
+        agg: dict[tuple, dict] = {}
+        for r in self.records[since:]:
+            row = agg.get((r.site, r.key))
+            if row is None:
+                row = agg[(r.site, r.key)] = {
+                    "site": r.site, "key": r.key, "n": 0, "builds": 0,
+                    "seconds": 0.0, "persistent_hits": 0, "n_args": r.n_args}
+            row["n"] += 1
+            row["builds"] += r.builds
+            row["seconds"] += r.seconds
+            row["persistent_hits"] += 1 if r.persistent_hit else 0
+        out = sorted(agg.values(), key=lambda row: -row["seconds"])
+        for row in out:
+            row["seconds"] = round(row["seconds"], 4)
+        return out
+
+    def block(self, since: int = 0, top: int = 8) -> dict:
+        """The ``stats.compile`` block: totals plus the top buckets."""
+        recs = self.records[since:]
+        return {
+            "builds": sum(r.builds for r in recs),
+            "seconds": round(sum(r.seconds for r in recs), 4),
+            "persistent_hits": sum(1 for r in recs if r.persistent_hit),
+            "cache_dir": self._cache_dir,
+            "census": self.census(since)[:top],
+        }
+
+    def _reset(self) -> None:
+        """Test hygiene: drop all records (the cache-dir note survives)."""
+        with self._lock:
+            self.records = []
+
+
+COMPILE_STATS = CompileStats()
+
+
+def record_build(site: str, key: str, t0: float, seconds: float,
+                 **kw) -> CompileRecord:
+    """Module-level convenience for the executors' build sites."""
+    return COMPILE_STATS.record(site, key, t0, seconds, **kw)
+
+
+def timed_build(site: str, key: str, fn, *args, n_args: int = 0, **kwargs):
+    """Run ``fn(*args, **kwargs)`` (a first jit invocation) and record
+    its wall time as a build.  Returns fn's result."""
+    t0 = time.perf_counter()
+    out = fn(*args, **kwargs)
+    COMPILE_STATS.record(site, key, t0, time.perf_counter() - t0,
+                         n_args=n_args)
+    return out

@@ -42,9 +42,11 @@ import time
 #: Span categories (the ``cat`` field of every record).  "verify" spans
 #: come from the runtime SLU106 tier: collective-lockstep mismatches
 #: (parallel/treecomm.LockstepVerifier) and unexpected-recompile events
-#: (numeric/stream.RetraceSentinel).
+#: (numeric/stream.RetraceSentinel).  "compile" spans come from the
+#: compile census (obs/compilestats.py): one per jit build, tagged with
+#: the shape-key bucket and persistent-cache hit/miss.
 CATEGORIES = ("phase", "dispatch", "kernel", "comm", "host-offload",
-              "verify")
+              "verify", "compile")
 
 
 class _NullSpan:
@@ -70,6 +72,7 @@ class NullTracer:
 
     __slots__ = ()
     enabled = False
+    profiling = False
     path = None
 
     def span(self, name, cat="phase", **attrs):
@@ -121,6 +124,7 @@ class Tracer:
     artifact) and stream to the JSONL sidecar as they close."""
 
     enabled = True
+    profiling = True     # file tracing implies per-kernel blocking spans
 
     def __init__(self, path: str):
         path = path.replace("%p", str(os.getpid()))
@@ -134,6 +138,12 @@ class Tracer:
         self._tls = threading.local()
         self._jsonl = None
         self._closed = False
+        # wall-clock anchor: every span timestamp is monotonic, so a
+        # multi-rank Perfetto merge (or a flight-recorder dump) needs one
+        # absolute reference per process — unix ≈ unix_time + ts_us/1e6
+        self._record("clock-anchor", "phase", self._epoch_ns, 0,
+                     {"unix_time": round(time.time(), 6),
+                      "perf_ns": self._epoch_ns})
 
     # ---- internals -----------------------------------------------------
     def _enter_thread(self):
@@ -213,6 +223,66 @@ class Tracer:
                 self._jsonl = None
 
 
+class _TeeSpan:
+    """One span mirrored into every child tracer."""
+
+    __slots__ = ("_spans",)
+
+    def __init__(self, spans):
+        self._spans = spans
+
+    def __enter__(self):
+        for s in self._spans:
+            s.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        for s in reversed(self._spans):
+            s.__exit__(*exc)
+        return False
+
+    def set(self, **attrs):
+        for s in self._spans:
+            s.set(**attrs)
+        return self
+
+
+class TeeTracer:
+    """Fan-out tracer: every span/record goes to each child (the file
+    tracer + the flight recorder when both are enabled)."""
+
+    enabled = True
+
+    def __init__(self, *tracers):
+        self._tracers = [t for t in tracers if t is not None and t.enabled]
+
+    @property
+    def path(self):
+        for t in self._tracers:
+            if getattr(t, "path", None):
+                return t.path
+        return None
+
+    @property
+    def profiling(self):
+        return any(getattr(t, "profiling", False) for t in self._tracers)
+
+    def span(self, name, cat="phase", **attrs):
+        return _TeeSpan([t.span(name, cat, **attrs) for t in self._tracers])
+
+    def complete(self, name, cat, t0, dur, **attrs):
+        for t in self._tracers:
+            t.complete(name, cat, t0, dur, **attrs)
+
+    def flush(self):
+        for t in self._tracers:
+            t.flush()
+
+    def close(self):
+        for t in self._tracers:
+            t.close()
+
+
 # ---- process-global tracer -------------------------------------------------
 
 _tracer = None
@@ -220,9 +290,13 @@ _init_lock = threading.Lock()
 
 
 def get_tracer():
-    """The process tracer: a ``Tracer`` when ``SLU_TPU_TRACE`` is set,
-    else the ``NULL_TRACER`` singleton.  The env var is read once, on
-    first use (tests reconfigure via ``install``/``_reset``)."""
+    """The process tracer, composed from two env gates on first use:
+    ``SLU_TPU_TRACE`` (the file tracer) and ``SLU_TPU_FLIGHTREC`` (the
+    ring-buffer flight recorder, obs/flightrec.py — it implements the
+    tracer protocol, so every instrumentation site feeds it for free).
+    Both on → a ``TeeTracer``; one on → that one; neither → the
+    ``NULL_TRACER`` singleton.  Tests reconfigure via
+    ``install``/``_reset``."""
     global _tracer
     t = _tracer
     if t is None:
@@ -230,9 +304,18 @@ def get_tracer():
             if _tracer is None:
                 from superlu_dist_tpu.utils.options import env_str
                 path = env_str("SLU_TPU_TRACE").strip()
+                file_tracer = None
                 if path:
-                    _tracer = Tracer(path)
-                    atexit.register(_tracer.close)
+                    file_tracer = Tracer(path)
+                    atexit.register(file_tracer.close)
+                from superlu_dist_tpu.obs.flightrec import get_flightrec
+                fr = get_flightrec()
+                if file_tracer is not None and fr.enabled:
+                    _tracer = TeeTracer(file_tracer, fr)
+                elif file_tracer is not None:
+                    _tracer = file_tracer
+                elif fr.enabled:
+                    _tracer = fr
                 else:
                     _tracer = NULL_TRACER
             t = _tracer

@@ -108,6 +108,15 @@ def _finish_stats(tc: TreeComm, lu_out):
     summary = stats.reduce(tc)
     if lu_out is not None:
         lu_out["stats_summary"] = summary
+    # serving metrics: cross-rank aggregation rides the same epilogue
+    # (SLU_TPU_METRICS is env-driven, hence identical on every rank —
+    # the branch is collective-safe)
+    from superlu_dist_tpu.obs.metrics import get_metrics
+    m = get_metrics()
+    if m.enabled:
+        reduced = m.reduce(tc)
+        if lu_out is not None:
+            lu_out["metrics_summary"] = reduced
     if env_flag("SLU_TPU_STATS") and tc.rank == 0:
         print(summary.report())
     return summary

@@ -26,6 +26,7 @@ import time
 import numpy as np
 
 from superlu_dist_tpu import native
+from superlu_dist_tpu.obs.metrics import get_metrics
 from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.utils.stats import CommStats
 
@@ -236,6 +237,11 @@ class TreeComm:
         # caller's intent, not the transport decomposition
         self.comm_stats = CommStats()
         self._op_label = None
+        # serving metrics (obs/metrics.py): latched once — the disabled
+        # path costs ONE `is None` test per collective leg, allocates
+        # nothing (the NULL_TRACER discipline)
+        m = get_metrics()
+        self._metrics = m if m.enabled else None
         # lockstep-verify mode (runtime SLU106): OFF means NO verifier
         # state at all — self._verifier stays None and the collective
         # path pays one attribute test (see _verified)
@@ -263,6 +269,11 @@ class TreeComm:
             tr.complete(f"tree-{op}", "comm", t0, dt, op=op,
                         bytes=int(nbytes), root=int(root), rank=self.rank,
                         n_ranks=self.n_ranks)
+        m = self._metrics
+        if m is not None:
+            m.inc("slu_comm_calls_total", 1.0, op=op)
+            m.inc("slu_comm_bytes_total", float(nbytes), op=op)
+            m.observe("slu_comm_seconds", dt, op=op)
 
     def _prep(self, buf: np.ndarray) -> np.ndarray:
         out = np.ascontiguousarray(buf, dtype=np.float64)
@@ -472,10 +483,13 @@ class FaultyTreeComm(TreeComm):
     def _f64_op(self, flat: np.ndarray, root: int, op) -> np.ndarray:
         out = np.empty(flat.size, dtype=np.float64)
         step = self.max_len
+        m = self._metrics
         offsets = list(range(0, flat.size, step))
         if len(offsets) > 1 and self._frng.random() < self._p_reorder:
             self._frng.shuffle(offsets)
             self.fault_counts["reorder"] += 1
+            if m is not None:
+                m.inc("slu_comm_faults_total", 1.0, fault="reorder")
         for lo in offsets:
             hi = min(lo + step, flat.size)
             for attempt in range(self._max_retries + 1):
@@ -488,12 +502,17 @@ class FaultyTreeComm(TreeComm):
                 if (attempt < self._max_retries
                         and self._frng.random() < self._p_drop):
                     self.fault_counts["drop"] += 1
+                    if m is not None:
+                        m.inc("slu_comm_faults_total", 1.0, fault="drop")
+                        m.inc("slu_comm_retries_total", 1.0)
                     if self._delay:
                         time.sleep(self._delay)   # the simulated timeout
                     continue
                 break
             if self._frng.random() < self._p_dup:
                 self.fault_counts["dup"] += 1
+                if m is not None:
+                    m.inc("slu_comm_faults_total", 1.0, fault="dup")
                 res = op(np.ascontiguousarray(flat[lo:hi],
                                               dtype=np.float64),
                          root=root)[:hi - lo]

@@ -27,7 +27,19 @@ import jax
 import jax.numpy as jnp
 
 from superlu_dist_tpu.numeric.factor import NumericFactorization
+from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
 from superlu_dist_tpu.obs.trace import get_tracer
+
+
+def _sweep_kernel_builds() -> int:
+    """Total jitted-closure builds across the solve kernel factories —
+    the compile-census marker for one solve's sweeps (a fresh closure's
+    first invocation compiles synchronously inside the sweep)."""
+    return (_fwd_kernel.cache_info().misses
+            + _bwd_kernel.cache_info().misses
+            + _fwd_trans_kernel.cache_info().misses
+            + _bwd_trans_kernel.cache_info().misses
+            + _diag_inv_kernel.cache_info().misses)
 
 
 def _bucket_nrhs(k: int) -> int:
@@ -331,6 +343,11 @@ class DeviceSolver:
         kb = _bucket_nrhs(k)
         pad = np.zeros((self.n + 1, kb), dtype=jnp.dtype(self.fact.dtype))
         pad[:self.n, :k] = r2
+        # compile census: new sweep-kernel closures (streamed lru misses
+        # or fresh fused programs) mean this call compiles — time the
+        # sweep issue and account it per (n, nrhs-bucket, mode)
+        builds0 = _sweep_kernel_builds() + len(self._fused_cache)
+        t0_build = time.perf_counter()
         with tracer.span("device-solve", cat="kernel", n=self.n, nrhs=k,
                          padded_nrhs=kb, fused=self.fused,
                          n_groups=len(self._groups),
@@ -356,6 +373,15 @@ class DeviceSolver:
                 x = jnp.asarray(pad)
                 lsum = jnp.zeros_like(x)
                 x = sweeps(x, lsum, kb)
+            builds = (_sweep_kernel_builds() + len(self._fused_cache)
+                      - builds0)
+            if builds:
+                COMPILE_STATS.record(
+                    "solve.device",
+                    f"solve n{self.n} nrhs{kb} "
+                    f"{'fused' if self.fused else 'stream'}",
+                    t0_build, time.perf_counter() - t0_build,
+                    n_args=6, builds=builds)
             t0 = time.perf_counter()
             out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
             if tracer.enabled:

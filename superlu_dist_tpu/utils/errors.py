@@ -7,6 +7,20 @@ and return ``info`` from drivers for singularity, matching pdgssvx semantics.
 """
 
 
+def _flight_dump(exc) -> None:
+    """Flight-recorder postmortem hook (obs/flightrec.py): the
+    structured breakdown/mismatch errors dump the telemetry ring at
+    CONSTRUCTION time, so the evidence lands on disk on every rank even
+    when the exception later dies inside a watchdog ``os._exit``, a
+    worker process, or an over-broad caller ``except``.  Must never
+    interfere with raising the error itself."""
+    try:
+        from superlu_dist_tpu.obs.flightrec import on_error
+        exc.flightrec_dump = on_error(exc)   # path, or None when off
+    except Exception:
+        exc.flightrec_dump = None
+
+
 class SuperLUError(Exception):
     """Invalid argument / internal error (analog of pxerr_dist + ABORT)."""
 
@@ -39,6 +53,7 @@ class NumericBreakdownError(SuperLUError):
         super().__init__(
             f"non-finite values detected{stage}{loc}; the system is "
             "numerically broken down (overflow or NaN input)")
+        _flight_dump(self)
 
 
 class CollectiveMismatchError(SuperLUError):
@@ -73,3 +88,4 @@ class CollectiveMismatchError(SuperLUError):
             + " — every rank must reach the same TreeComm collective "
               "sequence (this would have deadlocked without "
               "SLU_TPU_VERIFY_COLLECTIVES)")
+        _flight_dump(self)

@@ -111,6 +111,13 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     except Exception:
         pass
+    # compile-census boundary (obs/compilestats.py): build records can
+    # now attribute disk hit/miss by entry-count delta in this dir
+    try:
+        from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+        COMPILE_STATS.note_cache_dir(cache_dir)
+    except Exception:
+        pass
 
 
 def disable_compile_cache() -> None:
@@ -126,6 +133,21 @@ def disable_compile_cache() -> None:
         jax.config.update("jax_compilation_cache_dir", None)
     except Exception:
         pass
+
+
+def entry_count(cache_dir: str | None = None) -> int | None:
+    """Number of entries in the persistent compile cache directory
+    (None when no dir is configured or it does not exist yet).  The
+    compile census uses the delta across a build to tell a disk hit
+    (no new entry) from a fresh compile (entry written)."""
+    if cache_dir is None:
+        cache_dir = current_cache_dir()
+    if not cache_dir:
+        return None
+    try:
+        return len(os.listdir(cache_dir))
+    except OSError:
+        return None
 
 
 def current_cache_dir() -> str | None:

@@ -126,6 +126,17 @@ def _register_all() -> None:
       "deprecated legacy '# lvl=' stderr kernel trace", group="obs")
     r("SLU_TPU_PROGRESS", "int", 0,
       "log every K groups/levels issued (0=silent)", group="obs")
+    r("SLU_TPU_METRICS", "str", "",
+      "metrics registry: '1' enables; a path additionally dumps the "
+      "JSON/Prometheus export there at exit ('%p' expands to the pid)",
+      group="obs")
+    r("SLU_TPU_FLIGHTREC", "str", "",
+      "flight recorder: '1' enables (default flightrec-%p.json dump); "
+      "a path names the postmortem artifact ('%p' expands to the pid)",
+      group="obs")
+    r("SLU_TPU_FLIGHTREC_DEPTH", "int", 512,
+      "flight-recorder ring depth (events kept for the postmortem)",
+      group="obs")
     # --- native layer ------------------------------------------------------
     r("SLU_TPU_NO_NATIVE", "flag", False,
       "disable the native C++ host-analysis library", group="native")
@@ -188,7 +199,18 @@ def _register_all() -> None:
             ("DF64S_MESH", "str", "1", "df64_scale mesh spec"),
             ("DF64S_NX", "int", 16, "df64_scale grid edge"),
             ("DF64S_KAPPA", "float", 1e10, "df64_scale condition target"),
-            ("DF64S_COMPLEX", "str", "0", "df64_scale complex twin")):
+            ("DF64S_COMPLEX", "str", "0", "df64_scale complex twin"),
+            ("SLU_TPU_BENCH_HISTORY", "str", "",
+             "bench-history JSONL DB path (default .cache/"
+             "bench_history.jsonl; scripts/bench_history.py + "
+             "check_perf_regress.py)"),
+            ("PERF_GATE_NX", "int", 8,
+             "check_perf_regress micro-bench grid edge"),
+            ("PERF_GATE_TOL", "float", 0.5,
+             "check_perf_regress noise tolerance (fail below "
+             "(1-tol)*median)"),
+            ("PERF_GATE_MIN_SAMPLES", "int", 3,
+             "check_perf_regress history rows required before enforcing")):
         r(name, kind, default, help_, group="scripts")
 
 

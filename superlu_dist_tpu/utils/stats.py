@@ -146,6 +146,11 @@ class Stats:
                                   # groups before/after aggregation, mean
                                   # batch occupancy, padding factor,
                                   # critical-path length)
+    compile: dict = field(default_factory=dict)   # compile-census block
+                                  # of the last factorization
+                                  # (obs/compilestats.COMPILE_STATS.block:
+                                  # builds, seconds, persistent hits,
+                                  # top shape-key buckets)
     _timer_depth: dict = field(default_factory=dict, repr=False,
                                compare=False)
 
@@ -255,6 +260,19 @@ class Stats:
                 f"occupancy {s.get('occupancy', 0.0):6.2f}  "
                 f"padding {s.get('padding_factor', 0.0):5.2f}x  "
                 f"critical path {s.get('critical_path', 0)}")
+        if self.compile and self.compile.get("builds"):
+            # compile census (obs/compilestats.py): what the jit builds
+            # of the last factorization cost, and which shape-key
+            # buckets dominated — the ROADMAP item 3 diagnostic
+            c = self.compile
+            lines.append(
+                f"    compile  builds {c['builds']:4d}  "
+                f"{c.get('seconds', 0.0):10.4f} s  "
+                f"persistent hits {c.get('persistent_hits', 0)}")
+            for row in c.get("census", [])[:3]:
+                lines.append(
+                    f"      {row['site']:<18s} {row['key']:<26s} "
+                    f"x{row['n']:<3d} {row['seconds']:9.4f} s")
         if self.tiny_pivots:
             lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
         if self.retraces:

@@ -1,12 +1,21 @@
-"""Observability subsystem (obs/trace.py + comm/kernel telemetry +
-cross-rank stat reduction) — the PROFlevel analog.
+"""Observability subsystem (obs/trace.py + compile census + flight
+recorder + metrics + comm/kernel telemetry + cross-rank stat
+reduction) — the PROFlevel analog.
 
 Covers: span nesting/ordering and both artifact formats (Chrome
-trace-event JSON, JSONL sidecar), the guaranteed-negligible disabled
-path (no file, reused no-op span), comm counters against a 2-rank
-TreeComm exchange with known byte counts, kernel-shape records from
-both factorization executors and the device solve, Stats.timer
-reentrancy, and Stats.reduce min/max/avg + load-balance factors.
+trace-event JSON with wall-clock anchor, JSONL sidecar), the
+guaranteed-negligible disabled paths (no file / no ring / no registry,
+reused no-op singletons), comm counters against a 2-rank TreeComm
+exchange with known byte counts, kernel-shape records from both
+factorization executors and the device solve, Stats.timer reentrancy,
+Stats.reduce min/max/avg + load-balance factors, the compile census
+(cold builds recorded with bucket keys + compile trace spans, warm
+reruns silent, stats.compile block), flight-recorder postmortems
+(bounded ring, dump on provoked NumericBreakdownError and 2-rank
+CollectiveMismatchError, tracer composition), the metrics registry
+(exports, TreeComm wiring, 2-rank collective reduction, recovery-rung
+counters), the bench row's compile/phase acceptance fields, and the
+perf-regression gate's seeding/enforcement state machine.
 """
 
 import json
@@ -31,12 +40,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 @pytest.fixture(autouse=True)
 def _tracer_hygiene(monkeypatch):
-    """Every test starts and ends with the env-driven tracer state reset
-    (the global is latched on first use)."""
-    monkeypatch.delenv("SLU_TPU_TRACE", raising=False)
+    """Every test starts and ends with the env-driven telemetry state
+    reset (tracer, flight recorder, and metrics are all latched on
+    first use)."""
+    from superlu_dist_tpu.obs import flightrec, metrics
+    for knob in ("SLU_TPU_TRACE", "SLU_TPU_FLIGHTREC", "SLU_TPU_METRICS"):
+        monkeypatch.delenv(knob, raising=False)
     trace._reset()
+    flightrec._reset()
+    metrics._reset()
     yield
     trace._reset()
+    flightrec._reset()
+    metrics._reset()
 
 
 # ---------------------------------------------------------------------------
@@ -53,7 +69,10 @@ def test_span_nesting_and_jsonl(tmp_path):
             pass
     t.close()
     rows = [json.loads(line) for line in open(tmp_path / "t.jsonl")]
-    assert [r["name"] for r in rows] == ["inner", "inner2", "outer"]
+    # the first record is the wall-clock anchor written at tracer open
+    assert [r["name"] for r in rows] == ["clock-anchor", "inner", "inner2",
+                                         "outer"]
+    assert rows[0]["args"]["unix_time"] > 0
     by = {r["name"]: r for r in rows}
     outer, inner = by["outer"], by["inner"]
     # nesting: children start after and end before the parent
@@ -76,7 +95,7 @@ def test_chrome_trace_artifact_valid(tmp_path):
     t.close()
     doc = json.load(open(path))
     events = doc["traceEvents"]
-    assert len(events) == 3
+    assert len(events) == 4          # 3 spans + the wall-clock anchor
     for ev in events:
         assert ev["ph"] == "X"
         for key in ("name", "cat", "ts", "dur", "pid", "tid"):
@@ -96,7 +115,7 @@ def test_span_set_attaches_midspan_attrs(tmp_path):
         sp.set(result_bytes=128)
     t.close()
     rows = [json.loads(line) for line in open(tmp_path / "t.jsonl")]
-    assert rows[0]["args"] == {"result_bytes": 128}
+    assert rows[1]["args"] == {"result_bytes": 128}   # rows[0] = anchor
 
 
 def test_disabled_path_is_noop(tmp_path, monkeypatch):
@@ -131,7 +150,8 @@ def test_env_gated_tracer(tmp_path, monkeypatch):
         pass
     trace._reset()                            # closes + flushes
     doc = json.load(open(path))
-    assert doc["traceEvents"][0]["name"] == "gated"
+    names = [e["name"] for e in doc["traceEvents"]]
+    assert names == ["clock-anchor", "gated"]
     assert (tmp_path / "run.jsonl").exists()
 
 
@@ -146,7 +166,7 @@ def test_install_programmatic(tmp_path):
         trace.install(prev)
         t.close()
     rows = [json.loads(line) for line in open(tmp_path / "p.jsonl")]
-    assert rows[0]["name"] == "prog"
+    assert [r["name"] for r in rows] == ["clock-anchor", "prog"]
 
 
 # ---------------------------------------------------------------------------
@@ -501,3 +521,463 @@ def test_mfu_report_legacy_stderr_still_parses(tmp_path):
     assert r.returncode == 0, r.stderr
     out = r.stdout.decode()
     assert "legacy stderr" in out and "m=512" in out
+
+
+# ---------------------------------------------------------------------------
+# compile census (obs/compilestats.py): cold builds recorded, warm silent
+# ---------------------------------------------------------------------------
+
+def test_compile_census_cold_then_warm_stream(tmp_path):
+    import jax.numpy as jnp
+    from superlu_dist_tpu.numeric import stream as stream_mod
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+
+    plan, avals = _small_plan()
+    stream_mod._CENSUSED_KEYS.clear()
+    m0 = COMPILE_STATS.marker()
+    t = trace.Tracer(str(tmp_path / "c.json"))
+    prev = trace.install(t)
+    try:
+        ex = stream_mod.StreamExecutor(plan, "float64")
+        ex(jnp.asarray(avals), jnp.asarray(0.0))
+        cold = COMPILE_STATS.marker() - m0
+        assert cold > 0
+        # warm rerun: every key censused, nothing new recorded
+        ex(jnp.asarray(avals), jnp.asarray(0.0))
+        assert COMPILE_STATS.marker() - m0 == cold
+    finally:
+        trace.install(prev)
+        t.close()
+    # record content: site, bucket key, seconds, param count
+    recs = COMPILE_STATS.records[m0:]
+    assert all(r.site == "stream._kernel" for r in recs)
+    assert all(r.key.startswith("lu b") for r in recs)
+    assert all(r.seconds >= 0 and r.n_args >= 8 for r in recs)
+    # census aggregation ranks buckets by total seconds
+    census = COMPILE_STATS.census(m0)
+    assert census == sorted(census, key=lambda row: -row["seconds"])
+    # the builds landed in the trace as compile-category spans
+    events = json.load(open(tmp_path / "c.json"))["traceEvents"]
+    spans = [e for e in events if e["cat"] == "compile"]
+    assert len(spans) == cold
+    for e in spans:
+        assert e["name"] == "compile stream._kernel"
+        assert "key" in e["args"]
+
+
+def test_compile_census_fused_and_stats_block():
+    import jax.numpy as jnp
+    from superlu_dist_tpu.numeric.factor import make_factor_fn
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+
+    plan, avals = _small_plan()
+    fn = make_factor_fn(plan, "float64")
+    m0 = COMPILE_STATS.marker()
+    fn(jnp.asarray(avals), jnp.asarray(0.0))
+    assert COMPILE_STATS.marker() - m0 == 1       # one fused program
+    fn(jnp.asarray(avals), jnp.asarray(0.0))
+    assert COMPILE_STATS.marker() - m0 == 1       # warm: silent
+    rec = COMPILE_STATS.records[m0]
+    assert rec.site == "make_factor_fn" and rec.key.startswith("fused g")
+    blk = COMPILE_STATS.block(since=m0)
+    assert blk["builds"] == 1 and blk["seconds"] > 0
+    assert blk["census"][0]["site"] == "make_factor_fn"
+
+
+def test_gssvx_fills_stats_compile_block():
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.models.gallery import poisson2d
+
+    a = poisson2d(9)   # distinct size: guarantees at least one cold build
+    x, lu, stats, info = slu.gssvx(slu.Options(), a, np.ones(a.n_rows))
+    assert info == 0
+    assert isinstance(stats.compile, dict)
+    assert {"builds", "seconds", "persistent_hits", "census"} \
+        <= set(stats.compile)
+    if stats.compile["builds"]:
+        assert "compile" in stats.report()
+
+
+# ---------------------------------------------------------------------------
+# flight recorder (obs/flightrec.py)
+# ---------------------------------------------------------------------------
+
+def test_flightrec_ring_bounds_and_dump(tmp_path):
+    from superlu_dist_tpu.obs import flightrec
+
+    fr = flightrec.FlightRecorder(str(tmp_path / "fr.json"), depth=16)
+    with fr.span("FACT", cat="phase"):
+        for i in range(40):
+            fr.complete(f"ev{i}", "dispatch", time.perf_counter(), 0.0,
+                        i=i)
+    path = fr.dump("unit-test", detail="ring bounds")
+    assert path == str(tmp_path / "fr.json")
+    doc = json.load(open(path))
+    assert doc["reason"] == "unit-test"
+    assert len(doc["events"]) == 16               # bounded, newest kept
+    assert doc["total_events"] == 41 and doc["dropped_events"] == 25
+    assert doc["events"][-1]["name"] == "FACT"    # span closed last
+    assert doc["anchor"]["unix_time"] > 0
+    assert "compile" in doc
+    # a second dump supersedes (seq advances)
+    fr.dump("again")
+    assert json.load(open(path))["seq"] == 1
+
+
+def test_flightrec_is_the_tracer_when_alone(tmp_path, monkeypatch):
+    """Flight-only mode: get_tracer() returns the recorder (every
+    instrumentation site feeds the ring) but profiling stays OFF — the
+    executors must not serialize their dispatch for it."""
+    from superlu_dist_tpu.obs import flightrec
+
+    monkeypatch.setenv("SLU_TPU_FLIGHTREC", str(tmp_path / "f-%p.json"))
+    flightrec._reset()
+    trace._reset()
+    t = trace.get_tracer()
+    assert isinstance(t, flightrec.FlightRecorder)
+    assert t.enabled and not t.profiling and t.path is None
+    # both on: a tee that profiles (file tracer wins) and keeps the path
+    monkeypatch.setenv("SLU_TPU_TRACE", str(tmp_path / "t.json"))
+    flightrec._reset()
+    trace._reset()
+    t2 = trace.get_tracer()
+    assert isinstance(t2, trace.TeeTracer)
+    assert t2.profiling and t2.path == str(tmp_path / "t.json")
+    with t2.span("both", cat="phase"):
+        pass
+    trace._reset()
+    events = json.load(open(tmp_path / "t.json"))["traceEvents"]
+    assert any(e["name"] == "both" for e in events)
+
+
+def test_flightrec_dump_on_numeric_breakdown(tmp_path):
+    """Acceptance: a run killed by an injected breakdown leaves a
+    postmortem artifact with the last events and the open phase stack."""
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.obs import flightrec
+    from superlu_dist_tpu.utils.errors import NumericBreakdownError
+    from superlu_dist_tpu.utils.options import Options, RowPerm
+
+    fr = flightrec.FlightRecorder(str(tmp_path / "post.json"), depth=128)
+    prev = flightrec.install(fr)
+    trace._reset()            # recompose: the recorder becomes the tracer
+    try:
+        a = poisson2d(8)
+        a.data = a.data.copy()
+        a.data[len(a.data) // 2] = np.nan
+        with pytest.raises(NumericBreakdownError) as exc:
+            gssvx(Options(equil=False, row_perm=RowPerm.NOROWPERM), a,
+                  np.ones(a.n_rows))
+    finally:
+        flightrec.install(prev)
+        trace._reset()
+    assert exc.value.flightrec_dump == str(tmp_path / "post.json")
+    doc = json.load(open(tmp_path / "post.json"))
+    assert doc["reason"] == "NumericBreakdownError"
+    assert "supernode" in doc["detail"]
+    assert doc["events"], "postmortem carries no events"
+    names = {e["name"] for e in doc["events"]}
+    assert {"EQUIL", "COLPERM"} & names            # recent phase spans
+    # the error fired INSIDE the FACT phase: it is still on the stack
+    stacks = [tuple(s) for st in doc["phase_stack"].values() for s in st]
+    assert ("FACT", "phase") in stacks
+    assert "compile" in doc and "anchor" in doc
+
+
+def _mismatch_flight_worker(name, dump_path, q):
+    from superlu_dist_tpu.obs import flightrec, trace as trace_mod
+    fr = flightrec.FlightRecorder(dump_path, depth=64)
+    flightrec.install(fr)
+    trace_mod._reset()
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+    tc = TreeComm(name, 2, 1, max_len=64, create=False)
+    try:
+        x = np.ones(8)
+        tc.allreduce_sum_any(x)                  # matched prologue
+        tc.reduce_sum_any(x)                     # DIVERGES from the owner
+        q.put(("no-error", None))
+    except CollectiveMismatchError as exc:
+        q.put(("mismatch", exc.flightrec_dump))
+    finally:
+        tc.close()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library unavailable")
+def test_flightrec_dump_on_collective_mismatch_two_ranks(tmp_path,
+                                                         monkeypatch):
+    """Acceptance: EVERY rank of a diverged 2-rank run leaves its own
+    postmortem naming the mismatch — evidence instead of a deadlock."""
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    from superlu_dist_tpu.obs import flightrec
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CollectiveMismatchError
+
+    owner_path = str(tmp_path / "owner.json")
+    worker_path = str(tmp_path / "worker.json")
+    fr = flightrec.FlightRecorder(owner_path, depth=64)
+    prev = flightrec.install(fr)
+    trace._reset()
+    name = f"/slu_obs_frmm_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_mismatch_flight_worker,
+                    args=(name, worker_path, q))
+    p.start()
+    try:
+        x = np.ones(8)
+        owner.allreduce_sum_any(x)
+        with pytest.raises(CollectiveMismatchError) as ei:
+            owner.bcast_any(x)                   # diverges from the worker
+        kind, wdump = q.get(timeout=60)
+        p.join(timeout=60)
+        assert kind == "mismatch", kind
+    finally:
+        owner.close(unlink=True)
+        flightrec.install(prev)
+        trace._reset()
+    assert ei.value.flightrec_dump == owner_path
+    assert wdump == worker_path
+    for path in (owner_path, worker_path):
+        doc = json.load(open(path))
+        assert doc["reason"] == "CollectiveMismatchError"
+        assert "reduce_sum_any" in doc["detail"] \
+            and "bcast_any" in doc["detail"]
+        # the ring caught the matched prologue's comm legs
+        assert any(e["cat"] == "comm" for e in doc["events"])
+        assert doc["anchor"]["unix_time"] > 0
+
+
+# ---------------------------------------------------------------------------
+# metrics registry (obs/metrics.py)
+# ---------------------------------------------------------------------------
+
+def test_metrics_disabled_path_is_noop(tmp_path, monkeypatch):
+    from superlu_dist_tpu.obs import metrics
+
+    m = metrics.get_metrics()
+    assert m is metrics.NULL_METRICS and not m.enabled
+    assert m.inc("x", 1, op="a") is None
+    m.set("g", 2.0)
+    m.observe("h", 0.1, op="b")
+    assert m.snapshot() == {} and m.to_prometheus() == ""
+    # singleton: repeated gets allocate nothing new
+    assert metrics.get_metrics() is m
+
+
+def test_metrics_counters_gauges_histograms_and_exports():
+    from superlu_dist_tpu.obs import metrics
+
+    m = metrics.Metrics()
+    m.inc("slu_comm_bytes_total", 64, op="bcast")
+    m.inc("slu_comm_bytes_total", 64, op="bcast")
+    m.inc("slu_comm_bytes_total", 8, op="reduce")
+    m.set("slu_schedule_groups", 7)
+    m.observe("slu_comm_seconds", 0.004, op="bcast")
+    m.observe("slu_comm_seconds", 0.2, op="bcast")
+    snap = m.snapshot()
+    assert snap["counters"]['slu_comm_bytes_total{op="bcast"}'] == 128.0
+    assert snap["gauges"]["slu_schedule_groups"] == 7.0
+    h = snap["histograms"]['slu_comm_seconds{op="bcast"}']
+    assert h["count"] == 2 and abs(h["sum"] - 0.204) < 1e-12
+    assert h["min"] == 0.004 and h["max"] == 0.2
+    # exports: JSON round-trips; Prometheus text carries samples + types
+    assert json.loads(m.to_json()) == snap
+    prom = m.to_prometheus()
+    assert "# TYPE slu_comm_bytes_total counter" in prom
+    assert 'slu_comm_bytes_total{op="bcast"} 128' in prom
+    assert 'slu_comm_seconds_count{op="bcast"} 2' in prom
+    assert "# TYPE slu_schedule_groups gauge" in prom
+
+
+def test_metrics_env_gate_and_treecomm_latch(monkeypatch):
+    from superlu_dist_tpu.obs import metrics
+
+    monkeypatch.setenv("SLU_TPU_METRICS", "1")
+    metrics._reset()
+    m = metrics.get_metrics()
+    assert isinstance(m, metrics.Metrics) and m.enabled
+    m.inc("gate_check", 1)
+    assert metrics.get_metrics() is m            # latched
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library unavailable")
+def test_metrics_comm_wiring_single_rank(monkeypatch):
+    from superlu_dist_tpu.obs import metrics
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    monkeypatch.setenv("SLU_TPU_METRICS", "1")
+    metrics._reset()
+    name = f"/slu_obs_mw_{os.getpid()}"
+    with TreeComm(name, 1, 0, max_len=16, create=True) as tc:
+        assert tc._metrics is not None
+        tc.bcast(np.ones(8))                     # 8 f64 = 64 bytes
+        tc.allreduce_sum(np.ones(4))
+    snap = metrics.get_metrics().snapshot()
+    assert snap["counters"]['slu_comm_bytes_total{op="bcast"}'] == 64.0
+    assert snap["counters"]['slu_comm_calls_total{op="allreduce"}'] == 2.0
+    assert 'slu_comm_seconds{op="bcast"}' in snap["histograms"]
+    # and with the knob off, TreeComm latches None (one is-None test)
+    monkeypatch.delenv("SLU_TPU_METRICS")
+    metrics._reset()
+    name2 = f"/slu_obs_mw2_{os.getpid()}"
+    with TreeComm(name2, 1, 0, max_len=16, create=True) as tc2:
+        assert tc2._metrics is None
+        tc2.bcast(np.ones(4))
+
+
+def _metrics_rank_worker(name, q):
+    os.environ["SLU_TPU_METRICS"] = "1"
+    from superlu_dist_tpu.obs import metrics
+    metrics._reset()
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    tc = TreeComm(name, 2, 1, max_len=64, create=False)
+    try:
+        m = metrics.get_metrics()
+        m.inc("test_rank_contrib", 2.0)          # rank 1 contributes 2
+        tc.bcast(np.arange(8.0), root=0)
+        q.put((1, m.reduce(tc)))
+    finally:
+        tc.close()
+
+
+@pytest.mark.skipif(not native.available(),
+                    reason="native library unavailable")
+def test_metrics_two_rank_reduce_over_treecomm(monkeypatch):
+    """Cross-rank aggregation: both ranks call reduce() collectively and
+    get the SAME summed/min/max table (the Stats.reduce discipline)."""
+    from superlu_dist_tpu.obs import metrics
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    monkeypatch.setenv("SLU_TPU_METRICS", "1")
+    metrics._reset()
+    name = f"/slu_obs_mr_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    try:
+        ctx = mp.get_context("spawn")     # no fork of the jax-laden parent
+        q = ctx.Queue()
+        p = ctx.Process(target=_metrics_rank_worker, args=(name, q))
+        p.start()
+        m = metrics.get_metrics()
+        m.inc("test_rank_contrib", 1.0)          # rank 0 contributes 1
+        owner.bcast(np.arange(8.0), root=0)
+        mine = m.reduce(owner)
+        rank1, theirs = q.get(timeout=120)
+        p.join(timeout=120)
+        assert p.exitcode == 0
+    finally:
+        owner.close(unlink=True)
+    contrib = mine["counter:test_rank_contrib"]
+    assert contrib["sum"] == 3.0
+    assert contrib["min"] == 1.0 and contrib["max"] == 2.0
+    # both ranks computed the identical table
+    assert theirs["counter:test_rank_contrib"] == contrib
+    # the wired comm counters aggregated too (1 bcast leg per rank)
+    bk = 'counter:slu_comm_calls_total{op="bcast"}'
+    assert mine[bk]["sum"] >= 2.0
+
+
+def test_escalation_ladder_emits_rung_metrics(monkeypatch):
+    """A solve that climbs the recovery ladder counts its rung
+    transitions in the registry."""
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import hilbert
+    from superlu_dist_tpu.obs import metrics
+    from superlu_dist_tpu.utils.options import Options
+
+    monkeypatch.setenv("SLU_TPU_METRICS", "1")
+    metrics._reset()
+    a = hilbert(12)
+    x, lu, stats, info = gssvx(Options(), a, np.ones(a.n_rows))
+    assert info == 0
+    if stats.solve_report is not None and stats.solve_report.rungs:
+        snap = metrics.get_metrics().snapshot()
+        rung_keys = [k for k in snap["counters"]
+                     if k.startswith("slu_recovery_rungs_total")]
+        assert rung_keys, snap["counters"]
+        assert sum(snap["counters"][k] for k in rung_keys) \
+            == len(stats.solve_report.rungs)
+
+
+# ---------------------------------------------------------------------------
+# bench row: compile_seconds + census + phase_seconds (acceptance fields)
+# ---------------------------------------------------------------------------
+
+def test_bench_row_carries_compile_and_phase_fields(tmp_path):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", BENCH_NX="6",
+               BENCH_REPS="1", BENCH_NO_PROBE="1", BENCH_FORCE_CPU="1",
+               BENCH_DEADLINE_S="240",
+               SLU_TPU_FLIGHTREC=str(tmp_path / "bench_fr.json"))
+    env.pop("SLU_TPU_TRACE", None)
+    r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
+                       env=env, cwd=REPO, stdout=subprocess.PIPE,
+                       stderr=subprocess.PIPE)
+    assert r.returncode == 0, r.stderr.decode()
+    row = json.loads(r.stdout.decode().strip().splitlines()[-1])
+    assert row["value"] is not None
+    assert "compile_seconds" in row and row["compile_seconds"] >= 0
+    assert isinstance(row.get("compile_census"), list)
+    ph = row["phase_seconds"]
+    for phase in ("prepare", "factor-compile", "factor-time"):
+        assert phase in ph and ph[phase] >= 0
+    assert row["flightrec"] == str(tmp_path / "bench_fr.json")
+
+
+# ---------------------------------------------------------------------------
+# perf-regression gate: self-seeding, pass, regression (fast --row path)
+# ---------------------------------------------------------------------------
+
+def _run_gate(history, row_dict, tmp_path):
+    row_file = tmp_path / "row.json"
+    row_file.write_text(json.dumps(row_dict))
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "scripts",
+                                      "check_perf_regress.py"),
+         "--row", str(row_file), "--history", str(history)],
+        cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+
+
+def test_perf_gate_seeds_then_passes_then_fails(tmp_path):
+    hist = tmp_path / "hist.jsonl"
+    row = {"metric": "m_test", "value": 2.0, "backend": "cpu",
+           "granularity": "fused", "schedule": "dataflow",
+           "blocking": [1, 2, 3], "compile_seconds": 0.5}
+    # self-seeding: an empty history passes (acceptance for ci_gates)
+    for i in range(3):
+        r = _run_gate(hist, row, tmp_path)
+        assert r.returncode == 0, r.stderr.decode()
+        assert b"SEEDED" in r.stdout
+    # at min_samples the gate enforces — an equal value passes
+    r = _run_gate(hist, row, tmp_path)
+    assert r.returncode == 0 and b"OK" in r.stdout
+    # a large drop fails...
+    bad = dict(row, value=0.4)
+    r = _run_gate(hist, bad, tmp_path)
+    assert r.returncode == 1
+    assert b"REGRESSION" in r.stdout
+    # ...and did NOT poison the baseline (flagged gate_fail)
+    r = _run_gate(hist, row, tmp_path)
+    assert r.returncode == 0, r.stderr.decode()
+    # a different config key keeps its own (empty -> seeding) history
+    other = dict(row, backend="tpu")
+    r = _run_gate(hist, other, tmp_path)
+    assert r.returncode == 0 and b"SEEDED" in r.stdout
+
+
+def test_mfu_report_prints_compile_section(tmp_path):
+    t = trace.Tracer(str(tmp_path / "k.json"))
+    t.complete("compile stream._kernel", "compile", 0.0, 1.5,
+               key="lu b4 m32 w16 u16", n_args=11, persistent_hit=False)
+    t.complete("compile make_factor_fn", "compile", 2.0, 0.5,
+               key="fused g7 float32", n_args=2, persistent_hit=True)
+    t.close()
+    r = _run_mfu(str(tmp_path / "no.jsonl"), str(tmp_path / "k.json"))
+    assert r.returncode == 0, r.stderr
+    out = r.stdout.decode()
+    assert "compile census" in out
+    assert "lu b4 m32 w16 u16" in out and "stream._kernel" in out
+    assert "[disk hit]" in out
