@@ -104,13 +104,33 @@ def _watchdog():
         blk = COMPILE_STATS.block(top=16)
         RESULT.setdefault("compile_seconds", blk["seconds"])
         RESULT.setdefault("compile_census", blk["census"])
+        # durable frontier FIRST (persist/checkpoint.py): flush whatever
+        # the factor loop completed, record the bundle path and its
+        # resume eligibility in the row — the next BENCH run of this
+        # matrix resumes from it instead of recompiling/refactoring from
+        # zero (the BENCH_r02 n=110592 death left nothing reusable)
+        from superlu_dist_tpu.persist.checkpoint import (
+            flush_active, last_checkpoint)
+        ck = flush_active("bench-watchdog") or last_checkpoint()
+        if ck:
+            RESULT["checkpoint_path"] = ck
+            try:
+                from superlu_dist_tpu.persist.checkpoint import peek
+                meta = peek(ck)
+                RESULT["resume_eligible"] = True
+                RESULT["checkpoint_groups"] = meta.get("k")
+            except Exception:
+                RESULT["resume_eligible"] = False
+            _log(f"factor checkpoint: {ck} "
+                 f"(resume_eligible={RESULT.get('resume_eligible')})")
         from superlu_dist_tpu.obs.flightrec import get_flightrec
         fr = get_flightrec()
         if fr.enabled:
             p = fr.dump("bench-watchdog",
                         detail=f"phase={RESULT.get('phase')}",
                         extra={"phase_seconds": RESULT.get("phase_seconds"),
-                               "metric": RESULT.get("metric")})
+                               "metric": RESULT.get("metric"),
+                               "checkpoint": ck})
             _log(f"flight-recorder postmortem: {p}")
     except Exception as e:                          # pragma: no cover
         _log(f"watchdog telemetry failed: {type(e).__name__}: {e}")
@@ -427,6 +447,39 @@ def main():
         ex = _Fused()
     else:
         ex = StreamExecutor(plan, DTYPE, granularity=gran)
+    # Crash-consistent warm call (persist/checkpoint.py): checkpoint the
+    # compile/warm factorization — the phase the BENCH_r02 n=110592 run
+    # died in — so a watchdog kill leaves a durable frontier in the row,
+    # and a prior killed run's frontier (plan-fingerprint + value-digest
+    # verified) is RESUMED instead of refactoring from zero.  The timed
+    # reps below run with checkpointing disarmed: the interval flush
+    # blocks the async dispatch stream and would poison the measurement.
+    _ckpt = None
+    if gran == "group" and DTYPE != "bfloat16":
+        try:
+            from superlu_dist_tpu.persist.checkpoint import (
+                FactorCheckpointer, load_checkpoint)
+            from superlu_dist_tpu.utils.options import env_int
+            _ck_dir = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)), ".cache",
+                "bench_ckpt", RESULT["metric"])
+            try:
+                st = load_checkpoint(_ck_dir, plan=plan,
+                                     pattern_values=avals_np,
+                                     thresh=thresh_np, dtype=DTYPE)
+                ex.resume = st
+                RESULT["resumed_from_groups"] = st.k
+                _log(f"resuming factorization from checkpoint frontier "
+                     f"{st.k}/{len(plan.groups)} ({_ck_dir})")
+            except Exception:
+                pass            # no / incompatible checkpoint: fresh run
+            _ckpt = FactorCheckpointer(
+                _ck_dir, plan, avals_np, thresh_np, DTYPE,
+                every=env_int("SLU_TPU_CKPT_EVERY") or 8)
+            ex.checkpoint = _ckpt
+        except Exception as e:                      # pragma: no cover
+            _log(f"checkpoint arming failed: {type(e).__name__}: {e}")
+            _ckpt = None
     RESULT["offload"] = ex.offload
     RESULT["granularity"] = ex.granularity
     RESULT["n_kernels"] = ex.n_kernels
@@ -453,6 +506,12 @@ def main():
     _log(f"warm (compile) done, kernels={ex.n_kernels}, "
          f"offload={ex.offload}, compile {_blk['seconds']:.1f}s "
          f"({_blk['builds']} builds, {_blk['persistent_hits']} disk hits)")
+    if _ckpt is not None:
+        # the warm factorization completed: the frontier is no longer
+        # needed (and must not leak into the timed reps)
+        ex.checkpoint = None
+        _ckpt.complete(cleanup=True)
+        _ckpt = None
     if _default_cfg and NX == 48 and backend != "cpu":
         # default NX=48 set is now in .cache/jax: future default runs
         # need not downsize (self-healing, same marker the hardware

@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Crash-resume gate: kill -9 a factorization mid-run, resume, compare.
+
+The crash-consistency acceptance case (ISSUE 7 / docs/RELIABILITY.md):
+
+  1. factor the gate matrix UNINTERRUPTED (streamed executor) — the
+     reference L/U;
+  2. run the same factorization in a subprocess with interval
+     checkpointing armed (``SLU_TPU_CKPT_EVERY``) and the chaos
+     injector (``SLU_TPU_CHAOS=kill_group=K``) SIGKILL-ing the process
+     mid-factor — the kill -9 failure domain, nothing flushes at death;
+  3. assert the child died by SIGKILL and left a durable frontier
+     0 < k <= K+1 on disk;
+  4. resume via ``numeric_factorize(resume_from=...)`` (plan
+     fingerprint + value digest verified) and assert every supernode's
+     L/U panel is BITWISE identical to the uninterrupted run
+     (np.array_equal, no tolerance).
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh; a few seconds on CPU.
+Gate contract (shared with the other gates): any regression — a wrong
+exit signal, a missing/invalid checkpoint, a bitwise mismatch — raises/
+asserts, which exits non-zero with the diagnostic on stderr.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+NX = 10          # n = 1000: enough dispatch groups to kill mid-run
+
+
+def _problem():
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.utils.options import Options
+
+    a = poisson3d(NX)
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(Options(), a, sym)
+    sf = symbolic_factorize(sym, col_order)
+    plan = build_plan(sf)
+    return plan, sym.data[sf.value_perm], a.norm_max()
+
+
+def worker():
+    """The victim: factor with checkpointing armed; the env-driven chaos
+    injector SIGKILLs us mid-stream (we never reach the prints)."""
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.utils.options import env_int, env_str
+
+    plan, vals, anorm = _problem()
+    numeric_factorize(plan, vals, anorm, dtype="float64",
+                      executor="stream",
+                      ckpt_dir=env_str("SLU_TPU_CKPT_DIR"),
+                      ckpt_every=env_int("SLU_TPU_CKPT_EVERY"))
+    print("worker: factorization completed (chaos kill did NOT fire)",
+          file=sys.stderr)
+    sys.exit(7)      # distinct code: the parent must see SIGKILL instead
+
+
+def main():
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.persist.checkpoint import peek
+
+    plan, vals, anorm = _problem()
+    n_groups = len(plan.groups)
+    assert n_groups >= 4, f"gate matrix too small ({n_groups} groups)"
+    kill_group = n_groups // 2
+    print(f"crash-resume gate: {n_groups} groups, SIGKILL after group "
+          f"{kill_group}, checkpoint every 2")
+
+    ref = numeric_factorize(plan, vals, anorm, dtype="float64",
+                            executor="stream")
+
+    ck_dir = tempfile.mkdtemp(prefix="slu_crash_resume_")
+    try:
+        env = dict(os.environ,
+                   JAX_PLATFORMS="cpu", JAX_ENABLE_X64="1",
+                   SLU_TPU_CHAOS=f"kill_group={kill_group}",
+                   SLU_TPU_CKPT_DIR=ck_dir, SLU_TPU_CKPT_EVERY="2")
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--worker"],
+            env=env, cwd=REPO, capture_output=True, text=True,
+            timeout=300)
+        if r.returncode != -9:
+            print(r.stdout, file=sys.stderr)
+            print(r.stderr, file=sys.stderr)
+        assert r.returncode == -9, (
+            f"victim exited {r.returncode}, expected SIGKILL (-9) — the "
+            "chaos kill_group injection did not fire")
+
+        meta = peek(ck_dir)
+        k = int(meta["k"])
+        assert 0 < k <= kill_group + 1, (
+            f"durable frontier k={k} inconsistent with a kill after "
+            f"group {kill_group}")
+        assert k < n_groups, "frontier covers the whole plan — no crash?"
+        print(f"victim killed by SIGKILL; durable frontier k={k}")
+
+        res = numeric_factorize(plan, vals, anorm, dtype="float64",
+                                resume_from=ck_dir)
+        assert res.resumed_groups == k, (
+            f"resume restored {res.resumed_groups} groups, frontier "
+            f"says {k}")
+        mismatches = [
+            g for g, ((rl, ru), (ll, lu_)) in enumerate(
+                zip(ref.fronts, res.fronts))
+            if not (np.array_equal(np.asarray(rl), np.asarray(ll))
+                    and np.array_equal(np.asarray(ru), np.asarray(lu_)))]
+        assert not mismatches, (
+            f"resumed L/U differs bitwise from the uninterrupted run in "
+            f"group(s) {mismatches[:8]}")
+        assert res.tiny_pivots == ref.tiny_pivots, (
+            f"tiny-pivot counts diverged: resumed {res.tiny_pivots} vs "
+            f"uninterrupted {ref.tiny_pivots}")
+        print(f"resume from k={k}: all {n_groups} groups bitwise "
+              "identical to the uninterrupted run")
+        print("crash-resume gate OK")
+    finally:
+        shutil.rmtree(ck_dir, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    if "--worker" in sys.argv:
+        worker()
+    else:
+        main()

@@ -20,6 +20,10 @@
 #   perf-regress    scripts/check_perf_regress.py     micro-bench factor
 #                   GFLOP/s vs the bench-history median (noise-tolerant,
 #                   self-seeding on an empty history)
+#   crash-resume    scripts/check_crash_resume.py     kill -9 a
+#                   factorization mid-run, resume from the durable
+#                   checkpoint frontier, assert bitwise-identical L/U
+#                   vs an uninterrupted run
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -41,9 +45,10 @@ declare -A GATES=(
   [verify-overhead]="python scripts/check_verify_overhead.py"
   [schedule-equiv]="python scripts/check_schedule_equiv.py"
   [perf-regress]="python scripts/check_perf_regress.py"
+  [crash-resume]="python scripts/check_crash_resume.py"
 )
-ORDER=(slulint verify-overhead schedule-equiv trace-overhead nan-guards
-       perf-regress)
+ORDER=(slulint verify-overhead schedule-equiv crash-resume trace-overhead
+       nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

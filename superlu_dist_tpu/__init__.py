@@ -51,6 +51,11 @@ def __getattr__(name):
         import importlib
         mod = importlib.import_module("superlu_dist_tpu.io.readers")
         return mod.read_matrix
+    if name in ("save_lu", "load_lu"):
+        # crash-consistent handle persistence (docs/RELIABILITY.md)
+        import importlib
+        mod = importlib.import_module("superlu_dist_tpu.persist")
+        return getattr(mod, name)
     raise AttributeError(name)
 
 __version__ = "0.1.0"

@@ -330,7 +330,9 @@ def analyze(options: Options, a: SparseCSR,
 
 
 def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
-                      stats: Stats | None = None, grid=None) -> int:
+                      stats: Stats | None = None, grid=None,
+                      resume_from: str | None = None,
+                      deadline_comm=None) -> int:
     """Numeric factorization (pdgssvx.c:1176 → pdgstrf, SRC/pdgstrf.c:243)
     on an analyzed skeleton from `analyze`.
 
@@ -338,7 +340,15 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     when that mesh spans multiple processes this is an SPMD collective
     every rank must enter with the SAME skeleton and values (the
     distributed-factors tier broadcasts them first).  Fills lu.numeric in
-    place; returns info (0, or 1-based first zero-pivot column)."""
+    place; returns info (0, or 1-based first zero-pivot column).
+
+    Crash consistency (docs/RELIABILITY.md): ``Options.ckpt_every`` arms
+    mid-factor frontier checkpoints; ``resume_from`` restarts from a
+    durable checkpoint instead of from scratch (recorded on
+    ``stats.resume`` and as a SolveReport rung by the solve tail);
+    ``Options.deadline_s`` bounds the factor loop, with ``deadline_comm``
+    (a TreeComm on the distributed tier) making expiry a collective
+    decision so cancellation can never strand a rank in a collective."""
     if stats is None:
         stats = Stats()
     options = lu.options
@@ -350,8 +360,21 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     dtype = options.factor_dtype or default_factor_dtype()
     if np.issubdtype(np.asarray(bvals).dtype, np.complexfloating):
         dtype = {"float32": "complex64", "float64": "complex128"}.get(str(dtype), dtype)
+    deadline = None
+    if options.deadline_s:
+        from superlu_dist_tpu.utils.deadline import Deadline
+        from superlu_dist_tpu.utils.options import env_int
+        deadline = Deadline(options.deadline_s, comm=deadline_comm,
+                            poll_every=env_int("SLU_TPU_DEADLINE_POLL"))
+    # checkpoints need a single-process pool boundary; the multi-process
+    # mesh shards it, so only the deadline travels onto the grid tier
+    want_ckpt = options.ckpt_every > 0 and grid is None
     with stats.timer("FACT"):
         if str(dtype) == "df64":
+            if resume_from:
+                raise SuperLUError(
+                    "resume_from is not supported for df64 factorization "
+                    "(its factor loop has no checkpoint boundaries yet)")
             # emulated-double factorization for f32-only hardware (true
             # ~2^-48 factors; SURVEY.md §7 hard-part 1), real AND complex
             # (zdf64, the pzgstrf twin — SRC/pzgstrf.c:243); host
@@ -371,7 +394,11 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
                 replace_tiny=options.replace_tiny_pivot,
                 mesh=grid.mesh if grid is not None else None,
                 pool_partition=options.pool_partition,
-                check_finite=options.recovery.sentinels)
+                check_finite=options.recovery.sentinels,
+                ckpt_dir=(options.ckpt_dir or None) if want_ckpt else None,
+                ckpt_every=options.ckpt_every if want_ckpt else 0,
+                resume_from=resume_from,
+                deadline=deadline)
         for lp, up in numeric.fronts:
             if hasattr(lp, "block_until_ready"):
                 lp.block_until_ready()
@@ -407,6 +434,12 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     stats.for_lu_bytes = space["for_lu_bytes"]
     stats.pool_bytes = space["pool_bytes"]
 
+    if getattr(numeric, "resumed_groups", 0):
+        # resume telemetry: surfaced in the Stats report and recorded as
+        # an escalation-ladder rung on the SolveReport by the solve tail
+        stats.resume = {"groups": int(numeric.resumed_groups),
+                        "of": len(plan.groups),
+                        "path": str(resume_from)}
     lu.numeric = numeric
     lu.mesh = grid.mesh if grid is not None else None
     # invalidate solve-side caches from any prior factorization the
@@ -422,7 +455,7 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
 
 def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
           lu: LUFactorization | None = None, stats: Stats | None = None,
-          grid=None):
+          grid=None, resume_from: str | None = None):
     """Solve A·X = B.  Returns (x, lu, stats, info).
 
     info = 0 on success; > 0 mirrors the reference's singularity reporting
@@ -432,6 +465,16 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
     `grid` is a parallel.grid.ProcessGrid (the reference passes gridinfo_t
     to pdgssvx): the numeric factorization and device solve then run
     sharded over the grid's mesh.
+
+    `resume_from` names a factor checkpoint (persist/checkpoint.py —
+    written by a prior run that died mid-factorization under
+    Options.ckpt_every, a deadline, or SIGTERM): the analysis re-runs
+    (cheap, deterministic), the checkpoint's plan fingerprint and value
+    digest are verified against it, and the numeric factorization
+    restarts from the durable frontier instead of from scratch — the
+    factors come out bitwise-identical to an uninterrupted run.  The
+    resume is recorded on stats.resume and as a 'resume-from-checkpoint'
+    rung in the SolveReport ladder.
     """
     if stats is None:
         stats = Stats()
@@ -450,7 +493,8 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         return _solve_and_refine(options, a, b, lu, stats)
 
     lu, bvals, stats = analyze(options, a, lu=lu, stats=stats)
-    info = factorize_numeric(lu, bvals, stats, grid=grid)
+    info = factorize_numeric(lu, bvals, stats, grid=grid,
+                             resume_from=resume_from)
     if info != 0:
         return None, lu, stats, info
     return _solve_and_refine(options, a, b, lu, stats)
@@ -718,6 +762,14 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
     info = 0
     report = SolveReport(factor_dtype=str(lu.numeric.dtype),
                          tiny_pivots=lu.numeric.tiny_pivots)
+    if stats.resume:
+        # a factorization resumed from a durable checkpoint is a ladder
+        # action in its own right: the report must show the answer rests
+        # partly on restored state (and where that state came from)
+        report.rungs.append(RungRecord(
+            name="resume-from-checkpoint",
+            detail=f"{stats.resume['groups']}/{stats.resume['of']} groups "
+                   f"from {stats.resume['path']}"))
     stats.solve_report = report
     recovery = options.recovery
     if options.iter_refine != IterRefine.NOREFINE:

@@ -123,6 +123,9 @@ class NumericFactorization:
                               # info>0 = first i with U(i,i)==0
                               # (pdgstrf.c:1920-1924, Allreduce MIN)
     host_fronts: list = None  # lazily pulled numpy copies for the host solve
+    resumed_groups: int = 0   # dispatch groups restored from a durable
+                              # checkpoint frontier instead of recomputed
+                              # (persist/checkpoint.py; 0 = fresh run)
 
     @property
     def on_host(self) -> bool:
@@ -304,7 +307,11 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       executor: str = "auto",
                       mesh=None,
                       pool_partition: bool = False,
-                      check_finite: bool = True) -> NumericFactorization:
+                      check_finite: bool = True,
+                      ckpt_dir: str | None = None,
+                      ckpt_every: int = 0,
+                      resume_from: str | None = None,
+                      deadline=None) -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
@@ -319,6 +326,17 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     expected singularity), so the cheap isfinite reductions below trip a
     structured NumericBreakdownError naming the offending supernode
     instead of letting NaN propagate through every later front.
+
+    Crash consistency (persist/, docs/RELIABILITY.md): ``ckpt_every`` /
+    ``ckpt_dir`` arm a FactorCheckpointer flushing the completed-group
+    frontier every K groups (and on breakdown/deadline/SIGTERM);
+    ``resume_from`` loads a checkpoint, verifies its plan fingerprint
+    AND value digest against THIS call's inputs, and restarts the
+    stream from the durable frontier — bitwise-identical factors to an
+    uninterrupted run.  ``deadline`` is a utils.deadline.Deadline
+    polled between dispatch groups.  Checkpointing/resume have group
+    boundaries only on the streamed executor, so arming them forces
+    ``executor="stream"``.
     """
     dtype = jnp.dtype(dtype)
     real_dtype = jnp.dtype(dtype).type(0).real.dtype
@@ -334,14 +352,60 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     thresh = jnp.asarray(
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)
+    # failure-domain chaos injection (testing/chaos.py, SLU_TPU_CHAOS):
+    # the NaN poke rewrites the values BEFORE the checkpointer latches
+    # its value digest, so a frontier computed from poisoned values can
+    # never be resumed against clean ones
+    from superlu_dist_tpu.testing.chaos import get_chaos
+    chaos = get_chaos()
+    if chaos is not None:
+        pattern_values = chaos.poke_nan(plan, pattern_values)
+    ckpt = None
+    want_ckpt = bool(ckpt_dir) or ckpt_every > 0
+    if want_ckpt or resume_from:
+        # only the streamed executor has per-group boundaries
+        if executor in ("auto", "fused"):
+            executor = "stream"
+    if want_ckpt:
+        from superlu_dist_tpu.persist.checkpoint import FactorCheckpointer
+        ckpt = FactorCheckpointer(ckpt_dir or ".slu_ckpt", plan,
+                                  pattern_values, thresh, dtype,
+                                  every=int(ckpt_every))
+    resume = None
+    if resume_from:
+        from superlu_dist_tpu.persist.checkpoint import load_checkpoint
+        resume = load_checkpoint(resume_from, plan=plan,
+                                 pattern_values=pattern_values,
+                                 thresh=thresh, dtype=dtype)
     avals = jnp.asarray(pattern_values, dtype=dtype)
     fn = get_executor(plan, dtype, executor, mesh=mesh,
                       pool_partition=pool_partition)
     if hasattr(fn, "check_finite"):
         # streamed executor: also sentinel each offloaded group as it
-        # lands on the host (early abort — see stream._emit_front)
+        # lands on the host (early abort — see stream._emit_front),
+        # plus the crash-consistency hooks (one-shot resume state)
         fn.check_finite = bool(check_finite and replace_tiny)
-    fronts_out, tiny_total = fn(avals, thresh)
+        fn.checkpoint = ckpt
+        fn.resume = resume
+        fn.deadline = deadline
+        fn.chaos = chaos
+    elif deadline is not None:
+        # fused executor: one dispatch, so the only boundaries are
+        # before/after the whole program
+        deadline.poll(where="fused factorization")
+    try:
+        fronts_out, tiny_total = fn(avals, thresh)
+    except BaseException:
+        if ckpt is not None:
+            # keep the flushed frontier on disk but deregister — a later
+            # factorization's SIGTERM flush must not resurrect stale refs
+            ckpt.complete(cleanup=False)
+        raise
+    finally:
+        if hasattr(fn, "check_finite"):
+            # the hooks are per-call state; a reused executor must not
+            # carry them into the next factorization
+            fn.checkpoint = fn.resume = fn.deadline = fn.chaos = None
     fronts_out = list(fronts_out)
     finite = True
     info_col = -1
@@ -350,11 +414,23 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     elif check_finite and not fronts_finite(fronts_out):
         from superlu_dist_tpu.utils.errors import NumericBreakdownError
         sn, col = localize_nonfinite(plan, fronts_out)
-        raise NumericBreakdownError(supernode=sn, col=col,
+        ck_path = None
+        if ckpt is not None:
+            ck_path = ckpt.flush_latest("numeric-breakdown")
+            ckpt.complete(cleanup=False)
+        err = NumericBreakdownError(supernode=sn, col=col,
                                     where="numeric factorization")
+        err.checkpoint_path = ck_path
+        raise err
+    if ckpt is not None:
+        # completed: the durable artifact of a finished factorization is
+        # the saved handle (persist.save_lu), not a stale frontier
+        ckpt.complete(cleanup=True)
     return NumericFactorization(plan=plan, fronts=fronts_out,
                                 tiny_pivots=int(tiny_total), dtype=dtype,
-                                finite=finite, info_col=info_col)
+                                finite=finite, info_col=info_col,
+                                resumed_groups=(resume.k if resume is not None
+                                                else 0))
 
 
 def fronts_finite(fronts) -> bool:

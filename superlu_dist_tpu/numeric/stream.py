@@ -208,6 +208,17 @@ class StreamExecutor:
         # isfinite-checked so a breakdown aborts the stream at the
         # offending supernode instead of NaN-ing the remaining levels
         self.check_finite = False
+        # crash-consistency hooks (set per call by numeric_factorize,
+        # docs/RELIABILITY.md): a persist.checkpoint.FactorCheckpointer
+        # noting every completed group, a persist.checkpoint.ResumeState
+        # splicing a durable frontier in (consumed one-shot), a
+        # utils.deadline.Deadline polled between dispatch groups, and a
+        # testing.chaos.ChaosMonkey injector — all None on the
+        # production fast path (one `is None` test per group each)
+        self.checkpoint = None
+        self.resume = None
+        self.deadline = None
+        self.chaos = None
         # retrace sentinel state (see RetraceSentinel): first call warms
         # the kernel caches; later calls must build nothing new
         self._warmed = False
@@ -389,8 +400,22 @@ class StreamExecutor:
         on_host_now, avals, thresh, pool = self._host_prologue(
             avals, thresh, pool)
         tiny_host = 0
+        # checkpoint resume: splice a durable frontier in — the first
+        # `start` groups' panels come from the checkpoint and the pool
+        # restarts from the saved boundary state, so the remaining
+        # groups run the IDENTICAL arithmetic an uninterrupted run
+        # would (bitwise; scripts/check_crash_resume.py pins it)
+        resume, self.resume = self.resume, None
+        start = tiny_resumed = 0
+        if resume is not None:
+            start, fronts, pool, tiny_resumed = self._apply_resume(
+                resume, pool)
         for gi, (key, a, child_arrs, nreal, on_host) in \
                 enumerate(self._steps):
+            if gi < start:
+                continue
+            if self.deadline is not None:
+                self._deadline_poll("streamed factorization")
             if on_host_now and not on_host:
                 tiny_host, pool = self._host_handoff(tiny, pool)
                 tiny = jnp.zeros((), jnp.int32)
@@ -437,7 +462,14 @@ class StreamExecutor:
                                    grp.batch, on_host)
             self._emit_front(fronts, lp, up, nreal, on_host)
             tiny = tiny + t
-        tiny = tiny + tiny_host
+            if self.checkpoint is not None:
+                # frontier bookkeeping (interval flushes inside note);
+                # BEFORE the chaos hook so an injected kill at group gi
+                # leaves gi's interval checkpoint durable
+                self.checkpoint.note(gi, fronts, pool, tiny)
+            if self.chaos is not None:
+                self.chaos.on_group(gi)
+        tiny = tiny + tiny_host + tiny_resumed
         # dispatch-gap instrumentation (the PROFlevel comm-split analog,
         # pdgstrf.c:1930-1951): time spent ISSUING the async stream.  If
         # this approaches the end-to-end factor time, the run is
@@ -446,6 +478,38 @@ class StreamExecutor:
         self.last_offload_wait_seconds = self._offload_wait
         self._retrace_end(builds0)
         return self._finalize_fronts(fronts), tiny
+
+    def _apply_resume(self, resume, pool):
+        """Validate and splice a ResumeState: returns (start, fronts,
+        pool, tiny_resumed).  Mesh-sharded and host-share runs have no
+        single durable pool boundary to restore into — refused."""
+        from superlu_dist_tpu.utils.errors import SuperLUError
+        if self.mesh is not None or self._host_levels:
+            raise SuperLUError(
+                "checkpoint resume is not supported on a mesh-sharded "
+                "or host-share factorization — refactor from scratch")
+        start = int(resume.k)
+        if start > len(self._steps):
+            raise SuperLUError(
+                f"resume frontier k={start} exceeds this plan's "
+                f"{len(self._steps)} groups")
+        fronts = [(lp, up) for lp, up in resume.fronts]
+        pool = jnp.asarray(resume.pool, dtype=self.dtype)
+        if self.checkpoint is not None:
+            self.checkpoint.tiny_base = int(resume.tiny)
+        return start, fronts, pool, int(resume.tiny)
+
+    def _deadline_poll(self, where: str) -> None:
+        """Cooperative deadline check at a group boundary: the latest
+        consistent frontier is flushed BEFORE the structured raise, so
+        cancellation always leaves a resumable checkpoint behind (and
+        on the multi-rank path the poll's flag allreduce makes the
+        raise collective — see utils/deadline.py)."""
+        ck = self.checkpoint
+        self.deadline.poll(
+            where=where,
+            on_expire=(None if ck is None
+                       else (lambda: ck.flush_latest("deadline"))))
 
     def _retrace_begin(self) -> int:
         """Kernel-build counter snapshot (per granularity's cache)."""
@@ -556,8 +620,15 @@ class StreamExecutor:
         nf |= ~np.isfinite(up.reshape(lp.shape[0], -1)).all(axis=1)
         sns = np.asarray(grp.sns)[np.nonzero(nf)[0]]
         sn = int(sns[np.argmin(sn_start[sns])])
-        raise NumericBreakdownError(supernode=sn, col=int(sn_start[sn]),
+        # durability before diagnosis: flush the latest consistent
+        # frontier FIRST, so the error construction's flight-recorder
+        # dump can reference the checkpoint it left behind
+        ck_path = (self.checkpoint.flush_latest("numeric-breakdown")
+                   if self.checkpoint is not None else None)
+        err = NumericBreakdownError(supernode=sn, col=int(sn_start[sn]),
                                     where="streamed factorization")
+        err.checkpoint_path = ck_path
+        raise err
 
     def _finalize_fronts(self, fronts):
         if self.offload == "host" or self._n_host_groups:
@@ -575,6 +646,11 @@ class StreamExecutor:
         """Level-granularity execution: one dispatch per elimination
         level (see __init__)."""
         import itertools
+        if self.resume is not None:
+            from superlu_dist_tpu.utils.errors import SuperLUError
+            raise SuperLUError(
+                "checkpoint resume requires granularity='group' (the "
+                "level-traced programs have no per-group entry points)")
         plan = self.plan
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
@@ -585,6 +661,8 @@ class StreamExecutor:
         tiny_host = 0
         for level, chunk in itertools.groupby(pairs,
                                               key=lambda p: p[0].level):
+            if self.deadline is not None:
+                self._deadline_poll("streamed factorization")
             chunk = list(chunk)
             entries = tuple(step for _, step in chunk)
             lv_host = entries[0][4]
@@ -644,6 +722,16 @@ class StreamExecutor:
                     structural=gflop * 1e9)
             for (grp, (_, _, _, nreal, g_host)), (lp, up) in zip(chunk, outs):
                 self._emit_front(fronts, lp, up, nreal, g_host)
+            if fronts:
+                # wave boundary: the pool now corresponds exactly to the
+                # frontier len(fronts) — the only consistent checkpoint
+                # boundary this granularity has (group-mode resume can
+                # still consume it: frontiers are group-aligned)
+                if self.checkpoint is not None:
+                    self.checkpoint.note(len(fronts) - 1, fronts, pool,
+                                         tiny)
+                if self.chaos is not None:
+                    self.chaos.on_group(len(fronts) - 1)
         self.last_offload_wait_seconds = self._offload_wait
         self._retrace_end(builds0)
         return self._finalize_fronts(fronts), tiny + tiny_host

@@ -217,6 +217,18 @@ class FlightRecorder:
                     doc["metrics"] = m.snapshot()
             except Exception:
                 pass
+            try:
+                # crash-consistency cross-reference: the checkpoint this
+                # process flushed most recently (persist/checkpoint.py) —
+                # a postmortem reader goes straight from the dump to the
+                # resumable frontier
+                from superlu_dist_tpu.persist.checkpoint import (
+                    last_checkpoint)
+                ck = last_checkpoint()
+                if ck:
+                    doc["checkpoint"] = ck
+            except Exception:
+                pass
             if extra:
                 doc["extra"] = extra
             parent = os.path.dirname(os.path.abspath(self.dump_path))
@@ -243,16 +255,29 @@ def _looks_like_path(value: str) -> bool:
 
 
 def _arm_sigterm(fr: FlightRecorder) -> None:
-    """Dump on SIGTERM, then defer to the previous disposition.  Only
-    possible from the main thread; silently skipped elsewhere."""
+    """On SIGTERM: flush any active factor checkpoint FIRST (so the dump
+    below can reference the frontier it left behind), dump the ring,
+    then defer to the previous disposition — a previously-installed
+    Python handler is CHAINED (it still runs), SIG_IGN is respected
+    (the process chose to ignore SIGTERM; hijacking that into a kill
+    would change semantics), and only the default disposition re-raises
+    the fatal signal.  Only possible from the main thread; silently
+    skipped elsewhere."""
     try:
         import signal
         prev = signal.getsignal(signal.SIGTERM)
 
         def handler(signum, frame):
+            try:
+                from superlu_dist_tpu.persist.checkpoint import flush_active
+                flush_active("SIGTERM")
+            except Exception:
+                pass
             fr.dump("SIGTERM")
             if callable(prev):
                 prev(signum, frame)
+            elif prev is signal.SIG_IGN:
+                return
             else:
                 signal.signal(signal.SIGTERM, signal.SIG_DFL)
                 os.kill(os.getpid(), signal.SIGTERM)

@@ -471,7 +471,13 @@ def _pgssvx_mesh(tc, options, a_loc, b2, grid, one_d, wdtype,
         # tiers inside analyze
         lu, bvals = root_analyze_bcast(tc, opts0, a_loc, stats, lu=lu)
     if fact != Fact.FACTORED:
-        info_r = factorize_numeric(lu, bvals, stats, grid=grid)
+        # deadline_comm=tc: Options.deadline_s expiry becomes a
+        # COLLECTIVE decision (flag allreduce per poll inside the factor
+        # loop, utils/deadline.py), so DeadlineExceededError raises on
+        # every rank together — cancellation can never strand a peer in
+        # a collective (the SLU101/SLU106 discipline)
+        info_r = factorize_numeric(lu, bvals, stats, grid=grid,
+                                   deadline_comm=tc)
     if lu_out is not None:
         lu_out["lu"] = lu
         lu_out["stats"] = stats

@@ -1,0 +1,31 @@
+"""Crash-consistent persistence of solver state.
+
+The reference solver's value proposition is "factor once, solve many"
+(PAPER.md GESP pipeline) — but a factorization held only in process
+memory dies with the process.  This package makes the two expensive
+artifacts durable:
+
+* :mod:`superlu_dist_tpu.persist.serial` — versioned, integrity-checked
+  serialization of a full :class:`LUFactorization` handle (symbolic
+  fact, :class:`FactorPlan` schedule, transforms, numeric L/U factors),
+  so a warmed serving process can ``load_lu`` and go straight to solve;
+* :mod:`superlu_dist_tpu.persist.checkpoint` — mid-factorization
+  checkpoints of the completed-group frontier, written every
+  ``SLU_TPU_CKPT_EVERY`` groups and on breakdown/SIGTERM/deadline, from
+  which ``gssvx(resume_from=...)`` restarts instead of refactoring from
+  scratch.
+
+Both use the same bundle format: a directory of ``.npy`` array files
+plus a ``MANIFEST.json`` carrying a format version and a per-array
+sha256 digest, every file written atomically (tmp + rename, manifest
+last) so a crash mid-write always leaves the previous consistent state.
+Format rules and the resume semantics are documented in
+docs/RELIABILITY.md.
+"""
+
+from superlu_dist_tpu.persist.serial import (          # noqa: F401
+    FORMAT_VERSION, save_lu, load_lu, write_bundle, read_bundle,
+    plan_fingerprint, values_digest)
+from superlu_dist_tpu.persist.checkpoint import (      # noqa: F401
+    FactorCheckpointer, ResumeState, load_checkpoint, flush_active,
+    last_checkpoint)

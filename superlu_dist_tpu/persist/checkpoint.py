@@ -1,0 +1,283 @@
+"""Mid-factorization checkpoints: the durable completed-group frontier.
+
+The streamed factor loop (numeric/stream.py) completes one dispatch
+group at a time; everything up to a group boundary is a deterministic
+function of (plan, values, threshold, dtype).  A checkpoint therefore
+is: the factored ``(lpanel, upanel)`` pairs of the first ``k`` groups
+plus the Schur pool AS OF that boundary — resuming re-runs groups
+``k..`` with the restored pool and produces BITWISE-identical factors
+to an uninterrupted run (scripts/check_crash_resume.py proves it with
+a kill -9).
+
+Write policy:
+
+* every ``SLU_TPU_CKPT_EVERY`` completed groups (``Options.ckpt_every``)
+  — the durable-interval tier; this blocks the async dispatch stream to
+  materialize the pool, which is the price of durability (size the
+  interval accordingly);
+* on :class:`NumericBreakdownError` / cooperative-deadline expiry — the
+  factor loop flushes the latest consistent frontier before raising
+  (for a breakdown the frontier may INCLUDE the contaminated group:
+  checkpoints promise crash-consistency, not numerical validity, and a
+  resume against unchanged inputs deterministically reproduces the
+  breakdown — while changed inputs are refused by the value digest);
+* on SIGTERM / the bench watchdog — best-effort via
+  :func:`flush_active`: if the signal lands mid-dispatch the live pool
+  buffer may already be donated to the in-flight kernel, in which case
+  the last interval checkpoint stands as the durable frontier.
+
+Front artifacts are immutable once written (``front_00012_l.npy`` never
+changes), so an advancing checkpoint only writes the NEW groups plus
+the pool and manifest — the manifest replace is the commit point
+(persist/serial.py crash-consistency rules).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+
+import numpy as np
+
+from superlu_dist_tpu.persist import serial
+from superlu_dist_tpu.utils.errors import (
+    CheckpointError, CheckpointMismatchError)
+
+# process-wide registry of live checkpointers (for signal/watchdog
+# flushes) and the most recently committed checkpoint path (for
+# flight-recorder postmortems to reference)
+_ACTIVE: list = []
+_LAST_PATH: list = []
+_REG_LOCK = threading.Lock()
+
+
+@dataclasses.dataclass
+class ResumeState:
+    """A loaded checkpoint, ready to splice into the factor loop."""
+
+    k: int                    # completed dispatch groups
+    fronts: list              # k (lpanel, upanel) numpy pairs
+    pool: np.ndarray          # Schur pool at the frontier
+    tiny: int                 # tiny-pivot count over the first k groups
+    meta: dict                # the bundle's manifest meta block
+    path: str = ""
+
+
+class FactorCheckpointer:
+    """Checkpoint writer bound to ONE factorization's identity.
+
+    Constructed by the driver when ``Options.ckpt_every > 0`` and handed
+    to the streamed executor, which calls :meth:`note` after every
+    completed group.  ``every=0`` disables interval flushes but keeps
+    the breakdown/deadline/signal flush paths armed.
+    """
+
+    def __init__(self, dirpath: str, plan, pattern_values, thresh, dtype,
+                 every: int = 0):
+        self.dirpath = os.path.abspath(dirpath)
+        os.makedirs(self.dirpath, exist_ok=True)
+        self.every = int(every)
+        self.plan = plan
+        self.n_groups = len(plan.groups)
+        self.plan_fp = serial.plan_fingerprint(plan)
+        self.values_fp = serial.values_digest(pattern_values, dtype, thresh)
+        self.dtype = serial.dtype_str(dtype)
+        self._entries: dict = {}      # manifest entries carried across
+                                      # flushes (front files are immutable)
+        self._host: list = []         # numpy copies of fronts already saved
+        self._latest = None           # (gi, fronts, pool, tiny) live refs
+        self.tiny_base = 0            # tiny count carried in from a
+                                      # resumed frontier (executor sets it)
+        self._flushed_k = -1
+        self._lock = threading.Lock()
+        self.last_path = None
+        self.flushes = 0
+        with _REG_LOCK:
+            _ACTIVE.append(self)
+        _arm_sigterm_once()
+
+    # ---- executor-facing hooks -----------------------------------------
+    def note(self, gi: int, fronts, pool, tiny) -> None:
+        """Group ``gi`` just completed.  Cheap: rebinds the live refs;
+        flushes only on the interval boundary."""
+        self._latest = (gi, fronts, pool, tiny)
+        if self.every and (gi + 1) % self.every == 0:
+            self.flush(gi + 1, fronts, pool, tiny, reason="interval")
+
+    def flush(self, k: int, fronts, pool, tiny, reason: str) -> str:
+        """Commit frontier ``k`` (the first ``k`` groups are durable).
+        Blocks until the pool and any device-resident panels are
+        materialized.  Returns the bundle path."""
+        with self._lock:
+            while len(self._host) < k:
+                lp, up = fronts[len(self._host)]
+                self._host.append((np.asarray(lp), np.asarray(up)))
+            pool_np = np.asarray(pool)
+            for g in range(k):
+                lp, up = self._host[g]
+                serial.write_array(self.dirpath, f"front_{g:05d}_l", lp,
+                                   self._entries, skip_existing=True)
+                serial.write_array(self.dirpath, f"front_{g:05d}_u", up,
+                                   self._entries, skip_existing=True)
+            serial.write_array(self.dirpath, "pool", pool_np, self._entries)
+            meta = {
+                "k": int(k),
+                "n_groups": self.n_groups,
+                "tiny": int(tiny) + self.tiny_base,
+                "factor_dtype": self.dtype,
+                "plan_fingerprint": self.plan_fp,
+                "values_digest": self.values_fp,
+                "reason": reason,
+            }
+            path = serial.write_manifest(self.dirpath, "factor_checkpoint",
+                                         meta, self._entries)
+            self._flushed_k = k
+            self.flushes += 1
+            self.last_path = path
+            with _REG_LOCK:
+                _LAST_PATH[:] = [path]
+            return path
+
+    def flush_latest(self, reason: str) -> str | None:
+        """Best-effort flush of the most recent completed frontier (for
+        signal handlers / watchdogs).  Never raises; returns the bundle
+        path, the previous durable path if nothing new could be written,
+        or None when no frontier exists at all."""
+        latest = self._latest
+        try:
+            if latest is None:
+                return self.last_path
+            gi, fronts, pool, tiny = latest
+            if gi + 1 <= self._flushed_k:
+                return self.last_path       # nothing newer than on disk
+            return self.flush(gi + 1, fronts, pool, tiny, reason=reason)
+        except Exception:
+            # e.g. the pool buffer was donated to an in-flight kernel —
+            # the last interval checkpoint stands
+            return self.last_path
+
+    def complete(self, cleanup: bool = True) -> None:
+        """The factorization finished: deregister, and by default remove
+        the checkpoint (the durable artifact of a COMPLETED run is the
+        saved handle, persist.save_lu — a stale mid-factor frontier
+        would only invite resuming work that already finished)."""
+        with _REG_LOCK:
+            if self in _ACTIVE:
+                _ACTIVE.remove(self)
+        self._latest = None
+        if cleanup and self.last_path:
+            import shutil
+            shutil.rmtree(self.dirpath, ignore_errors=True)
+            with _REG_LOCK:
+                if _LAST_PATH and _LAST_PATH[0] == self.last_path:
+                    _LAST_PATH[:] = []
+            self.last_path = None
+
+
+# ---------------------------------------------------------------------------
+# process-wide flush / query (signal handlers, watchdogs, postmortems)
+# ---------------------------------------------------------------------------
+
+def flush_active(reason: str) -> str | None:
+    """Flush every live checkpointer's latest frontier (best-effort;
+    never raises).  Returns the last committed path, or None."""
+    path = None
+    with _REG_LOCK:
+        active = list(_ACTIVE)
+    for ck in active:
+        p = ck.flush_latest(reason)
+        path = p or path
+    return path
+
+
+def last_checkpoint() -> str | None:
+    """Path of the most recently committed checkpoint in this process
+    (referenced by flight-recorder dumps), or None."""
+    with _REG_LOCK:
+        return _LAST_PATH[0] if _LAST_PATH else None
+
+
+_sigterm_armed = []
+
+
+def _arm_sigterm_once() -> None:
+    """Chain a SIGTERM disposition that flushes active checkpointers
+    before delegating to whatever handler was installed previously
+    (flight recorder, user code, or the default kill).  Main-thread
+    only; silently skipped elsewhere."""
+    if _sigterm_armed:
+        return
+    try:
+        import signal
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def handler(signum, frame):
+            flush_active("SIGTERM")
+            if callable(prev):
+                prev(signum, frame)
+            elif prev is signal.SIG_IGN:
+                return          # the process chose to ignore SIGTERM
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, handler)
+        _sigterm_armed.append(True)
+    except (ValueError, OSError, RuntimeError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# loading / resume
+# ---------------------------------------------------------------------------
+
+def peek(dirpath: str) -> dict:
+    """Manifest meta of a checkpoint without loading its arrays (for
+    resume-eligibility checks, e.g. the bench watchdog row)."""
+    return serial.read_manifest(dirpath, kind="factor_checkpoint")["meta"]
+
+
+def load_checkpoint(dirpath: str, plan=None, pattern_values=None,
+                    thresh=None, dtype=None) -> ResumeState:
+    """Load and verify a factor checkpoint.
+
+    With ``plan``/``pattern_values``/``thresh``/``dtype`` given, the
+    checkpoint's identity fingerprints must match — a frontier computed
+    from a different schedule or different values must never be spliced
+    into this run (:class:`CheckpointMismatchError`).  Every artifact is
+    digest-verified on read (corruption/truncation raise
+    :class:`CheckpointCorruptError`, never garbage factors)."""
+    doc = serial.read_manifest(dirpath, kind="factor_checkpoint")
+    meta = doc["meta"]
+    k = int(meta["k"])
+    if plan is not None:
+        fp = serial.plan_fingerprint(plan)
+        if fp != meta["plan_fingerprint"]:
+            raise CheckpointMismatchError(
+                f"checkpoint at {dirpath!r} was written for a different "
+                "factorization plan (schedule/bucket/amalgamation knobs "
+                "or the sparsity pattern changed) — refactor from "
+                "scratch instead of resuming")
+        if k > len(plan.groups):
+            raise CheckpointError(
+                f"checkpoint frontier k={k} exceeds the plan's "
+                f"{len(plan.groups)} groups")
+    if pattern_values is not None:
+        if dtype is None or thresh is None:
+            raise CheckpointError(
+                "value verification needs dtype and thresh alongside "
+                "pattern_values")
+        vd = serial.values_digest(pattern_values, dtype, thresh)
+        if vd != meta["values_digest"]:
+            raise CheckpointMismatchError(
+                f"checkpoint at {dirpath!r} was computed from different "
+                "numeric values (or dtype/threshold) — resuming would "
+                "splice stale panels; refactor instead")
+    fronts = [(serial.read_array(dirpath, f"front_{g:05d}_l", doc),
+               serial.read_array(dirpath, f"front_{g:05d}_u", doc))
+              for g in range(k)]
+    pool = serial.read_array(dirpath, "pool", doc)
+    return ResumeState(k=k, fronts=fronts, pool=pool,
+                       tiny=int(meta["tiny"]), meta=meta,
+                       path=os.path.abspath(dirpath))

@@ -1,0 +1,272 @@
+"""Failure-domain chaos harness — deterministic whole-domain injection.
+
+PR 1's :class:`FaultyTreeComm` perturbs the comm TRANSPORT (chunk
+drop/dup/reorder with retries); this module generalizes the idea to the
+failure domains a serving fleet actually loses: a process killed
+mid-factorization, a numeric value going NaN at a chosen supernode, a
+checkpoint artifact corrupted on disk, a rank dying mid-protocol.  Every
+injection is a deterministic function of the spec — no randomness races
+— so a chaos test either reproduces exactly or the code under test
+changed.
+
+Enable in a victim process via the registered env knob::
+
+    SLU_TPU_CHAOS='kill_group=5'            # SIGKILL self after group 5
+    SLU_TPU_CHAOS='kill_group=5,signal=term'  # SIGTERM instead (exercises
+                                              # the checkpoint/flightrec
+                                              # SIGTERM chain)
+    SLU_TPU_CHAOS='nan_supernode=3'         # poison supernode 3's values
+
+The factor path consults :func:`get_chaos` once per factorization
+(numeric/factor.py) and the streamed executor calls
+:meth:`ChaosMonkey.on_group` after each completed dispatch group — a
+no-op None when the knob is unset, so the production hot path pays one
+``is None`` test.
+
+Helpers for tests that inject from OUTSIDE the victim:
+
+* :func:`corrupt_file` — deterministic bit-flip / truncation of a
+  checkpoint artifact (drives the persist integrity paths);
+* :class:`DyingTreeComm` — a rank that exits mid-protocol after N
+  public collectives (simulated rank death);
+* :class:`HangWatchdog` — bounds a lost-peer hang: dump the flight
+  recorder and ``os._exit`` after a timeout unless disarmed (the
+  cooperative way a serving process converts an infinite collective
+  hang into a bounded, diagnosable abort).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import threading
+
+import numpy as np
+
+from superlu_dist_tpu.parallel.treecomm import TreeComm
+from superlu_dist_tpu.utils.deadline import Deadline
+
+#: exit code of a rank killed by its own DyingTreeComm (distinct from
+#: any Python/pytest code so harnesses can assert the death was the
+#: injected one)
+RANK_DEATH_EXIT = 17
+#: exit code of a HangWatchdog abort
+HANG_EXIT = 3
+
+
+@dataclasses.dataclass
+class ChaosPlan:
+    """Parsed injection spec (all fields optional; -1 / "" = off)."""
+
+    kill_group: int = -1      # kill self after completing this group
+    signal: str = "kill"      # "kill" (SIGKILL, the kill -9 domain) or
+                              # "term" (SIGTERM — handlers run first)
+    nan_supernode: int = -1   # poison this supernode's A-entries
+
+    @property
+    def armed(self) -> bool:
+        return self.kill_group >= 0 or self.nan_supernode >= 0
+
+
+def parse_chaos_spec(spec: str) -> ChaosPlan:
+    """'kill_group=5,signal=term' -> ChaosPlan.  Unknown keys raise —
+    a typo'd knob silently injecting nothing would defeat the test
+    (the parse_fault_spec discipline)."""
+    plan = ChaosPlan()
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        key = key.strip()
+        if key in ("kill_group", "nan_supernode"):
+            setattr(plan, key, int(val))
+        elif key == "signal":
+            val = val.strip().lower()
+            if val not in ("kill", "term"):
+                raise ValueError(
+                    f"chaos signal must be 'kill' or 'term', got {val!r}")
+            plan.signal = val
+        else:
+            raise ValueError(f"unknown chaos-injection knob {key!r}")
+    return plan
+
+
+class ChaosMonkey:
+    """One factorization's injector (built from a ChaosPlan)."""
+
+    def __init__(self, plan: ChaosPlan):
+        self.plan = plan
+        self.groups_seen = 0
+
+    # ---- process-kill domain -------------------------------------------
+    def on_group(self, gi: int) -> None:
+        """Called by the streamed executor after group ``gi`` completes.
+        The kill lands AFTER the group's panels are emitted (and after
+        any interval checkpoint for it), modeling a preemption between
+        dispatch groups — the boundary the resume path restarts from."""
+        self.groups_seen += 1
+        if gi == self.plan.kill_group:
+            sig = (signal.SIGTERM if self.plan.signal == "term"
+                   else signal.SIGKILL)
+            os.kill(os.getpid(), sig)
+            if sig == signal.SIGTERM:
+                # handlers (checkpoint flush, flightrec dump) ran and
+                # chained to the default disposition; if something
+                # swallowed it, die anyway — the injection must kill
+                os.kill(os.getpid(), signal.SIGKILL)
+
+    # ---- numeric-poison domain -----------------------------------------
+    def poke_nan(self, plan, pattern_values: np.ndarray) -> np.ndarray:
+        """Poison supernode ``nan_supernode``: NaN one A-entry that
+        assembles into its front, so the non-finite sentinel must trip
+        AT that supernode (localization is part of what chaos tests
+        pin).  Returns a poisoned COPY; no-op when unarmed."""
+        s = self.plan.nan_supernode
+        if s < 0:
+            return pattern_values
+        g = int(plan.sn_group[s])
+        slot = int(plan.sn_slot[s])
+        grp = plan.groups[g]
+        hit = np.nonzero(np.asarray(grp.a_slot) == slot)[0]
+        if not len(hit):
+            raise ValueError(
+                f"chaos nan_supernode={s}: supernode assembles no "
+                "A-entries (fully fill-in front) — pick another target")
+        out = np.array(pattern_values, copy=True)
+        out[np.asarray(grp.a_src)[hit[0]]] = np.nan
+        return out
+
+
+def get_chaos() -> ChaosMonkey | None:
+    """The env-armed injector, or None (the production fast path).
+    Re-read per call: chaos specs are per-run test state, not a latched
+    process constant."""
+    from superlu_dist_tpu.utils.options import env_str
+    spec = env_str("SLU_TPU_CHAOS").strip()
+    if not spec:
+        return None
+    plan = parse_chaos_spec(spec)
+    return ChaosMonkey(plan) if plan.armed else None
+
+
+# ---------------------------------------------------------------------------
+# outside-the-victim helpers
+# ---------------------------------------------------------------------------
+
+def corrupt_file(path: str, mode: str = "flip", offset: int | None = None,
+                 keep: int | None = None) -> None:
+    """Deterministically damage an on-disk artifact.
+
+    mode="flip": XOR one byte (at ``offset``, default the middle of the
+    file) — drives the sha256-mismatch path.  mode="truncate": cut the
+    file to ``keep`` bytes (default half) — drives the truncated-array
+    path.  Checkpoint loads must answer with structured
+    CheckpointCorruptError, never garbage factors."""
+    size = os.path.getsize(path)
+    if mode == "flip":
+        off = size // 2 if offset is None else offset
+        with open(path, "r+b") as f:
+            f.seek(off)
+            b = f.read(1)
+            f.seek(off)
+            f.write(bytes([b[0] ^ 0xFF]))
+    elif mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2 if keep is None else keep)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+
+
+class DyingTreeComm(TreeComm):
+    """A rank that dies mid-protocol: after ``die_after`` completed
+    public collectives the NEXT one ``os._exit``\\ s with
+    :data:`RANK_DEATH_EXIT` instead of participating — the simulated
+    rank-death failure domain.  Peers blocked on the abandoned
+    collective hang (the documented LockstepVerifier limitation: a rank
+    that stops calling collectives leaves nothing to cross-check), which
+    is exactly what :class:`HangWatchdog` exists to bound."""
+
+    def __init__(self, *args, die_after: int = 0, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._die_after = int(die_after)
+        self._public_ops = 0
+
+    def _maybe_die(self):
+        if self._public_ops >= self._die_after:
+            os._exit(RANK_DEATH_EXIT)
+        self._public_ops += 1
+
+    def bcast_any(self, arr, root=0):
+        self._maybe_die()
+        return super().bcast_any(arr, root=root)
+
+    def reduce_sum_any(self, arr, root=0):
+        self._maybe_die()
+        return super().reduce_sum_any(arr, root=root)
+
+    def allreduce_sum_any(self, arr, root=0):
+        self._maybe_die()
+        return super().allreduce_sum_any(arr, root=root)
+
+
+class CountdownDeadline(Deadline):
+    """Deterministic deadline injection: 'expires' at the Nth poll
+    instead of on the wall clock, so tests can cancel a factorization
+    at an exact dispatch-group boundary (the group loop polls once per
+    group).  Everything else — checkpoint-first flush, the collective
+    flag allreduce, the structured raise — runs the production path."""
+
+    def __init__(self, fire_after_polls: int, comm=None,
+                 poll_every: int = 1):
+        super().__init__(seconds=0.0, comm=comm, poll_every=poll_every)
+        self.fire_after_polls = int(fire_after_polls)
+
+    def expired_local(self) -> bool:
+        return self.polls > self.fire_after_polls
+
+
+class HangWatchdog:
+    """Bounded-hang guard for chaos tests and serving loops: unless
+    :meth:`disarm` runs within ``seconds``, dump the flight recorder
+    (when enabled) and ``os._exit(exit_code)``.  A daemon timer —
+    deliberately NOT a signal, so it fires even while the main thread is
+    blocked inside a native collective."""
+
+    def __init__(self, seconds: float, exit_code: int = HANG_EXIT,
+                 reason: str = "hang-watchdog"):
+        self.seconds = float(seconds)
+        self.exit_code = int(exit_code)
+        self.reason = reason
+        self._timer = None
+
+    def _fire(self):
+        try:
+            from superlu_dist_tpu.persist.checkpoint import flush_active
+            flush_active(self.reason)
+            from superlu_dist_tpu.obs.flightrec import get_flightrec
+            fr = get_flightrec()
+            if fr.enabled:
+                fr.dump(self.reason)
+        except Exception:
+            pass
+        os._exit(self.exit_code)
+
+    def arm(self) -> "HangWatchdog":
+        self._timer = threading.Timer(self.seconds, self._fire)
+        self._timer.daemon = True
+        self._timer.start()
+        return self
+
+    def disarm(self) -> None:
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+
+    def __enter__(self):
+        return self.arm()
+
+    def __exit__(self, *exc):
+        self.disarm()
+        return False

@@ -56,6 +56,62 @@ class NumericBreakdownError(SuperLUError):
         _flight_dump(self)
 
 
+class DeadlineExceededError(SuperLUError):
+    """The cooperative deadline (``Options.deadline_s`` /
+    ``SLU_TPU_DEADLINE_S``) expired between dispatch groups.  The factor
+    loop writes a checkpoint of the completed-group frontier FIRST (when
+    checkpointing is armed), so the work done before cancellation is
+    durable — ``checkpoint_path`` names it and ``gssvx(resume_from=...)``
+    restarts from it.  On the multi-rank path the expiry decision is an
+    allreduced flag, so every rank raises this together instead of one
+    rank abandoning its peers inside a collective (the SLU101/SLU106
+    discipline: cancellation must never become a deadlock)."""
+
+    def __init__(self, deadline_s: float, elapsed_s: float, where: str = "",
+                 checkpoint_path: str | None = None,
+                 expired_ranks: int = 0):
+        self.deadline_s = float(deadline_s)
+        self.elapsed_s = float(elapsed_s)
+        self.where = where
+        self.checkpoint_path = checkpoint_path
+        self.expired_ranks = int(expired_ranks)   # 0 = single-rank check
+        stage = f" during {where}" if where else ""
+        ck = (f"; frontier checkpointed at {checkpoint_path}"
+              if checkpoint_path else "")
+        ranks = (f" ({expired_ranks} rank(s) over budget)"
+                 if expired_ranks else "")
+        super().__init__(
+            f"cooperative deadline of {deadline_s:.3f}s exceeded"
+            f"{stage} after {elapsed_s:.3f}s{ranks}{ck}")
+        _flight_dump(self)
+
+
+class CheckpointError(SuperLUError):
+    """A persisted bundle (LU handle or factor checkpoint) is unusable:
+    missing manifest, structural mismatch, or an unreadable artifact.
+    Subclasses distinguish the failure families so callers can decide
+    between 'refactor from scratch' and 'operator error'."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """Integrity failure: a per-array digest mismatch or a truncated
+    array file.  Raised instead of returning garbage factors — the
+    whole point of the manifest (persist/serial.py)."""
+
+
+class CheckpointVersionError(CheckpointError):
+    """The bundle's format version is not one this build can read
+    (persist.FORMAT_VERSION — the versioning rule is documented in
+    docs/RELIABILITY.md)."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """The checkpoint is internally consistent but belongs to a
+    DIFFERENT factorization: plan fingerprint, value digest, dtype or
+    threshold differ from the run trying to resume.  Resuming would
+    silently splice incompatible frontiers, so this is a hard error."""
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

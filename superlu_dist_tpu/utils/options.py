@@ -116,6 +116,22 @@ def _register_all() -> None:
       group="recovery")
     r("SLU_TPU_SENTINELS", "flag", True,
       "non-finite isfinite sentinels in the numeric layer", group="recovery")
+    # --- persistence / crash consistency -----------------------------------
+    r("SLU_TPU_CKPT_EVERY", "int", 0,
+      "flush a factor checkpoint every K completed dispatch groups "
+      "(0 = interval checkpoints off; breakdown/deadline/SIGTERM "
+      "flushes stay armed once a checkpointer exists)", group="persist")
+    r("SLU_TPU_CKPT_DIR", "str", "",
+      "factor-checkpoint bundle directory (default .slu_ckpt in the "
+      "working directory)", group="persist")
+    r("SLU_TPU_DEADLINE_S", "float", 0.0,
+      "cooperative factorization deadline in seconds (0 = off): checked "
+      "between dispatch groups, checkpoint flushed first, raises "
+      "DeadlineExceededError — collectively on the multi-rank path",
+      group="persist")
+    r("SLU_TPU_DEADLINE_POLL", "int", 1,
+      "poll cadence of the collective deadline flag allreduce "
+      "(one exchange per N dispatch groups)", group="persist")
     # --- observability -----------------------------------------------------
     r("SLU_TPU_TRACE", "str", "",
       "structured span trace output path ('%p' expands to the pid)",
@@ -146,6 +162,9 @@ def _register_all() -> None:
     r("SLU_TPU_STRICT_ENV", "flag", False,
       "raise on SLU_TPU_* env vars the registry does not declare")
     # --- test / CI harness -------------------------------------------------
+    r("SLU_TPU_CHAOS", "str", "",
+      "failure-domain chaos-injection spec (testing/chaos.py, e.g. "
+      "'kill_group=5' or 'nan_supernode=3'); empty = off", group="test")
     r("SLU_TPU_SKIP_PROBE", "flag", False,
       "__graft_entry__: skip the accelerator probe", group="test")
     r("SLU_TPU_DRYRUN_BIG", "str", "1",
@@ -500,6 +519,21 @@ class Options:
     # and the automatic escalation ladder (see RecoveryPolicy)
     recovery: RecoveryPolicy = dataclasses.field(
         default_factory=RecoveryPolicy)
+    # --- crash consistency (persist/, docs/RELIABILITY.md) -----------------
+    # cooperative factorization deadline: checked between dispatch
+    # groups, checkpoint flushed first, DeadlineExceededError raised —
+    # collectively (flag allreduce) on the multi-rank path so
+    # cancellation can never strand a peer in a collective.  None = off.
+    deadline_s: float | None = dataclasses.field(
+        default_factory=lambda: env_float("SLU_TPU_DEADLINE_S") or None)
+    # factor-checkpoint interval in completed dispatch groups (0 = off);
+    # arming it forces the streamed executor (the fused whole-program
+    # jit has no group boundaries to checkpoint at)
+    ckpt_every: int = dataclasses.field(
+        default_factory=lambda: env_int("SLU_TPU_CKPT_EVERY"))
+    # checkpoint bundle directory ("" = .slu_ckpt in the working dir)
+    ckpt_dir: str = dataclasses.field(
+        default_factory=lambda: env_str("SLU_TPU_CKPT_DIR"))
 
 
 def set_default_options() -> Options:

@@ -118,8 +118,14 @@ class SolveReport:
         if self.tiny_pivots:
             parts.append(f"{self.tiny_pivots} tiny pivots replaced")
         for r in self.rungs:
-            parts.append(f"rung {r.name}[{r.detail}] "
-                         f"berr {r.berr_before:.2e}->{r.berr_after:.2e}")
+            if r.berr_before == float("inf") and \
+                    r.berr_after == float("inf"):
+                # informational rung (e.g. resume-from-checkpoint): no
+                # berr was measured around it
+                parts.append(f"rung {r.name}[{r.detail}]")
+            else:
+                parts.append(f"rung {r.name}[{r.detail}] "
+                             f"berr {r.berr_before:.2e}->{r.berr_after:.2e}")
         if not self.finite:
             parts.append("NON-FINITE")
         if not self.converged:
@@ -151,6 +157,10 @@ class Stats:
                                   # (obs/compilestats.COMPILE_STATS.block:
                                   # builds, seconds, persistent hits,
                                   # top shape-key buckets)
+    resume: dict = field(default_factory=dict)    # checkpoint-resume
+                                  # telemetry of the last factorization
+                                  # (drivers/gssvx.factorize_numeric:
+                                  # groups restored / total / bundle path)
     _timer_depth: dict = field(default_factory=dict, repr=False,
                                compare=False)
 
@@ -273,6 +283,13 @@ class Stats:
                 lines.append(
                     f"      {row['site']:<18s} {row['key']:<26s} "
                     f"x{row['n']:<3d} {row['seconds']:9.4f} s")
+        if self.resume:
+            # crash-consistency telemetry (persist/): this factorization
+            # spliced a durable frontier instead of recomputing it
+            lines.append(
+                f"    resumed  {self.resume.get('groups', 0)}/"
+                f"{self.resume.get('of', 0)} groups from checkpoint "
+                f"{self.resume.get('path', '?')}")
         if self.tiny_pivots:
             lines.append(f"    tiny pivots replaced: {self.tiny_pivots}")
         if self.retraces:

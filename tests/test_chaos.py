@@ -1,0 +1,404 @@
+"""Failure-domain chaos tests (testing/chaos.py — docs/RELIABILITY.md).
+
+Each failure domain from ISSUE 7 is injected deterministically and its
+contracted outcome asserted: a mid-factor SIGKILL leaves a resumable
+frontier; a SIGTERM chains checkpoint flush -> flight dump -> previous
+handler; a NaN poke trips the sentinel AT the chosen supernode; 2-rank
+deadline cancellation raises on BOTH ranks (collective flag allreduce,
+clean under SLU_TPU_VERIFY_COLLECTIVES=1); and a dead rank converts an
+infinite collective hang into a bounded, diagnosable abort.
+"""
+
+import hashlib
+import json
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+from superlu_dist_tpu.models.gallery import poisson3d
+from superlu_dist_tpu.utils.options import Options
+
+pytestmark = pytest.mark.chaos
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+needs_native = pytest.mark.skipif(not native.available(),
+                                  reason="native library unavailable")
+
+
+def _analyzed(nx=8):
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    a = poisson3d(nx)
+    sym = symmetrize_pattern(a)
+    sf = symbolic_factorize(sym, get_perm_c(Options(), a, sym))
+    return a, build_plan(sf), sym.data[sf.value_perm]
+
+
+def _digest(fronts):
+    h = hashlib.sha256()
+    for lp, up in fronts:
+        h.update(np.ascontiguousarray(np.asarray(lp)).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(up)).tobytes())
+    return h.hexdigest()
+
+
+# ---------------------------------------------------------------------------
+# spec parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_chaos_spec():
+    from superlu_dist_tpu.testing.chaos import parse_chaos_spec
+    p = parse_chaos_spec("kill_group=5,signal=term")
+    assert p.kill_group == 5 and p.signal == "term" and p.armed
+    p = parse_chaos_spec("nan_supernode=3")
+    assert p.nan_supernode == 3 and p.kill_group == -1
+    assert not parse_chaos_spec("").armed
+    with pytest.raises(ValueError, match="unknown"):
+        parse_chaos_spec("kill_gruop=5")
+    with pytest.raises(ValueError, match="signal"):
+        parse_chaos_spec("signal=hup")
+
+
+def test_chaos_off_is_none(monkeypatch):
+    from superlu_dist_tpu.testing.chaos import get_chaos
+    monkeypatch.delenv("SLU_TPU_CHAOS", raising=False)
+    assert get_chaos() is None
+
+
+# ---------------------------------------------------------------------------
+# NaN-poke domain
+# ---------------------------------------------------------------------------
+
+def test_nan_poke_trips_sentinel_at_chosen_supernode(monkeypatch):
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.utils.errors import NumericBreakdownError
+
+    a, plan, vals = _analyzed(nx=6)
+    target = 2
+    monkeypatch.setenv("SLU_TPU_CHAOS", f"nan_supernode={target}")
+    with pytest.raises(NumericBreakdownError) as ei:
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64")
+    assert ei.value.supernode == target
+    assert ei.value.col == int(plan.sf.sn_start[target])
+
+
+def test_nan_poke_breakdown_flushes_checkpoint(tmp_path, monkeypatch):
+    """Breakdown leaves a crash-consistent frontier behind, the error
+    carries its path, and resuming against the SAME (poisoned) inputs
+    deterministically reproduces the breakdown."""
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.persist.checkpoint import peek
+    from superlu_dist_tpu.utils.errors import NumericBreakdownError
+
+    a, plan, vals = _analyzed(nx=6)
+    ck = str(tmp_path / "ck")
+    monkeypatch.setenv("SLU_TPU_CHAOS", "nan_supernode=2")
+    with pytest.raises(NumericBreakdownError) as ei:
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          ckpt_dir=ck, ckpt_every=1)
+    assert ei.value.checkpoint_path == os.path.abspath(ck)
+    meta = peek(ck)
+    assert meta["reason"] in ("interval", "numeric-breakdown")
+    with pytest.raises(NumericBreakdownError):
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          resume_from=ck)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint-corruption domain
+# ---------------------------------------------------------------------------
+
+def test_corrupted_checkpoint_refuses_resume(tmp_path):
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.testing.chaos import (CountdownDeadline,
+                                                corrupt_file)
+    from superlu_dist_tpu.utils.errors import (CheckpointCorruptError,
+                                               DeadlineExceededError)
+
+    a, plan, vals = _analyzed(nx=8)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DeadlineExceededError):
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          ckpt_dir=ck, deadline=CountdownDeadline(3))
+    corrupt_file(os.path.join(ck, "pool.npy"), mode="flip")
+    with pytest.raises(CheckpointCorruptError):
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          resume_from=ck)
+
+
+# ---------------------------------------------------------------------------
+# mid-factor process-kill domain (subprocess victims)
+# ---------------------------------------------------------------------------
+
+_VICTIM = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu.numeric.factor import numeric_factorize
+from superlu_dist_tpu.utils.options import env_int, env_str
+import tests.test_chaos as T
+a, plan, vals = T._analyzed(nx=8)
+numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                  executor="stream",
+                  ckpt_dir=env_str("SLU_TPU_CKPT_DIR"),
+                  ckpt_every=env_int("SLU_TPU_CKPT_EVERY"))
+sys.exit(7)   # the injected kill must prevent us ever getting here
+"""
+
+
+def _run_victim(ck_dir, chaos, flightrec=None, every=2):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLU_TPU_CHAOS=chaos, SLU_TPU_CKPT_DIR=ck_dir,
+               SLU_TPU_CKPT_EVERY=str(every))
+    if flightrec:
+        env["SLU_TPU_FLIGHTREC"] = flightrec
+    return subprocess.run(
+        [sys.executable, "-c", _VICTIM.format(repo=REPO)],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240)
+
+
+def test_sigkill_mid_factor_leaves_resumable_frontier(tmp_path):
+    """The kill -9 domain (the acceptance case; the CI gate
+    scripts/check_crash_resume.py runs the same scenario standalone):
+    nothing flushes at death, the interval frontier is the durable
+    state, resume is bitwise-identical."""
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.persist.checkpoint import peek
+
+    a, plan, vals = _analyzed(nx=8)
+    ref = numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                            executor="stream")
+    kill = len(plan.groups) // 2
+    ck = str(tmp_path / "ck")
+    r = _run_victim(ck, f"kill_group={kill}")
+    assert r.returncode == -signal.SIGKILL, (r.returncode, r.stderr)
+    k = int(peek(ck)["k"])
+    assert 0 < k <= kill + 1
+    res = numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                            resume_from=ck)
+    assert res.resumed_groups == k
+    assert _digest(res.fronts) == _digest(ref.fronts)
+
+
+def test_sigterm_mid_factor_chains_flush_dump_and_dies(tmp_path):
+    """SIGTERM domain: the chained handlers flush the LATEST frontier
+    (no interval checkpoints armed here), dump the flight ring with a
+    reference to that checkpoint, then the default disposition kills
+    the process — and the frontier resumes bitwise."""
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.persist.checkpoint import peek
+
+    a, plan, vals = _analyzed(nx=8)
+    ref = numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                            executor="stream")
+    kill = len(plan.groups) // 2
+    ck = str(tmp_path / "ck")
+    dump = str(tmp_path / "flight.json")
+    r = _run_victim(ck, f"kill_group={kill},signal=term",
+                    flightrec=dump, every=0)
+    assert r.returncode == -signal.SIGTERM, (r.returncode, r.stderr)
+    # every=0: ONLY the SIGTERM flush can have written this frontier
+    meta = peek(ck)
+    assert meta["reason"] == "SIGTERM"
+    assert int(meta["k"]) == kill + 1
+    doc = json.loads(open(dump).read())
+    assert doc["reason"] == "SIGTERM"
+    assert doc["checkpoint"] == os.path.abspath(ck)
+    res = numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                            resume_from=ck)
+    assert _digest(res.fronts) == _digest(ref.fronts)
+
+
+def test_sigterm_chains_previously_installed_handler():
+    """Satellite fix pinned in-process: arming the flight recorder's
+    SIGTERM hook must CHAIN a previously-installed Python handler (it
+    still runs, and the process survives because that handler returns)."""
+    from superlu_dist_tpu.obs import flightrec
+
+    prev = signal.getsignal(signal.SIGTERM)
+    seen = []
+    try:
+        signal.signal(signal.SIGTERM, lambda s, f: seen.append(s))
+        fr = flightrec.FlightRecorder(dump_path="/dev/null")
+        flightrec._arm_sigterm(fr)
+        os.kill(os.getpid(), signal.SIGTERM)
+        for _ in range(100):
+            if seen:
+                break
+            time.sleep(0.01)
+        assert seen == [signal.SIGTERM]
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+def test_sigterm_respects_sig_ign():
+    """A process that chose to ignore SIGTERM must keep ignoring it
+    after the flight recorder arms (the old handler converted SIG_IGN
+    into a kill)."""
+    code = r"""
+import os, signal, sys
+signal.signal(signal.SIGTERM, signal.SIG_IGN)
+from superlu_dist_tpu.obs import flightrec
+fr = flightrec.FlightRecorder(dump_path="/dev/null")
+flightrec._arm_sigterm(fr)
+os.kill(os.getpid(), signal.SIGTERM)
+print("SURVIVED")
+"""
+    r = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                       env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.returncode, r.stderr)
+    assert "SURVIVED" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# 2-rank cooperative deadline: both ranks raise, no deadlock
+# ---------------------------------------------------------------------------
+
+_DEADLINE_RANK1 = r"""
+import sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu.parallel.treecomm import TreeComm
+from superlu_dist_tpu.testing.chaos import CountdownDeadline
+from superlu_dist_tpu.numeric.factor import numeric_factorize
+from superlu_dist_tpu.utils.errors import DeadlineExceededError
+import tests.test_chaos as T
+name, fire_after = sys.argv[1], int(sys.argv[2])
+tc = TreeComm(name, 2, 1, max_len=64, create=False)
+try:
+    a, plan, vals = T._analyzed(nx=6)
+    dl = CountdownDeadline(fire_after, comm=tc)
+    try:
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          executor="stream", deadline=dl)
+        print("OUTCOME no-error")
+    except DeadlineExceededError as e:
+        print("OUTCOME deadline", e.expired_ranks)
+finally:
+    tc.close()
+"""
+
+
+@needs_native
+def test_two_rank_deadline_raises_on_both_ranks(monkeypatch):
+    """Acceptance: rank 1's deadline expires, rank 0's never would —
+    the collective flag allreduce makes BOTH ranks raise
+    DeadlineExceededError together (no deadlock), clean under
+    SLU_TPU_VERIFY_COLLECTIVES=1.  Rank 1 runs in a FRESH subprocess
+    (not a fork: a forked child of a jax-warmed pytest process can
+    deadlock on inherited XLA locks when it compiles)."""
+    monkeypatch.setenv("SLU_TPU_VERIFY_COLLECTIVES", "1")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.testing.chaos import CountdownDeadline
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.utils.errors import DeadlineExceededError
+
+    name = f"/slu_chaos_dl_{os.getpid()}"
+    owner = TreeComm(name, 2, 0, max_len=64, create=True)
+    # rank 1 expires after 3 polls; rank 0 would never expire on its own
+    p = subprocess.Popen(
+        [sys.executable, "-c", _DEADLINE_RANK1.format(repo=REPO),
+         name, "3"],
+        env=dict(os.environ, JAX_PLATFORMS="cpu"), cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True)
+    try:
+        a, plan, vals = _analyzed(nx=6)
+        dl = CountdownDeadline(10 ** 9, comm=owner)
+        with pytest.raises(DeadlineExceededError) as ei:
+            numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                              executor="stream", deadline=dl)
+        # the owner was NOT locally expired: the raise came from the
+        # collective decision
+        assert ei.value.expired_ranks == 1
+        out, err = p.communicate(timeout=120)
+        assert p.returncode == 0, (p.returncode, err)
+        assert "OUTCOME deadline 1" in out, (out, err)
+    finally:
+        if p.poll() is None:                    # pragma: no cover
+            p.kill()
+        owner.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# simulated rank death: bounded abort instead of infinite hang
+# ---------------------------------------------------------------------------
+
+def _dying_rank(name, ready):
+    from superlu_dist_tpu.testing.chaos import DyingTreeComm
+    tc = DyingTreeComm(name, 2, 1, max_len=64, create=False,
+                       die_after=2)
+    ready.set()
+    x = np.ones(4)
+    tc.allreduce_sum_any(x)          # 1
+    tc.allreduce_sum_any(x)          # 2
+    tc.allreduce_sum_any(x)          # dies with RANK_DEATH_EXIT here
+    os._exit(99)                     # unreachable
+
+
+def _surviving_rank(name, q):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.testing.chaos import HangWatchdog
+    tc = TreeComm(name, 2, 0, max_len=64, create=False)
+    x = np.ones(4)
+    with HangWatchdog(5.0):
+        tc.allreduce_sum_any(x)      # 1
+        tc.allreduce_sum_any(x)      # 2
+        q.put("pre-hang")
+        tc.allreduce_sum_any(x)      # peer is dead: hangs -> watchdog
+    os._exit(0)                      # unreachable when the peer died
+
+
+@needs_native
+def test_rank_death_converts_hang_into_bounded_abort():
+    """A rank dying mid-protocol (DyingTreeComm) leaves its peer hung in
+    the abandoned collective — HangWatchdog bounds that hang: the
+    survivor exits with the watchdog code within its budget instead of
+    hanging forever."""
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.testing.chaos import HANG_EXIT, RANK_DEATH_EXIT
+
+    name = f"/slu_chaos_rd_{os.getpid()}"
+    # the parent owns (and later unlinks) the segment; both workers
+    # attach — the creator's constructor completes before any attacher
+    # starts (the TreeComm rendezvous contract)
+    seg = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    ready = ctx.Event()
+    dier = ctx.Process(target=_dying_rank, args=(name, ready))
+    dier.start()
+    assert ready.wait(timeout=30)
+    surv = ctx.Process(target=_surviving_rank, args=(name, q))
+    surv.start()
+    try:
+        assert q.get(timeout=60) == "pre-hang"
+        dier.join(timeout=60)
+        surv.join(timeout=60)
+        assert dier.exitcode == RANK_DEATH_EXIT
+        assert surv.exitcode == HANG_EXIT
+    finally:
+        seg.close(unlink=True)
+
+
+def test_hang_watchdog_disarm_keeps_process_alive():
+    from superlu_dist_tpu.testing.chaos import HangWatchdog
+    wd = HangWatchdog(0.05).arm()
+    wd.disarm()
+    time.sleep(0.15)        # were it still armed, os._exit would fire
+    with HangWatchdog(0.05):
+        pass
+    time.sleep(0.15)

@@ -1,0 +1,372 @@
+"""Persistence tests (persist/ — docs/RELIABILITY.md).
+
+Pins the crash-consistency contracts: a saved LU handle reloads and
+solves with BITWISE-identical factors and no refactorization; factor
+checkpoints resume to bitwise-identical L/U; corruption, truncation,
+version drift and identity mismatch all answer with structured errors
+(never garbage factors); and bundles round-trip across the int-width
+(``SLU_TPU_INT64`` / INT alias) and precision (f64 / df64) configs.
+"""
+
+import hashlib
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.models.gallery import poisson3d
+from superlu_dist_tpu.utils.errors import (
+    CheckpointCorruptError, CheckpointError, CheckpointMismatchError,
+    CheckpointVersionError)
+from superlu_dist_tpu.utils.options import Options
+
+pytestmark = pytest.mark.persist
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fronts_digest(fronts) -> str:
+    h = hashlib.sha256()
+    for lp, up in fronts:
+        h.update(np.ascontiguousarray(np.asarray(lp)).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(up)).tobytes())
+    return h.hexdigest()
+
+
+def _factored(nx=6, **opt_kw):
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    a = poisson3d(nx)
+    n = a.n_rows
+    b = a.matvec(np.ones(n))
+    x, lu, stats, info = gssvx(Options(**opt_kw), a, b)
+    assert info == 0
+    return a, b, lu
+
+
+def _analyzed(nx=6, **kw):
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    a = poisson3d(nx)
+    sym = symmetrize_pattern(a)
+    sf = symbolic_factorize(sym, get_perm_c(Options(), a, sym), **kw)
+    return a, build_plan(sf), sym.data[sf.value_perm]
+
+
+# ---------------------------------------------------------------------------
+# LU handle round trip
+# ---------------------------------------------------------------------------
+
+def test_lu_handle_round_trip_bitwise_and_solve(tmp_path):
+    """Acceptance: a saved handle reloads and solves WITHOUT
+    refactorization, with bitwise-identical factors."""
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.utils.options import Fact
+    from superlu_dist_tpu.utils.stats import Stats
+    import dataclasses
+
+    a, b, lu = _factored()
+    path = save_lu(lu, str(tmp_path / "handle"))
+    lu2 = load_lu(path)
+
+    assert _fronts_digest(lu2.numeric.fronts) == \
+        _fronts_digest(lu.numeric.fronts)
+    for (l1, u1), (l2, u2) in zip(lu.numeric.fronts, lu2.numeric.fronts):
+        assert np.array_equal(np.asarray(l1), l2)
+        assert np.array_equal(np.asarray(u1), u2)
+
+    # direct solve through the reloaded handle
+    x2 = lu2.solve_factored(b)
+    resid = np.linalg.norm(b - a.matvec(x2)) / np.linalg.norm(b)
+    assert resid < 1e-10
+
+    # the full driver path: Fact=FACTORED never re-enters the
+    # factorization (FACT time stays zero — no refactorization)
+    stats = Stats()
+    opts = dataclasses.replace(Options(), fact=Fact.FACTORED)
+    x3, _, stats, info = gssvx(opts, a, b, lu=lu2, stats=stats)
+    assert info == 0
+    assert stats.utime["FACT"] == 0.0
+    assert np.linalg.norm(b - a.matvec(x3)) / np.linalg.norm(b) < 1e-10
+
+
+def test_manifest_is_versioned_and_digested(tmp_path):
+    import json
+    from superlu_dist_tpu.persist import save_lu, FORMAT_VERSION
+    from superlu_dist_tpu.persist.serial import MANIFEST
+
+    _, _, lu = _factored()
+    path = save_lu(lu, str(tmp_path / "h"))
+    doc = json.loads(open(os.path.join(path, MANIFEST)).read())
+    assert doc["version"] == FORMAT_VERSION
+    assert doc["kind"] == "lu_handle"
+    assert doc["meta"]["n"] == lu.n
+    # every artifact is digest-covered
+    for name, ent in doc["arrays"].items():
+        f = os.path.join(path, ent["file"])
+        assert os.path.getsize(f) == ent["bytes"], name
+        assert len(ent["sha256"]) == 64
+
+
+def test_unknown_version_raises(tmp_path):
+    import json
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    from superlu_dist_tpu.persist.serial import MANIFEST
+
+    _, _, lu = _factored()
+    path = save_lu(lu, str(tmp_path / "h"))
+    mpath = os.path.join(path, MANIFEST)
+    doc = json.loads(open(mpath).read())
+    doc["version"] = 999
+    open(mpath, "w").write(json.dumps(doc))
+    with pytest.raises(CheckpointVersionError):
+        load_lu(path)
+
+
+def test_corrupted_array_raises_structured(tmp_path):
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    from superlu_dist_tpu.testing.chaos import corrupt_file
+
+    _, _, lu = _factored()
+    path = save_lu(lu, str(tmp_path / "h"))
+    corrupt_file(os.path.join(path, "front_00000_l.npy"), mode="flip")
+    with pytest.raises(CheckpointCorruptError, match="sha256"):
+        load_lu(path)
+
+
+def test_truncated_array_raises_structured(tmp_path):
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    from superlu_dist_tpu.testing.chaos import corrupt_file
+
+    _, _, lu = _factored()
+    path = save_lu(lu, str(tmp_path / "h"))
+    corrupt_file(os.path.join(path, "front_00000_u.npy"),
+                 mode="truncate")
+    with pytest.raises(CheckpointCorruptError, match="truncated"):
+        load_lu(path)
+
+
+def test_corrupted_manifest_raises_structured(tmp_path):
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    from superlu_dist_tpu.persist.serial import MANIFEST
+    from superlu_dist_tpu.testing.chaos import corrupt_file
+
+    _, _, lu = _factored()
+    path = save_lu(lu, str(tmp_path / "h"))
+    corrupt_file(os.path.join(path, MANIFEST), mode="truncate")
+    with pytest.raises(CheckpointError):
+        load_lu(path)
+
+
+def test_missing_bundle_raises(tmp_path):
+    from superlu_dist_tpu.persist import load_lu
+    with pytest.raises(CheckpointError, match="MANIFEST"):
+        load_lu(str(tmp_path / "nope"))
+
+
+# ---------------------------------------------------------------------------
+# factor checkpoint round trip / resume
+# ---------------------------------------------------------------------------
+
+def test_factor_checkpoint_resume_bitwise(tmp_path):
+    """An interrupted-then-resumed factorization is bitwise identical to
+    an uninterrupted one (the in-process twin of the kill -9 CI gate
+    scripts/check_crash_resume.py)."""
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.testing.chaos import CountdownDeadline
+    from superlu_dist_tpu.utils.errors import DeadlineExceededError
+
+    a, plan, vals = _analyzed(nx=8)
+    assert len(plan.groups) >= 4
+    ref = numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                            executor="stream")
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DeadlineExceededError) as ei:
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          ckpt_dir=ck,
+                          deadline=CountdownDeadline(3))
+    assert ei.value.checkpoint_path == os.path.abspath(ck)
+    res = numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                            resume_from=ck)
+    assert res.resumed_groups == 3
+    assert _fronts_digest(res.fronts) == _fronts_digest(ref.fronts)
+    assert res.tiny_pivots == ref.tiny_pivots
+
+
+def test_resume_refuses_changed_values(tmp_path):
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.testing.chaos import CountdownDeadline
+    from superlu_dist_tpu.utils.errors import DeadlineExceededError
+
+    a, plan, vals = _analyzed(nx=8)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DeadlineExceededError):
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          ckpt_dir=ck, deadline=CountdownDeadline(3))
+    drifted = vals.copy()
+    drifted[0] *= 1.5
+    with pytest.raises(CheckpointMismatchError, match="different"):
+        numeric_factorize(plan, drifted, a.norm_max(), dtype="float64",
+                          resume_from=ck)
+
+
+def test_resume_refuses_different_plan(tmp_path):
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.testing.chaos import CountdownDeadline
+    from superlu_dist_tpu.utils.errors import DeadlineExceededError
+
+    a, plan, vals = _analyzed(nx=8)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DeadlineExceededError):
+        numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                          ckpt_dir=ck, deadline=CountdownDeadline(3))
+    # a different blocking config yields a different plan fingerprint
+    _, plan2, vals2 = _analyzed(nx=8, relax=4, max_supernode=16)
+    with pytest.raises(CheckpointMismatchError, match="different"):
+        numeric_factorize(plan2, vals2, a.norm_max(), dtype="float64",
+                          resume_from=ck)
+
+
+def test_resume_recorded_as_solve_report_rung(tmp_path):
+    """gssvx(resume_from=...) records the resume on stats.resume AND as
+    a 'resume-from-checkpoint' rung in the SolveReport ladder."""
+    from superlu_dist_tpu.drivers.gssvx import analyze, gssvx
+    from superlu_dist_tpu.testing.chaos import CountdownDeadline
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.utils.errors import DeadlineExceededError
+
+    # the checkpoint must belong to the DRIVER's analysis (equil + MC64
+    # + its column order), so write it from analyze()'s own products —
+    # the driver's re-analysis is deterministic, so the fingerprints
+    # line up on resume
+    a = poisson3d(8)
+    lu0, bvals, _ = analyze(Options(), a)
+    ck = str(tmp_path / "ck")
+    with pytest.raises(DeadlineExceededError):
+        numeric_factorize(lu0.plan, bvals, lu0.anorm, dtype="float64",
+                          ckpt_dir=ck, deadline=CountdownDeadline(3))
+    b = a.matvec(np.ones(a.n_rows))
+    x, lu, stats, info = gssvx(Options(), a, b, resume_from=ck)
+    assert info == 0
+    assert stats.resume["groups"] == 3
+    rep = stats.solve_report
+    rungs = [r for r in rep.rungs if r.name == "resume-from-checkpoint"]
+    assert len(rungs) == 1
+    assert "3/" in rungs[0].detail
+    assert "resume-from-checkpoint" in rep.summary()
+    assert "resumed" in stats.report()
+    resid = np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b)
+    assert resid < 1e-10
+
+
+def test_checkpoint_removed_after_completed_run(tmp_path):
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    a, plan, vals = _analyzed(nx=6)
+    ck = str(tmp_path / "ck")
+    numeric_factorize(plan, vals, a.norm_max(), dtype="float64",
+                      ckpt_dir=ck, ckpt_every=2)
+    # a completed factorization leaves no stale frontier behind
+    assert not os.path.exists(os.path.join(ck, "MANIFEST.json"))
+
+
+# ---------------------------------------------------------------------------
+# cross-config round trips (int width, precision)
+# ---------------------------------------------------------------------------
+
+_WORKER = r"""
+import hashlib, os, sys
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+sys.path.insert(0, {repo!r})
+from superlu_dist_tpu.models.gallery import poisson3d
+from superlu_dist_tpu.drivers.gssvx import gssvx
+from superlu_dist_tpu.utils.options import Options
+from superlu_dist_tpu.persist import save_lu, load_lu
+
+def digest(fronts):
+    h = hashlib.sha256()
+    for lp, up in fronts:
+        h.update(np.ascontiguousarray(np.asarray(lp)).tobytes())
+        h.update(np.ascontiguousarray(np.asarray(up)).tobytes())
+    return h.hexdigest()
+
+mode, path = sys.argv[1], sys.argv[2]
+a = poisson3d(6)
+b = a.matvec(np.ones(a.n_rows))
+if mode == "save":
+    x, lu, stats, info = gssvx(Options(), a, b)
+    assert info == 0
+    save_lu(lu, path)
+    print("DIGEST", digest(lu.numeric.fronts))
+else:
+    lu = load_lu(path)
+    x = lu.solve_factored(b)
+    resid = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
+    assert resid < 1e-10, resid
+    print("DIGEST", digest(lu.numeric.fronts))
+"""
+
+
+def _run_worker(mode, path, int64: bool):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLU_TPU_INT64="1" if int64 else "0")
+    r = subprocess.run(
+        [sys.executable, "-c", _WORKER.format(repo=REPO), mode, path],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=240)
+    assert r.returncode == 0, r.stderr
+    for line in r.stdout.splitlines():
+        if line.startswith("DIGEST "):
+            return line.split()[1]
+    raise AssertionError(f"no digest in worker output: {r.stdout}")
+
+
+@pytest.mark.parametrize("save64,load64", [(False, True), (True, False)])
+def test_round_trip_across_int_width_configs(tmp_path, save64, load64):
+    """A handle saved under one SLU_TPU_INT64 (INT alias) config loads
+    under the other with bitwise-identical L/U and a working solve."""
+    path = str(tmp_path / "h")
+    d_save = _run_worker("save", path, int64=save64)
+    d_load = _run_worker("load", path, int64=load64)
+    assert d_save == d_load
+
+
+def test_round_trip_df64_config(tmp_path):
+    """df64 (emulated-double) factors — recombined host f64 — round-trip
+    bitwise through the same bundle format."""
+    from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    import dataclasses
+
+    a = poisson3d(5)
+    opts = dataclasses.replace(Options(), factor_dtype="df64")
+    lu, bvals, stats = analyze(opts, a)
+    info = factorize_numeric(lu, bvals, stats)
+    assert info == 0
+    assert str(lu.numeric.dtype) == "float64"   # recombined exact f64
+    path = save_lu(lu, str(tmp_path / "h"))
+    lu2 = load_lu(path)
+    assert _fronts_digest(lu2.numeric.fronts) == \
+        _fronts_digest(lu.numeric.fronts)
+
+
+def test_round_trip_f32_dtype(tmp_path):
+    from superlu_dist_tpu.drivers.gssvx import analyze, factorize_numeric
+    from superlu_dist_tpu.persist import save_lu, load_lu
+    import dataclasses
+
+    a = poisson3d(5)
+    opts = dataclasses.replace(Options(), factor_dtype="float32")
+    lu, bvals, stats = analyze(opts, a)
+    assert factorize_numeric(lu, bvals, stats) == 0
+    path = save_lu(lu, str(tmp_path / "h"))
+    lu2 = load_lu(path)
+    assert str(np.dtype(lu2.numeric.dtype)) == "float32"
+    assert lu2.numeric.fronts[0][0].dtype == np.float32
+    assert _fronts_digest(lu2.numeric.fronts) == \
+        _fronts_digest(lu.numeric.fronts)
