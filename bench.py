@@ -54,6 +54,17 @@ def _emit(final: bool):
         if _DONE:
             return
         snap = dict(RESULT)      # snapshot: main thread mutates RESULT
+        # rank-failure tolerance telemetry (parallel/recover.py): how
+        # many shrink/respawn recoveries this run absorbed, and whether
+        # the row's numbers rest on a recovered solve — 0/False on the
+        # single-process bench unless an embedded FT driver ran
+        try:
+            from superlu_dist_tpu.parallel.recover import FT_EVENTS
+            snap["ft_events"] = len(FT_EVENTS)
+            snap["recovered"] = bool(FT_EVENTS)
+        except Exception:
+            snap["ft_events"] = 0
+            snap["recovered"] = False
         if not final:
             snap["timeout"] = True
         print(json.dumps(snap), flush=True)

@@ -1,0 +1,175 @@
+#!/usr/bin/env python
+"""Rank-failure gate: kill -9 a rank mid-factor, diagnose, shrink, resume.
+
+The ISSUE 8 acceptance cases, end to end (gate contract shared with the
+other scripts/ci_gates.sh gates: any regression asserts/raises, exiting
+non-zero with the diagnostic on stderr):
+
+  Phase A — diagnosis (ft=abort, 3 ranks): rank 1 is SIGKILLed before
+     its 4th public collective while rank 0 factors.  BOTH survivors
+     must raise RankFailureError naming rank 1 + op + call site within
+     2x SLU_TPU_COMM_TIMEOUT_S of the death (wall-clocked from the
+     victim's exit), with an armed HangWatchdog that must NOT fire
+     (exit code 3 = the old unbounded-hang behavior = gate failure).
+
+  Phase B — recovery (ft=shrink, 2 ranks): rank 0 (the factoring root)
+     is SIGKILLed after dispatch group 3 with interval checkpoints
+     armed; the survivor shrinks to a solo epoch, RESUMES the durable
+     checkpoint frontier, and completes — and its L/U digest is
+     BITWISE-identical to an undisturbed run's.
+
+Exit 0 = pass.  A few tens of seconds on CPU.
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+TIMEOUT_S = 1.0          # SLU_TPU_COMM_TIMEOUT_S for the victims
+DETECT_BUDGET_S = 2 * TIMEOUT_S + 5.0   # 2x timeout + subprocess slack
+
+_RANK = r"""
+import os, sys, time, hashlib
+import numpy as np
+sys.path.insert(0, {repo!r})
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    rank, n_ranks, name = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.parallel.recover import (
+        pgssvx_ft, RowBlockSource, VectorBlockSource, FT_EVENTS)
+    from superlu_dist_tpu.utils.errors import RankFailureError
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.testing.chaos import HANG_EXIT, HangWatchdog
+
+    a = poisson3d(6)
+    xt = np.random.default_rng(0).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    opts = Options(factor_dtype="float64", ckpt_every=2,
+                   ckpt_dir=os.environ.get("FT_CKDIR", ""))
+    lu_out = {{}}
+    with HangWatchdog(90.0, exit_code=HANG_EXIT):
+        try:
+            x, info = pgssvx_ft(name, n_ranks, rank, opts,
+                                RowBlockSource(a), VectorBlockSource(b),
+                                max_len=a.n_rows, lu_out=lu_out)
+        except RankFailureError as e:
+            print("OUTCOME", rank, "rank-failure", time.time(),
+                  ",".join(map(str, e.dead_ranks)), e.op, e.site,
+                  flush=True)
+            return
+    h = hashlib.sha256()
+    lu = lu_out.get("lu")
+    if lu is not None and getattr(lu, "numeric", None) is not None:
+        for lp, up in lu.numeric.fronts:
+            h.update(np.ascontiguousarray(np.asarray(lp)).tobytes())
+            h.update(np.ascontiguousarray(np.asarray(up)).tobytes())
+    print("OUTCOME", rank, "solved", time.time(), info, len(FT_EVENTS),
+          float(np.abs(x - xt).max()), h.hexdigest(),
+          lu_out.get("recovered"), flush=True)
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+def _spawn(workdir, name, rank, n_ranks, ft, chaos=None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLU_TPU_COMM_TIMEOUT_S=str(TIMEOUT_S),
+               SLU_TPU_FT=ft,
+               FT_CKDIR=os.path.join(workdir, "ck"))
+    env.pop("SLU_TPU_CHAOS", None)
+    if chaos:
+        env["SLU_TPU_CHAOS"] = chaos
+    script = os.path.join(workdir, f"rank{rank}.py")
+    with open(script, "w") as f:
+        f.write(_RANK.format(repo=REPO))
+    return subprocess.Popen(
+        [sys.executable, script, str(rank), str(n_ranks), name],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _finish(p, timeout=240):
+    out, err = p.communicate(timeout=timeout)
+    lines = [ln.split() for ln in out.splitlines()
+             if ln.startswith("OUTCOME")]
+    return p.returncode, (lines[-1] if lines else None), err
+
+
+def phase_a(workdir):
+    print("phase A: 3 ranks, kill -9 rank 1 mid-factor (ft=abort)")
+    name = f"/slu_gate_ftA_{os.getpid()}"
+    procs = {0: _spawn(workdir, name, 0, 3, "abort")}
+    time.sleep(0.3)
+    procs[1] = _spawn(workdir, name, 1, 3, "abort",
+                      chaos="kill_rank=1,kill_op=4")
+    procs[2] = _spawn(workdir, name, 2, 3, "abort")
+    rc1, _, err1 = _finish(procs[1])
+    t_death = time.time()
+    assert rc1 == -signal.SIGKILL, \
+        f"victim rank 1 exited {rc1}, expected SIGKILL: {err1[-2000:]}"
+    for r in (0, 2):
+        rc, line, err = _finish(procs[r])
+        assert rc == 0, (f"survivor rank {r} exited {rc} "
+                         f"(3 = HangWatchdog fired): {err[-2000:]}")
+        assert line is not None and line[2] == "rank-failure", (r, line)
+        t_raise = float(line[3])
+        assert t_raise - t_death <= DETECT_BUDGET_S, \
+            (f"survivor rank {r} took {t_raise - t_death:.1f}s "
+             f"> {DETECT_BUDGET_S:.1f}s after the death")
+        assert line[4] == "1", f"dead set {line[4]!r} != victim rank 1"
+        assert line[5] and line[6], f"op/site missing: {line}"
+        print(f"  rank {r}: RankFailureError dead=1 op={line[5]} "
+              f"site={line[6]} (+{t_raise - t_death:.1f}s)")
+
+
+def phase_b(workdir):
+    print("phase B: shrink recovery resumes the frontier bitwise")
+    # undisturbed reference (same options incl. checkpoint arming)
+    name = f"/slu_gate_ftBr_{os.getpid()}"
+    rc, line, err = _finish(_spawn(workdir, name, 0, 1, "shrink"))
+    assert rc == 0 and line[2] == "solved", (rc, line, err[-2000:])
+    ref_digest = line[7]
+
+    name = f"/slu_gate_ftB_{os.getpid()}"
+    procs = {0: _spawn(workdir, name, 0, 2, "shrink",
+                       chaos="kill_rank=0@group=3")}
+    time.sleep(0.3)
+    procs[1] = _spawn(workdir, name, 1, 2, "shrink")
+    rc0, _, _ = _finish(procs[0])
+    assert rc0 == -signal.SIGKILL, f"root exited {rc0}, expected SIGKILL"
+    rc, line, err = _finish(procs[1])
+    assert rc == 0, f"survivor exited {rc}: {err[-2000:]}"
+    assert line[2] == "solved" and line[4] == "0", line
+    assert line[5] == "1", f"ft_events {line[5]!r} != 1"
+    assert float(line[6]) < 1e-8, f"solution error {line[6]}"
+    assert line[7] == ref_digest, "recovered L/U differs from the " \
+        "undisturbed run (resume was not bitwise)"
+    assert line[8] == "True", "lu_out['recovered'] not set"
+    print(f"  survivor: shrink epoch solved, digest {line[7][:12]}… "
+          "== undisturbed (bitwise)")
+
+
+def main():
+    with tempfile.TemporaryDirectory(prefix="slu_ft_gate_") as workdir:
+        phase_a(workdir)
+    with tempfile.TemporaryDirectory(prefix="slu_ft_gate_") as workdir:
+        phase_b(workdir)
+    print("rank-failure gate OK: survivors diagnose within budget, "
+          "shrink resumes bitwise")
+
+
+if __name__ == "__main__":
+    main()

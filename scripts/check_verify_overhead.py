@@ -46,8 +46,11 @@ with treecomm.TreeComm(name, 1, 0, max_len=64, create=True) as tc:
     v = tc._verifier
     print(json.dumps({
         "verifier": type(v).__name__ if v is not None else None,
-        "null_guard": tc._verified("bcast", (1,), "float64", 0)
-                      is treecomm._NULL_CTX if v is None else False,
+        # with verification off (and no comm timeout / chaos armed) the
+        # public-op entry must have allocated NOTHING: no verifier, no
+        # failure detector, no chaos monkey
+        "null_guard": (tc._detector is None and tc._chaos is None)
+                      if v is None else False,
         "checks": v.checks if v is not None else 0,
         "payload_ok": ok_payload,
     }))
@@ -56,7 +59,9 @@ with treecomm.TreeComm(name, 1, 0, max_len=64, create=True) as tc:
 
 def run_child(extra_env):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
-    env.pop("SLU_TPU_VERIFY_COLLECTIVES", None)
+    for k in ("SLU_TPU_VERIFY_COLLECTIVES", "SLU_TPU_COMM_TIMEOUT_S",
+              "SLU_TPU_CHAOS"):
+        env.pop(k, None)
     env.update(extra_env)
     r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
                        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
@@ -80,7 +85,7 @@ def main():
     if off["verifier"] is not None:
         fail(f"disabled path allocated a verifier: {off['verifier']}")
     if not off["null_guard"]:
-        fail("disabled path did not reuse the no-op guard singleton")
+        fail("disabled path allocated detector/chaos state")
     if not off["payload_ok"]:
         fail("payload mismatch with verification off")
 

@@ -24,6 +24,11 @@
 #                   factorization mid-run, resume from the durable
 #                   checkpoint frontier, assert bitwise-identical L/U
 #                   vs an uninterrupted run
+#   rank-failure    scripts/check_rank_failure.py     kill -9 a rank
+#                   mid-factor: every survivor raises RankFailureError
+#                   within 2x SLU_TPU_COMM_TIMEOUT_S (no watchdog
+#                   exit-3), and ft=shrink resumes the checkpoint
+#                   frontier with bitwise-identical L/U
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -46,9 +51,10 @@ declare -A GATES=(
   [schedule-equiv]="python scripts/check_schedule_equiv.py"
   [perf-regress]="python scripts/check_perf_regress.py"
   [crash-resume]="python scripts/check_crash_resume.py"
+  [rank-failure]="python scripts/check_rank_failure.py"
 )
-ORDER=(slulint verify-overhead schedule-equiv crash-resume trace-overhead
-       nan-guards perf-regress)
+ORDER=(slulint verify-overhead schedule-equiv crash-resume rank-failure
+       trace-overhead nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

@@ -480,6 +480,12 @@ def gssvx(options: Options, a: SparseCSR, b: np.ndarray,
         stats = Stats()
     if options.print_stat:
         print(print_options(options))
+    ft = getattr(options, "ft", "abort") or "abort"
+    if ft not in ("abort", "shrink", "respawn"):
+        # fail the typo'd SLU_TPU_FT here, on every driver, instead of
+        # silently aborting the first real rank failure
+        raise SuperLUError(
+            f"Options.ft must be abort|shrink|respawn, got {ft!r}")
     n = a.n_rows
     if a.n_cols != n:
         raise SuperLUError("A must be square")

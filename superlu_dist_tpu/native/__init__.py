@@ -119,6 +119,33 @@ def _load():
             lib.slu_tree_reduce_sum.argtypes = [ctypes.c_void_p,
                                                 ctypes.c_int64, _F64,
                                                 ctypes.c_int64]
+            # bounded-wait collective legs + failure-detector surface
+            # (ISSUE 8): timed variants return 0 ok / 1+rank on timeout;
+            # pid + heartbeat slots feed the Python-side liveness poll;
+            # post/peek are the wait-free ".ftx" agreement board
+            lib.slu_tree_bcast_tw.restype = ctypes.c_int64
+            lib.slu_tree_bcast_tw.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _F64, ctypes.c_int64,
+                ctypes.c_double]
+            lib.slu_tree_reduce_sum_tw.restype = ctypes.c_int64
+            lib.slu_tree_reduce_sum_tw.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, _F64, ctypes.c_int64,
+                ctypes.c_double]
+            lib.slu_tree_set_pid.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int64]
+            lib.slu_tree_get_pid.restype = ctypes.c_int64
+            lib.slu_tree_get_pid.argtypes = [ctypes.c_void_p,
+                                             ctypes.c_int64]
+            lib.slu_tree_heartbeat.argtypes = [ctypes.c_void_p]
+            lib.slu_tree_get_heartbeat.restype = ctypes.c_int64
+            lib.slu_tree_get_heartbeat.argtypes = [ctypes.c_void_p,
+                                                   ctypes.c_int64]
+            lib.slu_tree_post.restype = ctypes.c_int64
+            lib.slu_tree_post.argtypes = [ctypes.c_void_p, _F64,
+                                          ctypes.c_int64]
+            lib.slu_tree_peek.restype = ctypes.c_int64
+            lib.slu_tree_peek.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                          _F64, ctypes.c_int64]
             lib.slu_ata_pattern.restype = ctypes.c_int64
             lib.slu_ata_pattern.argtypes = [
                 ctypes.c_int64, ctypes.c_int64, _I64, _I64, ctypes.c_int64,

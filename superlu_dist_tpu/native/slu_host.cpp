@@ -1488,6 +1488,7 @@ void slu_mlnd(i64 n, const i64* indptr, const i64* indices, i64 leaf_size,
 #include <fcntl.h>
 #include <sys/mman.h>
 #include <sys/stat.h>
+#include <time.h>
 #include <unistd.h>
 
 namespace slu_tree {
@@ -1495,6 +1496,13 @@ namespace slu_tree {
 struct RankSlot {
   std::atomic<uint64_t> seq;
   std::atomic<uint64_t> ack;
+  // failure-detector slots (ISSUE 8): hb is a heartbeat epoch bumped by
+  // the owner's heartbeat thread; pid is the owning process, polled by
+  // peers with kill(pid, 0) so death is detected even when the
+  // heartbeat thread died WITH the process.  Both are pure telemetry —
+  // the collective protocol never reads them.
+  std::atomic<uint64_t> hb;
+  std::atomic<int64_t> pid;
 };
 
 struct Header {
@@ -1545,6 +1553,34 @@ inline void backoff(int& spins) {
   if (++spins < 1024) return;
   ::usleep(50);
 }
+
+inline double mono_now() {
+  struct timespec ts;
+  ::clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (double)ts.tv_sec + 1e-9 * (double)ts.tv_nsec;
+}
+
+// Bounded spin-wait: a short hot-spin phase, then exponential-backoff
+// sleeps with jitter (decorrelates the ranks of a big tree hammering
+// the same cache lines) up to a monotonic deadline.  deadline <= 0
+// means unbounded — the legacy behavior of the untimed entry points.
+struct TimedWait {
+  double deadline;
+  int spins = 0;
+  useconds_t slp = 50;
+  uint64_t rng;
+  explicit TimedWait(double dl, uint64_t seed)
+      : deadline(dl), rng(seed * 2654435769ull + 1) {}
+  bool step() {
+    if (++spins < 512) return true;
+    if (deadline > 0 && mono_now() >= deadline) return false;
+    rng = rng * 6364136223846793005ull + 1442695040888963407ull;
+    useconds_t j = (useconds_t)((rng >> 33) % (uint64_t)(slp / 2 + 1));
+    ::usleep(slp / 2 + j);
+    if (slp < 4000) slp <<= 1;
+    return true;
+  }
+};
 
 }  // namespace slu_tree
 
@@ -1654,12 +1690,25 @@ void* slu_tree_attach_shared(void* creator_handle, i64 rank) {
 // overwriting my slot I wait until every read promised by my PREVIOUS
 // publishes has been acked (cumulative counter), so a slow child can
 // still be copying op t while the tree races ahead to t+1 elsewhere.
-void slu_tree_bcast(void* vh, i64 root, double* buf, i64 len) {
+//
+// Timed variant (ISSUE 8 bounded-wait): EVERY wait runs under one
+// monotonic deadline with exponential backoff + jitter; all waits
+// complete BEFORE any mutation (op bump, memcpy, ack, publish), so a
+// timeout is perfectly resumable — the caller consults the failure
+// detector and either retries this very op or raises.  Returns 0 on
+// success; on timeout, 1 + the rank being waited on, or 1 + n_ranks
+// when the stuck party is an unidentified child (cumulative ack drain).
+// timeout_s <= 0 waits forever (the legacy untimed behavior).
+i64 slu_tree_bcast_tw(void* vh, i64 root, double* buf, i64 len,
+                      double timeout_s) {
   using namespace slu_tree;
   auto* h = (Handle*)vh;
   i64 n = h->hdr->n_ranks;
-  uint64_t op = ++h->op;
-  if (n == 1) return;
+  uint64_t op = h->op + 1;
+  if (n == 1) {
+    h->op = op;
+    return 0;
+  }
   root = ((root % n) + n) % n;   // normalize (root=-1 idiom, bad input)
   i64 v = (h->rank - root + n) % n;
   i64 kids[8];
@@ -1667,34 +1716,57 @@ void slu_tree_bcast(void* vh, i64 root, double* buf, i64 len) {
   children_of(v, n, kids, &n_kids);
   RankSlot& mine = h->slots[h->rank];
   double* my_buf = h->bufs + (size_t)h->rank * h->hdr->max_len;
-  int spins = 0;
+  double dl = timeout_s > 0 ? mono_now() + timeout_s : 0.0;
+  TimedWait w(dl, (uint64_t)h->rank * 0x9e3779b9u + op);
+  // ---- wait phase (side-effect free) ---------------------------------
+  if (n_kids) {
+    while (mine.ack.load(std::memory_order_acquire) < h->my_reads)
+      if (!w.step()) return 1 + n;
+  }
+  i64 p_rank = -1;
   if (v != 0) {
-    i64 p_rank = (parent_of(v, n) + root) % n;
+    p_rank = (parent_of(v, n) + root) % n;
     RankSlot& ps = h->slots[p_rank];
-    while (ps.seq.load(std::memory_order_acquire) < op) backoff(spins);
+    while (ps.seq.load(std::memory_order_acquire) < op)
+      if (!w.step()) return 1 + p_rank;
+  }
+  // ---- commit phase --------------------------------------------------
+  h->op = op;
+  if (v != 0) {
+    RankSlot& ps = h->slots[p_rank];
     std::memcpy(buf, h->bufs + (size_t)p_rank * h->hdr->max_len,
                 (size_t)len * sizeof(double));
     ps.ack.fetch_add(1, std::memory_order_acq_rel);
   }
   if (n_kids) {
-    spins = 0;
-    while (mine.ack.load(std::memory_order_acquire) < h->my_reads)
-      backoff(spins);
     std::memcpy(my_buf, buf, (size_t)len * sizeof(double));
     mine.seq.store(op, std::memory_order_release);
     h->my_reads += (uint64_t)n_kids;
   }
+  return 0;
+}
+
+void slu_tree_bcast(void* vh, i64 root, double* buf, i64 len) {
+  slu_tree_bcast_tw(vh, root, buf, len, 0.0);
 }
 
 // Sum-reduce buf (len doubles) onto the root: on return the root's buf
 // holds the elementwise sum of every rank's input; other ranks' bufs are
-// clobbered with their subtree partial.
-void slu_tree_reduce_sum(void* vh, i64 root, double* buf, i64 len) {
+// clobbered with their subtree partial.  Timed contract identical to
+// slu_tree_bcast_tw: all waits (children present AND my previous
+// publishes acked) precede the first mutation — in particular the
+// child-partial accumulation into buf — so a timeout never leaves a
+// half-summed buffer behind.
+i64 slu_tree_reduce_sum_tw(void* vh, i64 root, double* buf, i64 len,
+                           double timeout_s) {
   using namespace slu_tree;
   auto* h = (Handle*)vh;
   i64 n = h->hdr->n_ranks;
-  uint64_t op = ++h->op;
-  if (n == 1) return;
+  uint64_t op = h->op + 1;
+  if (n == 1) {
+    h->op = op;
+    return 0;
+  }
   root = ((root % n) + n) % n;   // normalize (root=-1 idiom, bad input)
   i64 v = (h->rank - root + n) % n;
   i64 kids[8];
@@ -1702,24 +1774,107 @@ void slu_tree_reduce_sum(void* vh, i64 root, double* buf, i64 len) {
   children_of(v, n, kids, &n_kids);
   RankSlot& mine = h->slots[h->rank];
   double* my_buf = h->bufs + (size_t)h->rank * h->hdr->max_len;
-  int spins = 0;
+  double dl = timeout_s > 0 ? mono_now() + timeout_s : 0.0;
+  TimedWait w(dl, (uint64_t)h->rank * 0x9e3779b9u + op);
+  // ---- wait phase (side-effect free) ---------------------------------
   for (i64 c = 0; c < n_kids; ++c) {
     i64 c_rank = (kids[c] + root) % n;
     RankSlot& cs = h->slots[c_rank];
-    spins = 0;
-    while (cs.seq.load(std::memory_order_acquire) < op) backoff(spins);
+    while (cs.seq.load(std::memory_order_acquire) < op)
+      if (!w.step()) return 1 + c_rank;
+  }
+  if (v != 0) {
+    while (mine.ack.load(std::memory_order_acquire) < h->my_reads)
+      if (!w.step()) return 1 + n;
+  }
+  // ---- commit phase --------------------------------------------------
+  h->op = op;
+  for (i64 c = 0; c < n_kids; ++c) {
+    i64 c_rank = (kids[c] + root) % n;
+    RankSlot& cs = h->slots[c_rank];
     const double* cb = h->bufs + (size_t)c_rank * h->hdr->max_len;
     for (i64 i = 0; i < len; ++i) buf[i] += cb[i];
     cs.ack.fetch_add(1, std::memory_order_acq_rel);
   }
   if (v != 0) {                 // publish subtree partial for my parent
-    spins = 0;
-    while (mine.ack.load(std::memory_order_acquire) < h->my_reads)
-      backoff(spins);
     std::memcpy(my_buf, buf, (size_t)len * sizeof(double));
     mine.seq.store(op, std::memory_order_release);
     h->my_reads += 1;
   }
+  return 0;
+}
+
+void slu_tree_reduce_sum(void* vh, i64 root, double* buf, i64 len) {
+  slu_tree_reduce_sum_tw(vh, root, buf, len, 0.0);
+}
+
+// ---------------------------------------------------------------------------
+// Failure-detector surface (ISSUE 8).  pid + heartbeat live in the
+// RankSlot of the COLLECTIVE domain; the post/peek pair implements the
+// wait-free bulletin board of the sibling ".ftx" agreement domain —
+// each rank writes only its OWN slot (seqlock versioning via the seq
+// counter, which the board domain never uses for collectives), peers
+// poll, and nothing ever blocks on a dead rank.
+// ---------------------------------------------------------------------------
+
+void slu_tree_set_pid(void* vh, i64 pid) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  h->slots[h->rank].pid.store(pid, std::memory_order_release);
+}
+
+i64 slu_tree_get_pid(void* vh, i64 rank) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  return h->slots[rank].pid.load(std::memory_order_acquire);
+}
+
+void slu_tree_heartbeat(void* vh) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  h->slots[h->rank].hb.fetch_add(1, std::memory_order_acq_rel);
+}
+
+i64 slu_tree_get_heartbeat(void* vh, i64 rank) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  return (i64)h->slots[rank].hb.load(std::memory_order_acquire);
+}
+
+// Publish len doubles into my board slot.  Odd seq = write in progress,
+// even = committed; returns the committed version (>= 2).
+i64 slu_tree_post(void* vh, double* buf, i64 len) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  RankSlot& mine = h->slots[h->rank];
+  double* my_buf = h->bufs + (size_t)h->rank * h->hdr->max_len;
+  uint64_t s = mine.seq.load(std::memory_order_relaxed) & ~1ull;
+  mine.seq.store(s + 1, std::memory_order_release);
+  std::memcpy(my_buf, buf, (size_t)len * sizeof(double));
+  mine.seq.store(s + 2, std::memory_order_release);
+  return (i64)(s + 2);
+}
+
+// Read rank's board slot into out.  Returns the committed version read
+// (0 = never posted, -1 = could not get a consistent snapshot — e.g.
+// the writer died mid-post; callers treat both as "no data").
+i64 slu_tree_peek(void* vh, i64 rank, double* out, i64 len) {
+  using namespace slu_tree;
+  auto* h = (Handle*)vh;
+  RankSlot& rs = h->slots[rank];
+  const double* rb = h->bufs + (size_t)rank * h->hdr->max_len;
+  for (int tries = 0; tries < 200; ++tries) {
+    uint64_t s1 = rs.seq.load(std::memory_order_acquire);
+    if (s1 == 0) return 0;
+    if (s1 & 1) {
+      ::usleep(20);
+      continue;
+    }
+    std::memcpy(out, rb, (size_t)len * sizeof(double));
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (rs.seq.load(std::memory_order_acquire) == s1) return (i64)s1;
+  }
+  return -1;
 }
 
 }  // extern "C"

@@ -505,7 +505,16 @@ def panalyze(tc: TreeComm, options, a_loc: DistributedCSR, stats=None,
     root-analysis path of parallel/pgssvx._pgssvx_mesh.
 
     Falls back to the serial root analysis for problems too small to
-    partition (n < 64·P)."""
+    partition (n < 64·P).
+
+    Rank-failure tolerance: every stage is parameterized ONLY by
+    (tc.n_ranks, tc.rank) and the re-dealt input rows, never by a
+    remembered world size — which is what lets a recovery epoch
+    (parallel/recover.pgssvx_ft, Options.ft="shrink") simply re-run this
+    partitioning over the surviving rank set after a peer died
+    mid-analysis; a death inside any collective here surfaces as
+    RankFailureError on every survivor once SLU_TPU_COMM_TIMEOUT_S
+    bounds the legs."""
     from superlu_dist_tpu.drivers.gssvx import LUFactorization, analyze
     from superlu_dist_tpu.numeric.plan import build_plan
     from superlu_dist_tpu.parallel.pgssvx import gather_distributed

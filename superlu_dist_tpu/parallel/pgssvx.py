@@ -170,7 +170,8 @@ def root_analyze_bcast(tc: TreeComm, options, a_loc: DistributedCSR,
 
 def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
            b_loc: np.ndarray, root: int = 0, grid=None, lu=None,
-           lu_out=None, replicate_analysis: bool = False):
+           lu_out=None, replicate_analysis: bool = False,
+           resume_from: str | None = None):
     """Collectively solve op(A)·X = B from block-row distributed input.
 
     b_loc: (m_loc,) or (m_loc, nrhs) — this rank's block rows of B.
@@ -209,6 +210,20 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     with the new values; FACTORED skips straight to the collective
     solve on the existing sharded factors.
 
+    `resume_from` names a durable factor-checkpoint frontier
+    (persist/checkpoint.py) for the ROOT factorization of the fallback
+    tier — the rank-failure recovery path (parallel/recover.py,
+    Options.ft="shrink"/"respawn") threads the previous epoch's
+    checkpoint directory through here so the surviving ranks complete
+    the factorization instead of redoing it; the fingerprint/digest
+    verification inside gssvx guarantees the resumed frontier belongs
+    to this exact analysis.  Rank failure itself surfaces here as
+    RankFailureError on EVERY surviving rank (the bounded-wait
+    collectives + failure detector in parallel/treecomm.py) — this
+    driver never hangs on a dead peer once SLU_TPU_COMM_TIMEOUT_S is
+    armed, and never retries on its own: recovery policy lives in
+    parallel/recover.pgssvx_ft.
+
     Solve health: when refinement ran, lu_out["stats"].solve_report
     carries berr (+ history) from the distributed loop; if it stagnated
     above the recovery target and options.recovery is enabled, ONE
@@ -218,6 +233,7 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
     """
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.parallel.pgsrfs import pgsrfs
+    from superlu_dist_tpu.utils.errors import CheckpointError
     from superlu_dist_tpu.utils.options import IterRefine, Trans
     import dataclasses
 
@@ -259,8 +275,18 @@ def pgssvx(tc: TreeComm, options, a_loc: DistributedCSR,
         # `lu` threads the Fact reuse tiers through (root-held handle)
         opts0 = dataclasses.replace(options,
                                     iter_refine=IterRefine.NOREFINE)
-        x_r, lu, stats, info_r = gssvx(
-            opts0, a_root, b_full if nrhs > 1 else b_full[:, 0], lu=lu)
+        try:
+            x_r, lu, stats, info_r = gssvx(
+                opts0, a_root, b_full if nrhs > 1 else b_full[:, 0],
+                lu=lu, resume_from=resume_from)
+        except CheckpointError:
+            # an unusable recovery frontier (corrupt / wrong plan) must
+            # degrade to a from-scratch factorization, not strand the
+            # peers: the retry is root-LOCAL and leaves the collective
+            # sequence untouched (the peers only see the info bcast)
+            x_r, lu, stats, info_r = gssvx(
+                opts0, a_root, b_full if nrhs > 1 else b_full[:, 0],
+                lu=lu)
         info[0] = float(info_r)
         if lu_out is not None:
             lu_out["lu"] = lu

@@ -21,6 +21,7 @@ import contextlib
 import ctypes
 import os
 import sys
+import threading
 import time
 
 import numpy as np
@@ -55,10 +56,12 @@ class LockstepVerifier:
     Composite ops verify ONCE at their public entry (``depth`` guards
     the inner legs), so the digest carries the caller's intent —
     ``allreduce_sum_any`` with the real payload shape/dtype — not the
-    transport decomposition.  Limitation: a rank that stops calling
-    collectives altogether still hangs its peers inside the digest
-    exchange (nothing to cross-check against); divergence in *what* is
-    called is what this converts into an error.
+    transport decomposition.  The digest exchange rides the owning
+    TreeComm's bounded-wait legs, so with ``SLU_TPU_COMM_TIMEOUT_S`` set
+    a rank that stops calling collectives altogether (died, hung)
+    surfaces as :class:`RankFailureError` on every peer — SILENCE is
+    covered by the failure detector the same way DIVERGENCE is covered
+    by the digest cross-check; neither hangs the fleet.
     """
 
     SHAPE_SLOTS = 3
@@ -87,6 +90,10 @@ class LockstepVerifier:
             raise OSError(f"slu_tree_attach failed for verifier domain "
                           f"{self.name!r}")
         self._created = bool(create)
+        # set by the owning TreeComm: routes the digest exchange through
+        # its bounded-wait leg policy (timeout + failure detector), so a
+        # silent rank fails this exchange structurally too
+        self.comm = None
 
     # ---- lifecycle -----------------------------------------------------
     def close(self, unlink: bool | None = None):
@@ -116,12 +123,20 @@ class LockstepVerifier:
         rec = self._encode(op, shape, dtype, root, _call_site())
         buf = np.zeros(self.n_ranks * self.REC, dtype=np.float64)
         buf[self.rank * self.REC:(self.rank + 1) * self.REC] = rec
-        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
         # digest allreduce over the sibling domain: identical native-leg
         # structure for every public op, so it completes even when the
-        # public sequences have diverged
-        self._lib.slu_tree_reduce_sum(self._h, 0, ptr, buf.size)
-        self._lib.slu_tree_bcast(self._h, 0, ptr, buf.size)
+        # public sequences have diverged; routed through the owning
+        # TreeComm's bounded-wait policy so a SILENT (dead) rank raises
+        # RankFailureError here instead of hanging the exchange
+        if self.comm is not None:
+            self.comm._native_leg("reduce_sum", buf, 0, handle=self._h,
+                                  op_name=f"verify:{op}")
+            self.comm._native_leg("bcast", buf, 0, handle=self._h,
+                                  op_name=f"verify:{op}")
+        else:
+            ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+            self._lib.slu_tree_reduce_sum(self._h, 0, ptr, buf.size)
+            self._lib.slu_tree_bcast(self._h, 0, ptr, buf.size)
         self.seq += 1
         self.checks += 1
         mat = buf.reshape(self.n_ranks, self.REC)
@@ -195,6 +210,166 @@ def _call_site() -> str:
     return "/".join(parts[-2:]) + f":{f.f_lineno}"
 
 
+def _is_zombie(pid: int) -> bool:
+    """True when /proc says the process is a zombie (Linux; False where
+    /proc is unavailable — there kill(pid, 0) alone decides)."""
+    try:
+        with open(f"/proc/{pid}/stat", "rb") as f:
+            data = f.read()
+        # field 3, after the parenthesized comm (which may contain spaces)
+        return data.rsplit(b")", 1)[1].split()[0] == b"Z"
+    except (OSError, IndexError):
+        return False
+
+
+class FailureDetector:
+    """Per-rank heartbeat + pid liveness + the ``.ftx`` agreement board.
+
+    The shared segment of the COLLECTIVE domain carries, per rank, a
+    pid slot (written once at attach) and a heartbeat epoch (bumped by
+    a daemon thread every ``SLU_TPU_HEARTBEAT_S``).  Liveness is judged
+    by the *process*, not the heartbeat: ``os.kill(pid, 0)`` raising
+    ``ProcessLookupError`` is the death verdict, so a rank whose
+    heartbeat thread died with it is still detected — and a STALLED
+    rank (alive pid, stale heartbeat) is never declared failed, only
+    waited on (the slow-not-dead discipline; ``heartbeat_age`` is a
+    gauge, not a verdict).
+
+    The agreement board is a SIBLING shared-memory domain
+    (``<name>.ftx``) used only through the wait-free post/peek
+    primitives: each survivor publishes its observed dead-set into its
+    OWN slot and polls the others — by construction nothing on this
+    domain ever blocks on the dead rank, which is how the survivors
+    converge on one dead-set (ULFM's revoke→agree shape) and all raise
+    the same :class:`RankFailureError`.
+    """
+
+    BOARD_LEN = 4          # [MAGIC, epoch, dead-mask, pad]
+    MAGIC = 7355.0
+
+    def __init__(self, lib, name: bytes, n_ranks: int, rank: int,
+                 create: bool, main_handle):
+        if n_ranks > 52:
+            raise ValueError("failure detector dead-mask rides the f64 "
+                             f"mantissa: n_ranks {n_ranks} > 52")
+        self._lib = lib
+        self.name = bytes(name) + b".ftx"
+        self.n_ranks = int(n_ranks)
+        self.rank = int(rank)
+        self._main = main_handle      # pid/hb slots live in the MAIN domain
+        self._h = lib.slu_tree_attach(self.name, self.n_ranks,
+                                      self.BOARD_LEN, self.rank,
+                                      1 if create else 0)
+        if not self._h:
+            raise OSError(f"slu_tree_attach failed for detector domain "
+                          f"{self.name!r}")
+        self._created = bool(create)
+        self._hb_stop = threading.Event()
+        self._hb_thread = None
+        # rank -> (last seen hb count, monotonic time it changed): the
+        # heartbeat-age gauge's bookkeeping
+        self._hb_seen: dict = {}
+
+    # ---- heartbeat ------------------------------------------------------
+    def start_heartbeat(self, interval: float) -> None:
+        if self._hb_thread is not None or interval <= 0:
+            return
+
+        def run():
+            m = get_metrics()
+            while not self._hb_stop.wait(interval):
+                h = self._h
+                if h is None:
+                    return
+                self._lib.slu_tree_heartbeat(self._main)
+                if m.enabled:
+                    for r in range(self.n_ranks):
+                        m.set("slu_heartbeat_age_seconds",
+                              self.heartbeat_age(r), rank=str(r))
+
+        self._hb_thread = threading.Thread(
+            target=run, name="slu-heartbeat", daemon=True)
+        self._hb_thread.start()
+
+    def heartbeat_age(self, rank: int) -> float:
+        """Seconds since ``rank``'s heartbeat epoch last advanced (0.0
+        for my own rank and for counters seen to move this poll)."""
+        now = time.monotonic()
+        if rank == self.rank:
+            return 0.0
+        cur = int(self._lib.slu_tree_get_heartbeat(self._main, rank))
+        seen = self._hb_seen.get(rank)
+        if seen is None or seen[0] != cur:
+            self._hb_seen[rank] = (cur, now)
+            return 0.0
+        return now - seen[1]
+
+    # ---- liveness -------------------------------------------------------
+    def pid(self, rank: int) -> int:
+        return int(self._lib.slu_tree_get_pid(self._main, rank))
+
+    def dead_ranks(self) -> set:
+        """Ranks whose registered pid no longer exists.  A rank that
+        never registered (pid 0) is UNKNOWN, not dead; a pid we may not
+        signal (EPERM) is alive.  An unreaped ZOMBIE (a dead child
+        whose parent — often the detecting test harness itself — has
+        not waited on it yet) still answers ``kill(pid, 0)``, so on
+        Linux the /proc state is consulted too: Z is dead for every
+        communication purpose."""
+        out = set()
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            p = self.pid(r)
+            if p <= 0:
+                continue
+            try:
+                os.kill(p, 0)
+            except ProcessLookupError:
+                out.add(r)
+                continue
+            except PermissionError:
+                continue
+            if _is_zombie(p):
+                out.add(r)
+        return out
+
+    # ---- agreement board ------------------------------------------------
+    def post_failure(self, dead: set, epoch: int) -> None:
+        buf = np.zeros(self.BOARD_LEN, dtype=np.float64)
+        buf[0] = self.MAGIC
+        buf[1] = float(epoch)
+        buf[2] = float(sum(1 << int(r) for r in dead))
+        self._lib.slu_tree_post(
+            self._h, buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            buf.size)
+
+    def posted_failures(self, epoch: int) -> dict:
+        """{rank: dead-set} of every peer that has posted a failure
+        declaration for this epoch (non-blocking)."""
+        out = {}
+        buf = np.zeros(self.BOARD_LEN, dtype=np.float64)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        for r in range(self.n_ranks):
+            if r == self.rank:
+                continue
+            v = int(self._lib.slu_tree_peek(self._h, r, ptr, buf.size))
+            if v <= 0 or buf[0] != self.MAGIC or int(buf[1]) != epoch:
+                continue
+            mask = int(buf[2])
+            out[r] = {i for i in range(self.n_ranks) if mask >> i & 1}
+        return out
+
+    def close(self, unlink: bool | None = None) -> None:
+        self._hb_stop.set()
+        if self._h:
+            if unlink is None:
+                unlink = self._created
+            self._lib.slu_tree_detach(self._h, self.name,
+                                      1 if unlink else 0)
+            self._h = None
+
+
 class TreeComm:
     """One rank's attachment to a named tree-collective domain.
 
@@ -244,20 +419,160 @@ class TreeComm:
         self._metrics = m if m.enabled else None
         # lockstep-verify mode (runtime SLU106): OFF means NO verifier
         # state at all — self._verifier stays None and the collective
-        # path pays one attribute test (see _verified)
-        from superlu_dist_tpu.utils.options import env_flag
+        # path pays one attribute test (see _entered)
+        from superlu_dist_tpu.utils.options import (env_flag, env_float,
+                                                    env_int)
         self._verifier = None
         if env_flag("SLU_TPU_VERIFY_COLLECTIVES"):
             self._verifier = LockstepVerifier(lib, self.name, self.n_ranks,
                                               self.rank, bool(create))
+            self._verifier.comm = self
+        # rank-failure tolerance (ISSUE 8): register my pid in the shared
+        # segment (peers poll it for liveness), and with a comm timeout
+        # armed build the failure detector + heartbeat.  Timeout unset
+        # (the default) keeps the legacy unbounded waits and allocates
+        # NO detector state.
+        lib.slu_tree_set_pid(self._h, os.getpid())
+        self.epoch = 0                 # bumped by recovery rebuilds
+        self.seq = 0                   # public collective count
+        self._depth = 0                # public-entry nesting guard
+        self._timeout_s = float(env_float("SLU_TPU_COMM_TIMEOUT_S"))
+        self._retries = int(env_int("SLU_TPU_COMM_RETRIES"))
+        self._detector = None
+        if self._timeout_s > 0:
+            self._detector = FailureDetector(lib, self.name, self.n_ranks,
+                                             self.rank, bool(create),
+                                             self._h)
+            self._detector.start_heartbeat(env_float("SLU_TPU_HEARTBEAT_S"))
+        # comm-layer chaos injection (testing/chaos.py kill_rank/stall_rank
+        # specs), latched once — None is the production fast path; the
+        # bind gives rank-scoped FACTOR-loop injections (kill_rank@group)
+        # this process's distributed identity
+        from superlu_dist_tpu.testing.chaos import bind_rank, get_comm_chaos
+        bind_rank(self.rank, self.epoch)
+        self._chaos = get_comm_chaos()
 
-    def _verified(self, op: str, shape, dtype, root: int):
-        """Context manager entering the lockstep check for one public
-        collective (no-op singleton when verification is off)."""
-        v = self._verifier
-        if v is None:
-            return _NULL_CTX
-        return v.guard(op, shape, str(dtype), root)
+    @contextlib.contextmanager
+    def _entered(self, op: str, shape, dtype, root: int):
+        """Public-collective entry: ONE nesting-guarded hook where, at
+        the outermost op only, (a) the comm-chaos injector ticks, (b) a
+        peer's posted rank-failure is joined (so ranks that are sailing
+        ahead of the stuck subtree still raise promptly), and (c) the
+        SLU106 lockstep digest is exchanged.  Inner legs of composite
+        ops skip all three — their structure is a deterministic function
+        of the verified public op."""
+        outer = self._depth == 0
+        self._depth += 1
+        try:
+            if outer:
+                self.seq += 1
+                c = self._chaos
+                if c is not None:
+                    c.on_collective(self.seq,
+                                    getattr(self, "chaos_rank", self.rank),
+                                    self.epoch)
+                if self._detector is not None:
+                    self._join_posted(op)
+            v = self._verifier
+            if v is None or not outer:
+                yield
+            else:
+                with v.guard(op, shape, str(dtype), root):
+                    yield
+        finally:
+            self._depth -= 1
+
+    # ---- bounded-wait transport policy ---------------------------------
+    def _native_leg(self, kind: str, buf: np.ndarray, root: int,
+                    handle=None, op_name: str | None = None) -> None:
+        """One native tree leg.  Without a comm timeout this is the
+        legacy unbounded spin.  With ``SLU_TPU_COMM_TIMEOUT_S`` armed,
+        the leg waits at most the timeout, then consults the failure
+        detector: a DEAD peer converts the hang into a
+        :class:`RankFailureError` on every survivor (agreement via the
+        .ftx board); a live peer is retried — indefinitely by default,
+        or up to ``SLU_TPU_COMM_RETRIES`` before
+        :class:`CommTimeoutError` (the slow-not-dead verdict never
+        declares a live rank failed)."""
+        h = self._h if handle is None else handle
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        lib = self._lib
+        if self._detector is None:
+            if kind == "bcast":
+                lib.slu_tree_bcast(h, int(root), ptr, buf.size)
+            else:
+                lib.slu_tree_reduce_sum(h, int(root), ptr, buf.size)
+            return
+        fn = (lib.slu_tree_bcast_tw if kind == "bcast"
+              else lib.slu_tree_reduce_sum_tw)
+        op = op_name or kind
+        m = self._metrics
+        attempts = 0
+        while True:
+            rc = int(fn(h, int(root), ptr, buf.size,
+                        float(self._timeout_s)))
+            if rc == 0:
+                return
+            stuck = rc - 1          # == n_ranks: unidentified (ack drain)
+            attempts += 1
+            if m is not None:
+                m.inc("slu_comm_timeouts_total", 1.0, op=op)
+            dead = self._detector.dead_ranks()
+            posted = self._detector.posted_failures(self.epoch)
+            if dead or posted:
+                self._rank_failure(op, dead)
+            if self._retries and attempts >= self._retries:
+                from superlu_dist_tpu.utils.errors import CommTimeoutError
+                raise CommTimeoutError(op, stuck, self._timeout_s,
+                                       attempts, seq=self.seq,
+                                       site=_call_site())
+            if m is not None:
+                m.inc("slu_comm_retries_total", 1.0)
+
+    def _join_posted(self, op: str) -> None:
+        """Cheap board peek at public-collective entry: a peer already
+        declared a failure for this epoch — join the agreement and raise
+        here too, instead of discovering it only when MY leg eventually
+        blocks on the stuck subtree."""
+        if self._detector.posted_failures(self.epoch):
+            self._rank_failure(op, set())
+
+    def _rank_failure(self, op: str, dead: set):
+        """Agreement + raise (never returns).  Converge on the union of
+        every survivor's observed dead-set: post mine, merge the board
+        and fresh pid scans, and wait (bounded by ~1 timeout) until
+        every live peer has posted a matching set or died — then every
+        survivor raises the SAME RankFailureError."""
+        d = self._detector
+        dead = set(dead) | d.dead_ranks()
+        deadline = time.monotonic() + max(self._timeout_s, 0.5)
+        posted_mask = None
+        while True:
+            posted = d.posted_failures(self.epoch)
+            for peers in posted.values():
+                dead |= peers
+            if posted_mask != dead:
+                d.post_failure(dead, self.epoch)
+                posted_mask = set(dead)
+                posted = d.posted_failures(self.epoch)
+            live = [r for r in range(self.n_ranks)
+                    if r != self.rank and r not in dead]
+            # convergence on POSTS first: a survivor that already agreed
+            # (posted this dead-set) and then exited — e.g. its caller
+            # chose ft="abort" — must not be folded into THIS failure's
+            # dead-set; only scan pids while still unconverged
+            if all(posted.get(r) == dead for r in live):
+                break
+            dead |= d.dead_ranks()
+            if time.monotonic() >= deadline:
+                break               # late peers join via their own
+            time.sleep(0.005)       # timeout or board check
+        if self._metrics is not None:
+            self._metrics.inc("slu_ft_failures_total", 1.0, op=op)
+        from superlu_dist_tpu.utils.errors import RankFailureError
+        raise RankFailureError(dead, op=op, seq=self.seq,
+                               site=_call_site(), rank=self.rank,
+                               n_ranks=self.n_ranks, epoch=self.epoch)
 
     def _account(self, op: str, nbytes: int, t0: float, root: int):
         """One collective leg completed: count it, and emit a comm span
@@ -288,12 +603,9 @@ class TreeComm:
         otherwise the result lives in the returned copy."""
         buf = self._prep(buf)
         op = self._op_label or "bcast"
-        with self._verified("bcast", buf.shape, buf.dtype, root):
+        with self._entered("bcast", buf.shape, buf.dtype, root):
             t0 = time.perf_counter()
-            self._lib.slu_tree_bcast(
-                self._h, int(root),
-                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-                buf.size)
+            self._native_leg("bcast", buf, root)
             self._account(op, buf.nbytes, t0, root)
         return buf
 
@@ -302,12 +614,9 @@ class TreeComm:
         on the root; see bcast for the in-place caveat)."""
         buf = self._prep(buf)
         op = self._op_label or "reduce"
-        with self._verified("reduce_sum", buf.shape, buf.dtype, root):
+        with self._entered("reduce_sum", buf.shape, buf.dtype, root):
             t0 = time.perf_counter()
-            self._lib.slu_tree_reduce_sum(
-                self._h, int(root),
-                buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
-                buf.size)
+            self._native_leg("reduce_sum", buf, root)
             self._account(op, buf.nbytes, t0, root)
         return buf
 
@@ -325,7 +634,7 @@ class TreeComm:
     def allreduce_sum(self, buf: np.ndarray, root: int = 0) -> np.ndarray:
         """reduce_sum then bcast — the composite the reference builds from
         its RdTree + BcTree pair per supernode."""
-        with self._verified("allreduce_sum", np.shape(buf),
+        with self._entered("allreduce_sum", np.shape(buf),
                             getattr(buf, "dtype", "float64"), root):
             with self._labeled("allreduce"):
                 buf = self.reduce_sum(buf, root)
@@ -362,18 +671,18 @@ class TreeComm:
     def bcast_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         """Broadcast a payload of any dtype/shape (returns a new array)."""
         arr = np.asarray(arr)
-        with self._verified("bcast_any", arr.shape, arr.dtype, root):
+        with self._entered("bcast_any", arr.shape, arr.dtype, root):
             return self._payload_op(arr, root, self.bcast)
 
     def reduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         """Sum-reduce a payload of any dtype/shape onto root."""
         arr = np.asarray(arr)
-        with self._verified("reduce_sum_any", arr.shape, arr.dtype, root):
+        with self._entered("reduce_sum_any", arr.shape, arr.dtype, root):
             return self._payload_op(arr, root, self.reduce_sum)
 
     def allreduce_sum_any(self, arr: np.ndarray, root: int = 0) -> np.ndarray:
         arr = np.asarray(arr)
-        with self._verified("allreduce_sum_any", arr.shape, arr.dtype,
+        with self._entered("allreduce_sum_any", arr.shape, arr.dtype,
                             root):
             return self._payload_op(arr, root, self.allreduce_sum)
 
@@ -386,7 +695,7 @@ class TreeComm:
         """Broadcast a byte string from root (non-root passes None)."""
         # digest carries op/site/seq only: non-root ranks don't know the
         # length yet (the inner length bcast is depth-exempt)
-        with self._verified("bcast_bytes", (), "bytes", root):
+        with self._entered("bcast_bytes", (), "bytes", root):
             with self._labeled("bcast_bytes"):
                 return self._bcast_bytes(data, root)
 
@@ -413,7 +722,7 @@ class TreeComm:
         The root gets its ORIGINAL object back (no redundant second copy
         through pickle on the rank whose memory matters most)."""
         import pickle
-        with self._verified("bcast_obj", (), "obj", root):
+        with self._entered("bcast_obj", (), "obj", root):
             blob = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL) \
                 if self.rank == root else None
             data = self.bcast_bytes(blob, root=root)
@@ -425,6 +734,8 @@ class TreeComm:
                 unlink = self._created
             if self._verifier is not None:
                 self._verifier.close(unlink)
+            if self._detector is not None:
+                self._detector.close(unlink)
             self._lib.slu_tree_detach(self._h, self.name,
                                       1 if unlink else 0)
             self._h = None

@@ -16,6 +16,15 @@ Enable in a victim process via the registered env knob::
                                               # the checkpoint/flightrec
                                               # SIGTERM chain)
     SLU_TPU_CHAOS='nan_supernode=3'         # poison supernode 3's values
+    SLU_TPU_CHAOS='kill_rank=1@group=3'     # only rank 1 dies, after its
+                                              # dispatch group 3 (the
+                                              # rank-failure domain)
+    SLU_TPU_CHAOS='kill_rank=1,kill_op=4'   # rank 1 dies right before its
+                                              # 4th public collective
+    SLU_TPU_CHAOS='stall_rank=1,secs=2'     # rank 1 sleeps 2 s before a
+                                              # collective: slow, NOT dead
+                                              # — the detector must not
+                                              # declare it failed
 
 The factor path consults :func:`get_chaos` once per factorization
 (numeric/factor.py) and the streamed executor calls
@@ -63,16 +72,34 @@ class ChaosPlan:
     signal: str = "kill"      # "kill" (SIGKILL, the kill -9 domain) or
                               # "term" (SIGTERM — handlers run first)
     nan_supernode: int = -1   # poison this supernode's A-entries
+    # ---- rank-failure domain (ISSUE 8) --------------------------------
+    kill_rank: int = -1       # scope kill_group/kill_op to this rank
+                              # (-1 = any rank, the single-process case)
+    kill_op: int = -1         # die right before this public collective
+                              # (1-based count on the victim's TreeComm)
+    stall_rank: int = -1      # this rank sleeps `secs` before a
+    secs: float = 0.0         # collective — slow-NOT-dead injection
+    stall_op: int = 1         # ...before this public collective
+    epoch: int = 0            # comm injections fire only in this
+                              # TreeComm epoch (so a shrunken/respawned
+                              # recovery epoch is not re-injected)
 
     @property
     def armed(self) -> bool:
-        return self.kill_group >= 0 or self.nan_supernode >= 0
+        return (self.kill_group >= 0 or self.nan_supernode >= 0
+                or self.comm_armed)
+
+    @property
+    def comm_armed(self) -> bool:
+        return self.kill_op >= 0 or self.stall_rank >= 0
 
 
 def parse_chaos_spec(spec: str) -> ChaosPlan:
     """'kill_group=5,signal=term' -> ChaosPlan.  Unknown keys raise —
     a typo'd knob silently injecting nothing would defeat the test
-    (the parse_fault_spec discipline)."""
+    (the parse_fault_spec discipline).  'kill_rank=R@group=G' is the
+    rank-failure shorthand: rank R SIGKILLs itself after its dispatch
+    group G."""
     plan = ChaosPlan()
     for part in spec.split(","):
         part = part.strip()
@@ -80,8 +107,16 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
             continue
         key, _, val = part.partition("=")
         key = key.strip()
-        if key in ("kill_group", "nan_supernode"):
+        if key == "kill_rank":
+            rank, at, group = val.partition("@group=")
+            plan.kill_rank = int(rank)
+            if at:
+                plan.kill_group = int(group)
+        elif key in ("kill_group", "nan_supernode", "kill_op",
+                     "stall_rank", "stall_op", "epoch"):
             setattr(plan, key, int(val))
+        elif key == "secs":
+            plan.secs = float(val)
         elif key == "signal":
             val = val.strip().lower()
             if val not in ("kill", "term"):
@@ -93,29 +128,73 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
     return plan
 
 
+# the victim's distributed identity, bound by TreeComm construction (and
+# re-bound with the ORIGINAL rank id by recovery epochs, so a survivor
+# renumbered into a dead rank's slot never inherits its injection)
+_BOUND = {"rank": -1, "epoch": 0}
+
+
+def bind_rank(rank: int, epoch: int = 0) -> None:
+    """Record this process's rank identity for rank-scoped injections
+    (called by TreeComm.__init__ / recovery epoch builds)."""
+    _BOUND["rank"] = int(rank)
+    _BOUND["epoch"] = int(epoch)
+
+
 class ChaosMonkey:
     """One factorization's injector (built from a ChaosPlan)."""
 
     def __init__(self, plan: ChaosPlan):
         self.plan = plan
         self.groups_seen = 0
+        self._stalled = False
+
+    def _kill_self(self) -> None:
+        sig = (signal.SIGTERM if self.plan.signal == "term"
+               else signal.SIGKILL)
+        os.kill(os.getpid(), sig)
+        if sig == signal.SIGTERM:
+            # handlers (checkpoint flush, flightrec dump) ran and
+            # chained to the default disposition; if something
+            # swallowed it, die anyway — the injection must kill
+            os.kill(os.getpid(), signal.SIGKILL)
 
     # ---- process-kill domain -------------------------------------------
     def on_group(self, gi: int) -> None:
         """Called by the streamed executor after group ``gi`` completes.
         The kill lands AFTER the group's panels are emitted (and after
         any interval checkpoint for it), modeling a preemption between
-        dispatch groups — the boundary the resume path restarts from."""
+        dispatch groups — the boundary the resume path restarts from.
+        ``kill_rank=R@group=G`` scopes the kill to the rank bound by
+        :func:`bind_rank` (any rank when unscoped — the single-process
+        back-compat case), in epoch ``epoch`` only."""
         self.groups_seen += 1
-        if gi == self.plan.kill_group:
-            sig = (signal.SIGTERM if self.plan.signal == "term"
-                   else signal.SIGKILL)
-            os.kill(os.getpid(), sig)
-            if sig == signal.SIGTERM:
-                # handlers (checkpoint flush, flightrec dump) ran and
-                # chained to the default disposition; if something
-                # swallowed it, die anyway — the injection must kill
-                os.kill(os.getpid(), signal.SIGKILL)
+        if gi != self.plan.kill_group:
+            return
+        if self.plan.kill_rank >= 0 and (
+                _BOUND["rank"] != self.plan.kill_rank
+                or _BOUND["epoch"] != self.plan.epoch):
+            return
+        self._kill_self()
+
+    # ---- rank-failure domain (comm layer) -------------------------------
+    def on_collective(self, seq: int, rank: int, epoch: int) -> None:
+        """Called by TreeComm at every outermost public collective
+        (``seq`` is 1-based).  ``kill_op`` dies right BEFORE entering
+        the op — the silent-rank domain the failure detector must
+        convert into RankFailureError on the peers; ``stall_rank`` just
+        sleeps, and the detector must NOT declare it failed."""
+        p = self.plan
+        if epoch != p.epoch:
+            return
+        if p.kill_op >= 0 and seq >= p.kill_op and \
+                p.kill_rank in (-1, rank):
+            self._kill_self()
+        if p.stall_rank == rank and not self._stalled and \
+                seq >= p.stall_op and p.secs > 0:
+            self._stalled = True
+            import time
+            time.sleep(p.secs)
 
     # ---- numeric-poison domain -----------------------------------------
     def poke_nan(self, plan, pattern_values: np.ndarray) -> np.ndarray:
@@ -151,6 +230,16 @@ def get_chaos() -> ChaosMonkey | None:
     return ChaosMonkey(plan) if plan.armed else None
 
 
+def get_comm_chaos() -> ChaosMonkey | None:
+    """Comm-layer injector for TreeComm (kill_op / stall_rank specs).
+    None unless a COMM injection is armed, so the per-collective hook
+    stays one ``is None`` test on the production path."""
+    monkey = get_chaos()
+    if monkey is None or not monkey.plan.comm_armed:
+        return None
+    return monkey
+
+
 # ---------------------------------------------------------------------------
 # outside-the-victim helpers
 # ---------------------------------------------------------------------------
@@ -183,10 +272,11 @@ class DyingTreeComm(TreeComm):
     """A rank that dies mid-protocol: after ``die_after`` completed
     public collectives the NEXT one ``os._exit``\\ s with
     :data:`RANK_DEATH_EXIT` instead of participating — the simulated
-    rank-death failure domain.  Peers blocked on the abandoned
-    collective hang (the documented LockstepVerifier limitation: a rank
-    that stops calling collectives leaves nothing to cross-check), which
-    is exactly what :class:`HangWatchdog` exists to bound."""
+    rank-death failure domain.  With ``SLU_TPU_COMM_TIMEOUT_S`` armed
+    the peers' failure detector converts the abandoned collective into
+    :class:`RankFailureError` on every survivor; with bounded waits OFF
+    the peers hang, which is what :class:`HangWatchdog` exists to
+    bound."""
 
     def __init__(self, *args, die_after: int = 0, **kwargs):
         super().__init__(*args, **kwargs)
@@ -228,11 +318,20 @@ class CountdownDeadline(Deadline):
 
 
 class HangWatchdog:
-    """Bounded-hang guard for chaos tests and serving loops: unless
-    :meth:`disarm` runs within ``seconds``, dump the flight recorder
-    (when enabled) and ``os._exit(exit_code)``.  A daemon timer —
-    deliberately NOT a signal, so it fires even while the main thread is
-    blocked inside a native collective."""
+    """Bounded-hang guard of LAST RESORT for chaos tests and serving
+    loops: unless :meth:`disarm` runs within ``seconds``, dump the
+    flight recorder (when enabled) and ``os._exit(exit_code)``.  A
+    daemon timer — deliberately NOT a signal, so it fires even while the
+    main thread is blocked inside a native collective.
+
+    Since ISSUE 8 the FIRST line of defense against a dead peer is the
+    failure detector (``SLU_TPU_COMM_TIMEOUT_S`` bounded-wait legs +
+    pid liveness): a dead rank raises a structured, recoverable
+    :class:`~superlu_dist_tpu.utils.errors.RankFailureError` on every
+    survivor, and the watchdog never fires.  Keep the watchdog armed
+    only for the domains the detector cannot see — mesh/XLA in-program
+    collectives, or a transport wedged with every pid still alive —
+    and expect ``os._exit(3)`` to mean exactly that."""
 
     def __init__(self, seconds: float, exit_code: int = HANG_EXIT,
                  reason: str = "hang-watchdog"):

@@ -112,6 +112,70 @@ class CheckpointMismatchError(CheckpointError):
     silently splice incompatible frontiers, so this is a hard error."""
 
 
+class CommTimeoutError(SuperLUError):
+    """A bounded-wait collective leg (``SLU_TPU_COMM_TIMEOUT_S``) kept
+    timing out on a peer whose process is still ALIVE, and the retry
+    budget (``SLU_TPU_COMM_RETRIES`` > 0) ran out.  This is the
+    slow-not-dead verdict: the failure detector refused to declare the
+    peer failed (its pid answers ``kill(pid, 0)``), so the caller gets a
+    timeout, not a :class:`RankFailureError` — retrying later, raising
+    the timeout, or widening the budget are all sound.  With the default
+    unlimited retries (``SLU_TPU_COMM_RETRIES=0``) this error never
+    fires: live-but-slow peers are waited out indefinitely."""
+
+    def __init__(self, op: str, stuck_rank: int, timeout_s: float,
+                 retries: int, seq: int = -1, site: str = ""):
+        self.op = op
+        self.stuck_rank = int(stuck_rank)
+        self.timeout_s = float(timeout_s)
+        self.retries = int(retries)
+        self.seq = int(seq)
+        self.site = site
+        where = f" at {site}" if site else ""
+        super().__init__(
+            f"collective {op} (seq {seq}){where} timed out {retries}x "
+            f"({timeout_s:.3f}s each) waiting on live rank {stuck_rank} "
+            "— peer is slow, not dead (SLU_TPU_COMM_RETRIES exhausted)")
+        _flight_dump(self)
+
+
+class RankFailureError(SuperLUError):
+    """The failure detector declared peer rank(s) DEAD: a bounded-wait
+    collective leg timed out (``SLU_TPU_COMM_TIMEOUT_S``), the detector
+    found the stuck peer's pid gone (``kill(pid, 0)`` → ESRCH — liveness
+    is polled on the process itself, so death is detected even when the
+    heartbeat thread died with it), and the survivors converged on the
+    same dead set through the ``.ftx`` agreement board (a wait-free
+    bulletin domain that excludes the dead rank by construction — no
+    survivor ever blocks on it).  Every surviving rank raises this error
+    naming the dead rank(s), the op it was inside, the collective
+    sequence number and the call site — the ULFM revoke→agree shape: a
+    dead rank is a structured, recoverable event, not a fleet-killing
+    hang (``Options.ft`` = "shrink"/"respawn" in parallel/recover.py
+    resumes the solve on the survivors from the last checkpoint
+    frontier)."""
+
+    def __init__(self, dead_ranks, op: str = "", seq: int = -1,
+                 site: str = "", rank: int = -1, n_ranks: int = 0,
+                 epoch: int = 0):
+        self.dead_ranks = sorted(int(r) for r in dead_ranks)
+        self.op = op
+        self.seq = int(seq)
+        self.site = site
+        self.rank = int(rank)
+        self.n_ranks = int(n_ranks)
+        self.epoch = int(epoch)
+        where = f" at {site}" if site else ""
+        inside = f" during {op} (seq {seq})" if op else ""
+        super().__init__(
+            f"rank(s) {','.join(map(str, self.dead_ranks))} of "
+            f"{n_ranks} declared dead{inside}{where} (epoch {epoch}, "
+            f"observed from rank {rank}); survivors agreed via the .ftx "
+            "board — recover with Options.ft='shrink'/'respawn' or treat "
+            "as fatal (ft='abort')")
+        _flight_dump(self)
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

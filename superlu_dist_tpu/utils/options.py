@@ -107,6 +107,29 @@ def _register_all() -> None:
       "(call-site, op, shape/dtype, seq) digest across ranks and raise "
       "CollectiveMismatchError instead of deadlocking (runtime SLU106)",
       group="parallel")
+    # --- rank-failure tolerance (parallel/recover.py, docs/RELIABILITY.md) --
+    r("SLU_TPU_COMM_TIMEOUT_S", "float", 0.0,
+      "bounded-wait collectives: every native tree leg's spin loop gets "
+      "this deadline (exponential backoff + jitter); on expiry the "
+      "failure detector is consulted — dead peer => RankFailureError on "
+      "every survivor, live peer => retry.  0 = unbounded (legacy)",
+      group="parallel")
+    r("SLU_TPU_COMM_RETRIES", "int", 0,
+      "timed-out-but-peer-alive retry budget per collective leg; "
+      "exhausting it raises CommTimeoutError.  0 = unlimited (a slow "
+      "peer is waited out; only DEATH fails the collective)",
+      group="parallel")
+    r("SLU_TPU_HEARTBEAT_S", "float", 0.5,
+      "failure-detector heartbeat interval (epoch bumps in the shared "
+      "segment + heartbeat-age gauge); the thread only starts when "
+      "SLU_TPU_COMM_TIMEOUT_S > 0.  0 disables the thread (pid "
+      "liveness still detects death)", group="parallel")
+    r("SLU_TPU_FT", "str", "abort",
+      "rank-failure policy for fault-tolerant drivers "
+      "(parallel/recover.pgssvx_ft): abort = raise RankFailureError; "
+      "shrink = survivors re-partition and resume from the checkpoint "
+      "frontier; respawn = replacement processes take the dead ranks",
+      group="parallel", choices=("abort", "shrink", "respawn"))
     # --- index width -------------------------------------------------------
     r("SLU_TPU_INT64", "flag", False,
       "64-bit pattern indices (reference XSDK_INDEX_SIZE=64 analog)")
@@ -534,6 +557,14 @@ class Options:
     # checkpoint bundle directory ("" = .slu_ckpt in the working dir)
     ckpt_dir: str = dataclasses.field(
         default_factory=lambda: env_str("SLU_TPU_CKPT_DIR"))
+    # --- rank-failure tolerance (parallel/recover.py) ----------------------
+    # what a declared-dead peer rank does to a fault-tolerant driver
+    # (pgssvx_ft): "abort" re-raises RankFailureError, "shrink" resumes
+    # on the survivors, "respawn" replaces the dead rank with a fresh
+    # process.  Only consulted by the FT epoch loop — plain pgssvx
+    # always surfaces the structured error to its caller.
+    ft: str = dataclasses.field(
+        default_factory=lambda: env_str("SLU_TPU_FT"))
 
 
 def set_default_options() -> Options:

@@ -1,0 +1,485 @@
+"""Rank-failure tolerance tests (ISSUE 8 — docs/RELIABILITY.md).
+
+The contract under test: with ``SLU_TPU_COMM_TIMEOUT_S`` armed, a rank
+that DIES surfaces as a structured :class:`RankFailureError` on EVERY
+survivor — naming the dead rank(s), the op, the sequence number and the
+call site — within ~2x the timeout (no hang, no watchdog ``os._exit``);
+a rank that is merely SLOW (stalled below/above the timeout, pid alive)
+is never declared failed; and ``Options.ft`` = "shrink"/"respawn"
+(parallel/recover.py) completes the solve on the survivors, resuming
+the checkpoint frontier with bitwise-identical factors.
+"""
+
+import hashlib
+import multiprocessing as mp
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu import native
+
+pytestmark = [pytest.mark.ft,
+              pytest.mark.skipif(not native.available(),
+                                 reason="native library unavailable")]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TIMEOUT_S = 0.4
+
+
+# ---------------------------------------------------------------------------
+# spec / error-surface units
+# ---------------------------------------------------------------------------
+
+def test_parse_chaos_ft_specs():
+    from superlu_dist_tpu.testing.chaos import parse_chaos_spec
+    p = parse_chaos_spec("kill_rank=1@group=3,signal=term")
+    assert (p.kill_rank, p.kill_group, p.signal) == (1, 3, "term")
+    p = parse_chaos_spec("kill_rank=2,kill_op=4")
+    assert (p.kill_rank, p.kill_op) == (2, 4) and p.comm_armed and p.armed
+    p = parse_chaos_spec("stall_rank=1,secs=0.5")
+    assert (p.stall_rank, p.secs) == (1, 0.5) and p.comm_armed
+    assert not parse_chaos_spec("nan_supernode=3").comm_armed
+    with pytest.raises(ValueError, match="unknown"):
+        parse_chaos_spec("kill_rankk=1")
+
+
+def test_rank_failure_error_carries_structure():
+    from superlu_dist_tpu.utils.errors import (CommTimeoutError,
+                                               RankFailureError,
+                                               SuperLUError)
+    e = RankFailureError({2, 0}, op="bcast_any", seq=7,
+                         site="parallel/pgssvx.py:277", rank=1, n_ranks=3,
+                         epoch=0)
+    assert e.dead_ranks == [0, 2]
+    for frag in ("0,2", "bcast_any", "seq 7", "pgssvx.py:277", "shrink"):
+        assert frag in str(e), (frag, str(e))
+    assert isinstance(e, SuperLUError)
+    # the flight-recorder postmortem hook ran at construction (None =
+    # recorder off, but the attribute is always stamped)
+    assert hasattr(e, "flightrec_dump")
+    t = CommTimeoutError("reduce_sum", 1, 0.5, 3, seq=4, site="x.py:1")
+    assert t.stuck_rank == 1 and "slow, not dead" in str(t)
+    assert hasattr(t, "flightrec_dump")
+
+
+def test_rank_failure_dumps_flightrec(tmp_path, monkeypatch):
+    """Satellite: RankFailureError construction dumps the flight ring
+    (the evidence survives even when the raise dies in a worker)."""
+    import json
+    from superlu_dist_tpu.obs import flightrec
+    from superlu_dist_tpu.utils.errors import RankFailureError
+    dump = tmp_path / "flight.json"
+    fr = flightrec.FlightRecorder(dump_path=str(dump))
+    flightrec.install(fr)
+    try:
+        fr.event("pre-failure", cat="comm")
+        e = RankFailureError([1], op="bcast", seq=3, site="x.py:2",
+                             rank=0, n_ranks=2)
+        assert e.flightrec_dump == str(dump)
+        doc = json.loads(dump.read_text())
+        assert "RankFailureError" in doc["reason"]
+    finally:
+        flightrec._reset()
+
+
+def test_options_ft_validated_by_driver():
+    import superlu_dist_tpu as slu
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.utils.errors import SuperLUError
+    a = poisson2d(4)
+    b = np.ones(a.n_rows)
+    with pytest.raises(SuperLUError, match="Options.ft"):
+        slu.gssvx(slu.Options(ft="shirnk"), a, b)
+
+
+def test_native_timed_leg_bounds_the_wait():
+    """The native timed reduce returns 1+stuck_rank within ~timeout when
+    the peer never arrives, leaves the payload untouched, and the
+    untimed entry is unaffected (timeout 0 = legacy)."""
+    import ctypes
+    lib = native._load()
+    name = f"/slu_ft_unit_{os.getpid()}".encode()
+    h = lib.slu_tree_attach(name, 2, 16, 0, 1)
+    try:
+        buf = np.arange(4.0)
+        ptr = buf.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+        t0 = time.monotonic()
+        rc = lib.slu_tree_reduce_sum_tw(h, 0, ptr, 4, TIMEOUT_S)
+        dt = time.monotonic() - t0
+        assert rc == 2                        # 1 + stuck rank 1
+        assert TIMEOUT_S * 0.8 < dt < TIMEOUT_S * 3
+        np.testing.assert_array_equal(buf, np.arange(4.0))
+        rc = lib.slu_tree_bcast_tw(h, 0, ptr, 4, TIMEOUT_S)
+        assert rc == 0                        # root bcast: no waits at op 1
+    finally:
+        lib.slu_tree_detach(h, name, 1)
+
+
+# ---------------------------------------------------------------------------
+# TreeComm-level failure detection (fork workers: numpy only, no jax)
+# ---------------------------------------------------------------------------
+
+def _dying_worker(name, n_ranks, rank, die_before_op):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    tc = TreeComm(name, n_ranks, rank, max_len=64, create=False)
+    x = np.ones(4)
+    for _ in range(die_before_op - 1):
+        tc.allreduce_sum_any(x)
+    os._exit(17)
+
+
+def _surviving_worker(name, n_ranks, rank, n_ops, q, done):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import RankFailureError
+    tc = TreeComm(name, n_ranks, rank, max_len=64, create=False)
+    x = np.ones(4)
+    t0 = time.monotonic()
+    try:
+        for _ in range(n_ops):
+            tc.allreduce_sum_any(x)
+        q.put((rank, "no-error", None, None, None, 0.0))
+    except RankFailureError as e:
+        q.put((rank, "rank-failure", e.dead_ranks, e.op, e.site,
+               time.monotonic() - t0))
+    # stay alive until the peer finished its own agreement (a real
+    # survivor proceeds to recovery; exiting early would legitimately
+    # land this rank in the peer's dead-set)
+    done.wait(timeout=30)
+
+
+def test_three_rank_death_raises_on_every_survivor(monkeypatch):
+    """Rank 2 dies before op 2; BOTH survivors (the main process and a
+    fork worker) raise RankFailureError naming rank 2 + op + site,
+    within the 2x-timeout budget."""
+    monkeypatch.setenv("SLU_TPU_COMM_TIMEOUT_S", str(TIMEOUT_S))
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import RankFailureError
+
+    name = f"/slu_ft3_{os.getpid()}"
+    tc = TreeComm(name, 3, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    done = ctx.Event()
+    dier = ctx.Process(target=_dying_worker, args=(name, 3, 2, 2))
+    surv = ctx.Process(target=_surviving_worker,
+                       args=(name, 3, 1, 2, q, done))
+    dier.start()
+    surv.start()
+    x = np.ones(4)
+    try:
+        assert (tc.allreduce_sum_any(x) == 3).all()
+        t0 = time.monotonic()
+        with pytest.raises(RankFailureError) as ei:
+            tc.allreduce_sum_any(x)
+        dt = time.monotonic() - t0
+        done.set()
+        assert ei.value.dead_ranks == [2]
+        assert ei.value.op and ei.value.site
+        assert dt < 2 * TIMEOUT_S + 1.0, dt
+        peer = q.get(timeout=30)
+        assert peer[1] == "rank-failure", peer
+        assert peer[2] == [2] and peer[3] and peer[4]
+        dier.join(timeout=30)
+        surv.join(timeout=30)
+        assert dier.exitcode == 17 and surv.exitcode == 0
+    finally:
+        done.set()
+        tc.close(unlink=True)
+
+
+def _stalling_worker(name, n_ranks, rank, q):
+    # SLU_TPU_CHAOS='stall_rank=...' is inherited: the comm-chaos hook
+    # sleeps before the matching public collective
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    tc = TreeComm(name, n_ranks, rank, max_len=64, create=False)
+    # fresh payloads per op: contiguous f64 collectives run in place
+    out1 = tc.allreduce_sum_any(np.ones(4))
+    out2 = tc.allreduce_sum_any(np.ones(4))
+    q.put((rank, float(out1[0]), float(out2[0])))
+    tc.close()
+
+
+def test_stall_is_never_declared_failure(monkeypatch):
+    """A peer stalled for ~4x the timeout (pid alive) must NOT be
+    declared failed: the survivor retries through several timeouts and
+    the collective completes with the right value, zero false
+    positives."""
+    stall = 4 * TIMEOUT_S
+    monkeypatch.setenv("SLU_TPU_COMM_TIMEOUT_S", str(TIMEOUT_S))
+    monkeypatch.setenv("SLU_TPU_CHAOS", f"stall_rank=1,secs={stall},"
+                                        "stall_op=2")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    name = f"/slu_ftstall_{os.getpid()}"
+    tc = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    q = ctx.Queue()
+    p = ctx.Process(target=_stalling_worker, args=(name, 2, 1, q))
+    p.start()
+    try:
+        assert (tc.allreduce_sum_any(np.ones(4)) == 2).all()
+        t0 = time.monotonic()
+        # peer sleeps `stall` before entering this op
+        out = tc.allreduce_sum_any(np.ones(4))
+        dt = time.monotonic() - t0
+        assert (out == 2).all(), out
+        assert dt >= stall * 0.8, dt          # the stall really happened
+        r, o1, o2 = q.get(timeout=30)
+        assert (o1, o2) == (2.0, 2.0)
+        p.join(timeout=30)
+        assert p.exitcode == 0
+    finally:
+        tc.close(unlink=True)
+
+
+def _sleeping_worker(name, n_ranks, rank, secs):
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    tc = TreeComm(name, n_ranks, rank, max_len=64, create=False)
+    time.sleep(secs)        # never enters the collective
+    tc.close()
+    os._exit(0)
+
+
+def test_bounded_retries_raise_comm_timeout_on_live_peer(monkeypatch):
+    """With SLU_TPU_COMM_RETRIES bounded, a live-but-absent peer yields
+    CommTimeoutError (the slow-not-dead verdict), never
+    RankFailureError."""
+    monkeypatch.setenv("SLU_TPU_COMM_TIMEOUT_S", str(TIMEOUT_S))
+    monkeypatch.setenv("SLU_TPU_COMM_RETRIES", "2")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+    from superlu_dist_tpu.utils.errors import CommTimeoutError
+
+    name = f"/slu_ftto_{os.getpid()}"
+    tc = TreeComm(name, 2, 0, max_len=64, create=True)
+    ctx = mp.get_context("fork")
+    p = ctx.Process(target=_sleeping_worker, args=(name, 2, 1, 60.0))
+    p.start()
+    try:
+        time.sleep(0.2)     # let the peer attach (register its pid)
+        with pytest.raises(CommTimeoutError) as ei:
+            tc.allreduce_sum_any(np.ones(4))
+        assert ei.value.stuck_rank == 1
+        assert ei.value.retries == 2
+    finally:
+        p.terminate()
+        p.join(timeout=30)
+        tc.close(unlink=True)
+
+
+def test_heartbeat_and_board_roundtrip(monkeypatch):
+    """Detector unit surface: heartbeat epochs advance (age gauge
+    resets on movement), and a posted dead-set round-trips through the
+    .ftx board to a peer attachment."""
+    monkeypatch.setenv("SLU_TPU_COMM_TIMEOUT_S", str(TIMEOUT_S))
+    monkeypatch.setenv("SLU_TPU_HEARTBEAT_S", "0.05")
+    from superlu_dist_tpu.parallel.treecomm import TreeComm
+
+    name = f"/slu_fthb_{os.getpid()}"
+    a = TreeComm(name, 2, 0, max_len=64, create=True)
+    b = TreeComm(name, 2, 1, max_len=64, create=False)
+    try:
+        lib = native._load()
+        hb0 = lib.slu_tree_get_heartbeat(a._h, 0)
+        time.sleep(0.3)
+        assert lib.slu_tree_get_heartbeat(a._h, 0) > hb0
+        # b observes a's heartbeat moving: age snaps back to 0
+        assert b._detector.heartbeat_age(0) == 0.0
+        # pid liveness: both registered, both alive
+        assert b._detector.pid(0) == os.getpid()
+        assert b._detector.dead_ranks() == set()
+        # board: a posts a failure declaration, b reads it back
+        a._detector.post_failure({1}, epoch=0)
+        posted = b._detector.posted_failures(epoch=0)
+        assert posted == {0: {1}}
+        assert b._detector.posted_failures(epoch=3) == {}
+    finally:
+        b.close()
+        a.close(unlink=True)
+
+
+# ---------------------------------------------------------------------------
+# full-driver scenarios (subprocess ranks — fresh processes, jax-laden)
+# ---------------------------------------------------------------------------
+
+_RANK_SCRIPT = r"""
+import os, sys, hashlib
+import numpy as np
+sys.path.insert(0, {repo!r})
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    rank, n_ranks, name = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.parallel.recover import (
+        pgssvx_ft, RowBlockSource, VectorBlockSource, FT_EVENTS)
+    from superlu_dist_tpu.utils.errors import RankFailureError
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.testing.chaos import HangWatchdog
+
+    a = poisson3d(6)
+    xt = np.random.default_rng(0).standard_normal(a.n_rows)
+    b = a.matvec(xt)
+    opts = Options(factor_dtype="float64", ckpt_every=2,
+                   ckpt_dir=os.environ.get("FT_CKDIR", ""))
+    lu_out = {{}}
+    # the watchdog must never fire: the detector raises first (its
+    # exit-3 would fail the rc==0 assertion in the parent)
+    with HangWatchdog(120.0):
+        try:
+            x, info = pgssvx_ft(name, n_ranks, rank, opts,
+                                RowBlockSource(a), VectorBlockSource(b),
+                                max_len=a.n_rows, lu_out=lu_out)
+        except RankFailureError as e:
+            print("OUTCOME", rank, "rank-failure",
+                  ",".join(map(str, e.dead_ranks)), e.op, e.site,
+                  flush=True)
+            return
+    err = float(np.abs(x - xt).max())
+    h = hashlib.sha256()
+    lu = lu_out.get("lu")
+    if lu is not None and getattr(lu, "numeric", None) is not None:
+        for lp, up in lu.numeric.fronts:
+            h.update(np.ascontiguousarray(np.asarray(lp)).tobytes())
+            h.update(np.ascontiguousarray(np.asarray(up)).tobytes())
+    rungs = []
+    rep = lu_out.get("solve_report")
+    if rep is not None:
+        rungs = [r.name for r in rep.rungs]
+    print("OUTCOME", rank, "solved", info, len(FT_EVENTS), err,
+          h.hexdigest(), lu_out.get("recovered"), ";".join(rungs),
+          flush=True)
+
+if __name__ == "__main__":
+    main()
+"""
+
+
+def _spawn_rank(tmp_path, name, rank, n_ranks, extra_env):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               SLU_TPU_COMM_TIMEOUT_S="1.0",
+               FT_CKDIR=str(tmp_path / "ck"))
+    env.pop("SLU_TPU_CHAOS", None)
+    env.update(extra_env)
+    script = tmp_path / f"rank{rank}.py"
+    script.write_text(_RANK_SCRIPT.format(repo=REPO))
+    return subprocess.Popen(
+        [sys.executable, str(script), str(rank), str(n_ranks), name],
+        env=env, cwd=REPO, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE, text=True)
+
+
+def _outcomes(procs, timeout=300):
+    out = {}
+    for rank, p in procs.items():
+        o, e = p.communicate(timeout=timeout)
+        lines = [ln for ln in o.splitlines() if ln.startswith("OUTCOME")]
+        out[rank] = (p.returncode, lines[-1].split() if lines else None, e)
+    return out
+
+
+@pytest.mark.parametrize("n_ranks", [2, 3])
+def test_kill_mid_factor_all_survivors_raise(tmp_path, n_ranks):
+    """The acceptance shape with ft=abort: the highest rank is killed
+    mid-solve (before its 4th public collective, while root factors);
+    EVERY survivor raises RankFailureError naming rank+op+site, inside
+    the 2x-timeout window (wall-clocked from the kill), and no
+    HangWatchdog exit-3 fires."""
+    victim = n_ranks - 1
+    name = f"/slu_ftk{n_ranks}_{os.getpid()}"
+    procs = {0: _spawn_rank(tmp_path, name, 0, n_ranks,
+                            {"SLU_TPU_FT": "abort"})}
+    time.sleep(0.3)
+    for r in range(1, n_ranks):
+        env = {"SLU_TPU_FT": "abort"}
+        if r == victim:
+            env["SLU_TPU_CHAOS"] = f"kill_rank={victim},kill_op=4"
+        procs[r] = _spawn_rank(tmp_path, name, r, n_ranks, env)
+    res = _outcomes(procs)
+    rc, line, err = res[victim]
+    assert rc == -signal.SIGKILL, (rc, err)
+    for r in range(n_ranks):
+        if r == victim:
+            continue
+        rc, line, err = res[r]
+        assert rc == 0, (r, rc, err)
+        assert line is not None and line[2] == "rank-failure", (r, line)
+        assert line[3] == str(victim)         # dead set names the victim
+        assert line[4] and line[5]            # op + call site populated
+
+
+def test_shrink_recovery_resumes_bitwise(tmp_path):
+    """ft=shrink flagship: rank 0 (the factoring root) is SIGKILLed
+    after dispatch group 3 with interval checkpoints armed; the
+    survivor shrinks to a solo epoch, RESUMES the durable frontier, and
+    produces bitwise-identical L/U to an undisturbed run (digest
+    compare), with the ft-shrink rung recorded."""
+    # reference: undisturbed solo run, same options/ckpt arming
+    name_ref = f"/slu_ftref_{os.getpid()}"
+    ref = _spawn_rank(tmp_path, name_ref, 0, 1, {"SLU_TPU_FT": "shrink"})
+    res = _outcomes({0: ref})
+    rc, line, err = res[0]
+    assert rc == 0 and line[2] == "solved", (rc, line, err)
+    ref_digest = line[6]
+
+    name = f"/slu_ftshrink_{os.getpid()}"
+    procs = {0: _spawn_rank(
+        tmp_path, name, 0, 2,
+        {"SLU_TPU_FT": "shrink",
+         "SLU_TPU_CHAOS": "kill_rank=0@group=3"})}
+    time.sleep(0.3)
+    procs[1] = _spawn_rank(tmp_path, name, 1, 2, {"SLU_TPU_FT": "shrink"})
+    res = _outcomes(procs)
+    assert res[0][0] == -signal.SIGKILL, res[0]
+    rc, line, err = res[1]
+    assert rc == 0, (rc, err)
+    assert line[2] == "solved" and int(line[3]) == 0, line
+    assert int(line[4]) == 1                  # one FT event
+    assert float(line[5]) < 1e-8              # solution correct
+    assert line[6] == ref_digest              # BITWISE identical L/U
+    assert line[7] == "True"                  # lu_out["recovered"]
+    assert "ft-shrink" in line[8].split(";")  # SolveReport rung
+
+
+def test_respawn_recovery_completes(tmp_path):
+    """ft=respawn: rank 1 dies mid-gather; rank 0 spawns a replacement
+    that takes over rank 1's id in epoch 1 and the 2-rank solve
+    completes with one recorded recovery."""
+    name = f"/slu_ftresp_{os.getpid()}"
+    procs = {0: _spawn_rank(tmp_path, name, 0, 2,
+                            {"SLU_TPU_FT": "respawn"})}
+    time.sleep(0.3)
+    procs[1] = _spawn_rank(
+        tmp_path, name, 1, 2,
+        {"SLU_TPU_FT": "respawn", "SLU_TPU_CHAOS": "kill_rank=1,kill_op=4"})
+    res = _outcomes(procs)
+    assert res[1][0] == -signal.SIGKILL, res[1]
+    rc, line, err = res[0]
+    assert rc == 0, (rc, err)
+    assert line[2] == "solved" and int(line[3]) == 0, line
+    assert int(line[4]) == 1 and float(line[5]) < 1e-8
+    assert "ft-respawn" in line[8].split(";")
+
+
+def test_shrink_recovery_clean_under_verify_collectives(tmp_path):
+    """The whole failure->agree->shrink->resume path runs clean with the
+    SLU106 lockstep verifier ON (the digest exchange itself rides the
+    bounded-wait legs; the recovery epoch gets its own .vfy domain)."""
+    name = f"/slu_ftvfy_{os.getpid()}"
+    base = {"SLU_TPU_FT": "shrink", "SLU_TPU_VERIFY_COLLECTIVES": "1"}
+    procs = {0: _spawn_rank(
+        tmp_path, name, 0, 2,
+        dict(base, SLU_TPU_CHAOS="kill_rank=0@group=3"))}
+    time.sleep(0.3)
+    procs[1] = _spawn_rank(tmp_path, name, 1, 2, base)
+    res = _outcomes(procs)
+    assert res[0][0] == -signal.SIGKILL, res[0]
+    rc, line, err = res[1]
+    assert rc == 0, (rc, err)
+    assert line[2] == "solved" and float(line[5]) < 1e-8, (line, err)

@@ -29,17 +29,21 @@ needs_native = pytest.mark.skipif(not native.available(),
 @needs_native
 def test_verify_off_allocates_no_verifier_state(monkeypatch):
     monkeypatch.delenv("SLU_TPU_VERIFY_COLLECTIVES", raising=False)
+    monkeypatch.delenv("SLU_TPU_COMM_TIMEOUT_S", raising=False)
+    monkeypatch.delenv("SLU_TPU_CHAOS", raising=False)
     from superlu_dist_tpu.parallel import treecomm
     name = f"/slu_vc_off_{os.getpid()}"
     with treecomm.TreeComm(name, 1, 0, max_len=16, create=True) as tc:
+        # every optional layer stays unallocated on the default path:
+        # no verifier, no failure detector (bounded waits off), no
+        # chaos monkey — the public entry pays depth bookkeeping only
         assert tc._verifier is None
-        # the guard is the reused no-op singleton — nothing allocated
-        assert tc._verified("bcast", (4,), "float64", 0) \
-            is treecomm._NULL_CTX
+        assert tc._detector is None
+        assert tc._chaos is None
         b = np.arange(4.0)
         tc.bcast(b)
         tc.allreduce_sum(b)
-        assert tc._verifier is None
+        assert tc._verifier is None and tc._detector is None
 
 
 @needs_native
