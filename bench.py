@@ -297,7 +297,13 @@ def main():
               "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
               "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV",
               "SLU_TPU_SCHEDULE", "SLU_TPU_SCHED_WINDOW",
-              "SLU_TPU_SCHED_ALIGN")
+              "SLU_TPU_SCHED_ALIGN",
+              # solve-kernel-set knobs (solve/plan.py): a set one means
+              # a deliberate solve sweep with its own deadline discipline
+              "BENCH_SOLVE_NRHS", "SLU_TPU_SOLVE_SCHEDULE",
+              "SLU_TPU_SOLVE_WINDOW", "SLU_TPU_SOLVE_ALIGN",
+              "SLU_TPU_SOLVE_TRSM_LEAF", "SLU_TPU_SOLVE_NRHS_MAX",
+              "SLU_TPU_SOLVE_NRHS_GROWTH")
     # BENCH_NX=48 is exactly the default size, so an explicit "48" (the
     # hardware session's nx48_default config) still counts as the default
     # kernel set — its successful run must warm the default marker
@@ -622,6 +628,66 @@ def main():
         _log(f"solve phase failed: {e}")
 
     tracer.complete("solve-residual", "phase", t_phase,
+                    time.perf_counter() - t_phase)
+
+    # Serving hot path (ROADMAP item 1): the DEVICE batched solve at a
+    # many-RHS sweep — solve_gflops becomes {"1": ..., "64": ...,
+    # "1024": ...} (structural flops, honest numerator) plus the
+    # solve-plan schedule stats and the nrhs-inclusive padding factor
+    # (solve/plan.py).  Each size degrades independently under the
+    # remaining watchdog budget; a failure leaves the scalar host
+    # numbers from the phase above in place.
+    _set_phase("solve-bench")
+    t_phase = time.perf_counter()
+    try:
+        _sizes = [int(s) for s in os.environ.get(
+            "BENCH_SOLVE_NRHS", "1,64,1024").split(",") if s.strip()]
+        if numeric.on_host:
+            # offloaded factors would re-upload per solve — the device
+            # solve bench would measure the PCIe link, not the sweeps
+            RESULT["solve_bench"] = "skipped: factors host-resident"
+        elif _sizes:
+            from superlu_dist_tpu.solve.plan import build_solve_plan
+            lu.solve_path = "device"
+            lu.dev_solver = None
+            sp = build_solve_plan(plan)
+            RESULT["solve_plan"] = sp.schedule_stats(nrhs=max(_sizes))
+            gfl = {}
+            secs = {}
+            rng = np.random.default_rng(1)
+            sflops = 2.0 * (sf.nnz_L + sf.nnz_U)
+            for k in _sizes:
+                if DEADLINE - (time.perf_counter() - T0) < 180:
+                    _log(f"solve-bench: budget low, skipping nrhs={k}+")
+                    break
+                d = rng.standard_normal((n, k))
+                d = d[:, 0] if k == 1 else d
+                lu.solve_factored(d)          # warm (compile) call
+                t0 = time.perf_counter()
+                lu.solve_factored(d)
+                dt = time.perf_counter() - t0
+                secs[str(k)] = round(dt, 5)
+                gfl[str(k)] = round(sflops * k / max(dt, 1e-12) / 1e9, 3)
+                _log(f"solve nrhs={k}: {dt:.4f}s -> "
+                     f"{gfl[str(k)]} GFLOP/s (device)")
+                # progressive, like the factor reps: a watchdog fire
+                # mid-sweep still carries the sizes measured so far
+                RESULT["solve_gflops"] = dict(gfl)
+                RESULT["solve_seconds_nrhs"] = dict(secs)
+                RESULT["solve_path"] = "device"
+                if lu.dev_solver is not None \
+                        and lu.dev_solver.last_solve_stats:
+                    RESULT["solve_padding_factor"] = \
+                        lu.dev_solver.last_solve_stats["padding_factor"]
+            if lu.solve_path != "device":
+                # the auto-fallback fired mid-bench: record why
+                RESULT["solve_path"] = "host-fallback"
+                RESULT["solve_fallback"] = lu.solve_fallback_reason
+    except Exception as e:                       # pragma: no cover
+        RESULT["solve_bench"] = f"failed: {type(e).__name__}: {e}"
+        _log(f"solve-bench phase failed: {e}")
+
+    tracer.complete("solve-bench", "phase", t_phase,
                     time.perf_counter() - t_phase)
 
     # Baseline: serial SuperLU (same code family as the reference) with

@@ -1,0 +1,122 @@
+#!/usr/bin/env python
+"""Solve-equivalence gate: the device batched solve must agree with the
+host supernodal solve, and the solve-plan machinery must never change
+the answer.
+
+Three tiers on the (downsized) bench matrix family:
+
+1. **fused vs streamed, BITWISE** — one jitted program per sweep vs one
+   kernel per sweep batch runs the identical arithmetic, so
+   np.array_equal (no tolerance) must hold, per solve schedule.
+2. **schedules agree** — dataflow / level / factor sweep schedules (and
+   a promoted-key alignment pass) solve through the SAME factors; batch
+   membership may reorder the lsum scatter-adds, so these compare at a
+   tight f64 tolerance (≤ 64·eps·cond-ish; 1e-11 componentwise here),
+   not bitwise — the solve twin of check_schedule_equiv.py's contract,
+   with the reordering caveat documented in docs/SERVING.md.
+3. **device vs host** — the serving path against the scipy-grade host
+   loop at nrhs ∈ {1, 5, 130} (130 crosses a geometric nrhs bucket and
+   exercises padding columns), including the transpose sweep.  The
+   nrhs-padding telemetry must also report honestly: executed >=
+   structural, and padded_nrhs equal to the chunked bucket total.
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
+entry point); a few seconds on CPU.  Gate contract (shared with the
+other gates): any regression — a bitwise mismatch between fused and
+streamed, a cross-schedule drift past tolerance, a device-vs-host
+disagreement, a padding under-report — raises/asserts, which exits
+non-zero with the diagnostic on stderr.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _factored(a):
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.utils.options import IterRefine, Options
+
+    opts = Options(iter_refine=IterRefine.NOREFINE)
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert info == 0, f"factorization failed: info={info}"
+    return lu
+
+
+def check(name, a):
+    from superlu_dist_tpu.solve.device import DeviceSolver
+    from superlu_dist_tpu.solve.plan import build_solve_plan, chunk_nrhs
+    from superlu_dist_tpu.solve.trisolve import lu_solve, lu_solve_trans
+
+    lu = _factored(a)
+    n = a.n_rows
+    rng = np.random.default_rng(7)
+    for nrhs in (1, 5, 130):
+        d = rng.standard_normal((n, nrhs))
+        d = d[:, 0] if nrhs == 1 else d
+        want = lu_solve(lu.numeric, d)
+        ref = None
+        for sched in ("dataflow", "level", "factor"):
+            s_str = DeviceSolver(lu.numeric, fused=False, schedule=sched)
+            s_fus = DeviceSolver(lu.numeric, fused=True, schedule=sched)
+            x_str = s_str.solve(d)
+            x_fus = s_fus.solve(d)
+            # tier 1: identical arithmetic => identical bits
+            assert np.array_equal(x_str, x_fus), (
+                f"{name}: fused vs streamed differ BITWISE "
+                f"(schedule={sched}, nrhs={nrhs})")
+            # tier 2: schedules agree to f64 tightness
+            if ref is None:
+                ref = x_str
+            else:
+                np.testing.assert_allclose(
+                    x_str, ref, rtol=1e-11, atol=1e-13,
+                    err_msg=f"{name}: schedule {sched} drifted past "
+                            f"tolerance at nrhs={nrhs}")
+            # tier 3: device vs host
+            np.testing.assert_allclose(
+                x_str, want, rtol=1e-9, atol=1e-11,
+                err_msg=f"{name}: device ({sched}) vs host solve "
+                        f"disagree at nrhs={nrhs}")
+            # padding honesty: executed covers structural, padded nrhs
+            # is exactly the chunked bucket total
+            st = s_str.last_solve_stats
+            assert st["executed_flops"] >= st["solve_flops"] > 0, st
+            kb = sum(b for _, _, b in chunk_nrhs(
+                nrhs, s_str.splan.nrhs_bucket_set))
+            assert st["padded_nrhs"] == kb and st["nrhs"] == nrhs, st
+        # transpose sweep through the dataflow schedule
+        want_t = lu_solve_trans(lu.numeric, d)
+        got_t = DeviceSolver(lu.numeric, schedule="dataflow").solve_trans(d)
+        np.testing.assert_allclose(
+            got_t, want_t, rtol=1e-9, atol=1e-11,
+            err_msg=f"{name}: transpose device vs host at nrhs={nrhs}")
+    sp = build_solve_plan(lu.plan, schedule="dataflow", window=0)
+    assert len(sp.groups) <= sp.n_factor_groups, (
+        f"{name}: dataflow solve plan produced MORE groups "
+        f"({len(sp.groups)} > {sp.n_factor_groups})")
+    print(f"[solve-equiv] {name}: OK (factor groups "
+          f"{sp.n_factor_groups} -> solve groups {len(sp.groups)}, "
+          f"occupancy {sp.mean_occupancy:.2f})")
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    from superlu_dist_tpu.models.gallery import poisson2d, random_sparse
+
+    check("poisson2d-12", poisson2d(12))
+    check("random-120", random_sparse(120, density=0.05, seed=3))
+    print("[solve-equiv] all checks passed")
+
+
+if __name__ == "__main__":
+    main()

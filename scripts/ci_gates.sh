@@ -29,6 +29,10 @@
 #                   within 2x SLU_TPU_COMM_TIMEOUT_S (no watchdog
 #                   exit-3), and ft=shrink resumes the checkpoint
 #                   frontier with bitwise-identical L/U
+#   solve-equiv     scripts/check_solve_equiv.py      device batched
+#                   solve: fused vs streamed bitwise-identical, sweep
+#                   schedules agree, device vs host solve within f64
+#                   tightness, nrhs padding reported honestly
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -49,12 +53,13 @@ declare -A GATES=(
   [trace-overhead]="python scripts/check_trace_overhead.py"
   [verify-overhead]="python scripts/check_verify_overhead.py"
   [schedule-equiv]="python scripts/check_schedule_equiv.py"
+  [solve-equiv]="python scripts/check_solve_equiv.py"
   [perf-regress]="python scripts/check_perf_regress.py"
   [crash-resume]="python scripts/check_crash_resume.py"
   [rank-failure]="python scripts/check_rank_failure.py"
 )
-ORDER=(slulint verify-overhead schedule-equiv crash-resume rank-failure
-       trace-overhead nan-guards perf-regress)
+ORDER=(slulint verify-overhead schedule-equiv solve-equiv crash-resume
+       rank-failure trace-overhead nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

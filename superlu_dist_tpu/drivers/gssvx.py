@@ -170,7 +170,10 @@ class LUFactorization:
                     self.dev_solver = DeviceSolver(
                         self.numeric, diag_inv=self.options.diag_inv,
                         mesh=self.mesh if multiproc else None,
-                        fused=False if multiproc else "auto")
+                        fused=False if multiproc else "auto",
+                        schedule=self.options.solve_schedule,
+                        window=self.options.solve_window,
+                        align=self.options.solve_align)
                 return device_call(self.dev_solver)
             except Exception as e:
                 if self.solve_path != "auto" or multiproc:

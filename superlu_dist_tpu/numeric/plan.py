@@ -165,7 +165,10 @@ def _bucket_sizes(max_needed: int, min_bucket: int, growth: float):
 
 def _align_shape_keys(sn_W, sn_U, tol: float):
     """Schedule-aware shape-key coalescing (the interleaved-batching
-    enabler, arXiv:1909.04539): greedily merge (W, U) bucket keys —
+    enabler, arXiv:1909.04539).  SHARED MACHINERY: the solve-side
+    scheduler (solve/plan.py) runs this a second time on top of the
+    factor keys — keep the signature/semantics stable for both callers.
+    Greedily merge (W, U) bucket keys —
     promoting the smaller key's members to the merged (max W, max U)
     padding — while the merged members' executed flops stay within
     `tol`x the ORIGINAL constituent flops (the amalgamation budget
@@ -247,7 +250,11 @@ def _dataflow_batches(sf: SymbolicFact, sn_W, sn_U, window: int) -> list:
     """Earliest-ready dataflow schedule (the reference's elimination-tree
     task parallelism + look-ahead, SRC/pdgstrf.c:624-697, recast for
     batched dispatch; arXiv:2406.10511 medium-granularity dataflow,
-    arXiv:1909.04539 interleaved small-problem batching).
+    arXiv:1909.04539 interleaved small-problem batching).  SHARED
+    MACHINERY: solve/plan.py schedules the triangular sweeps through
+    this same function (and _level_batches) — the etree dependency is
+    identical on both sides, so a change here changes BOTH dispatch
+    sequences.
 
     A supernode is READY once every child that extend-adds into its
     front has been dispatched in an earlier batch (the Schur-scatter

@@ -308,6 +308,14 @@ def save_lu(lu, dirpath: str) -> str:
     return write_manifest(dirpath, "lu_handle", meta, entries)
 
 
+def lu_meta(dirpath: str) -> dict:
+    """Manifest meta block of a persisted LU handle — a cheap peek (no
+    array reads, no digest work) so a serving process can size queues
+    and validate n/dtype before paying the full load (serve/server.py's
+    from_bundle path)."""
+    return dict(read_manifest(dirpath, kind="lu_handle")["meta"])
+
+
 def load_lu(dirpath: str):
     """Load a persisted handle: verify every digest, rebuild the
     :class:`LUFactorization` with host-resident factors, and return it

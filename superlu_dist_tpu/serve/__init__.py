@@ -1,0 +1,2 @@
+from superlu_dist_tpu.serve.server import (   # noqa: F401
+    ServerClosedError, SolveServer, SolveTicket)

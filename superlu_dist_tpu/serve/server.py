@@ -1,0 +1,360 @@
+"""Micro-batching solve server — the serving tier over a factored handle.
+
+``SolveServer`` owns one factored :class:`LUFactorization` (taken live
+from a ``gssvx`` result, or loaded zero-refactor from a ``persist/``
+bundle via :meth:`SolveServer.from_bundle` — FACT time stays 0.0) and
+turns "one matrix, one solve" into a request/response loop:
+
+* callers :meth:`submit` right-hand-side columns (original labeling,
+  ``A·x = b``) and get a :class:`SolveTicket` back immediately;
+* a dispatcher thread coalesces pending columns into micro-batches
+  **keyed to the device solver's compiled nrhs buckets** (solve/plan.py)
+  — the oldest pending request is held open for at most
+  ``SLU_TPU_SERVE_MAX_WAIT_MS`` so concurrent traffic lands in one
+  device dispatch instead of many, and a batch dispatches early the
+  moment it can fill ``SLU_TPU_SERVE_MAX_BATCH`` columns (default: the
+  nrhs bucket cap);
+* each batch is ONE solve through the handle (device sweeps on an
+  accelerator backend, the host supernodal solve otherwise — the same
+  auto/fallback discipline as the driver), whose results are scattered
+  back to the submitting tickets.
+
+Requests wider than the batch cap are column-split across consecutive
+batches transparently — a ticket completes when all its columns have.
+
+Observability: every batch runs under a ``serve-batch`` dispatch span
+(the device solve's own ``device-solve`` kernel span and ``solve-d2h``
+comm span nest inside it), and the metrics registry (obs/metrics.py,
+``SLU_TPU_METRICS``) accumulates the serving-grade series —
+``slu_serve_requests_total`` / ``_columns_total`` / ``_batches_total``
+/ ``_errors_total`` counters, the ``slu_serve_queue_depth`` gauge, and
+``slu_serve_request_seconds`` / ``slu_serve_batch_fill`` histograms
+(per-request latency, batch occupancy).  docs/SERVING.md walks the
+whole tier.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+
+import numpy as np
+
+from superlu_dist_tpu.obs.metrics import get_metrics
+from superlu_dist_tpu.obs.trace import get_tracer
+from superlu_dist_tpu.solve.plan import bucket_nrhs
+from superlu_dist_tpu.utils.errors import SuperLUError
+
+
+class ServerClosedError(SuperLUError):
+    """submit() after close() — the request was never enqueued."""
+
+
+class _Request:
+    """One submitted right-hand side, possibly column-split over several
+    micro-batches; completes when every column has been solved."""
+
+    __slots__ = ("b", "k", "squeeze", "remaining", "parts", "error",
+                 "t_submit", "event")
+
+    def __init__(self, b: np.ndarray, squeeze: bool):
+        self.b = b
+        self.k = b.shape[1]
+        self.squeeze = squeeze
+        self.remaining = self.k
+        self.parts = []          # [(col offset, solved columns array)]
+        self.error = None
+        self.t_submit = time.perf_counter()
+        self.event = threading.Event()
+
+
+class SolveTicket:
+    """Handle for one submitted request (future-style)."""
+
+    def __init__(self, req: _Request):
+        self._req = req
+
+    def done(self) -> bool:
+        return self._req.event.is_set()
+
+    def result(self, timeout: float | None = None) -> np.ndarray:
+        """Block until the request's solve completes and return x with
+        the submitted shape ((n,) stays (n,)).  Raises the batch's error
+        if its dispatch failed, TimeoutError on expiry."""
+        if not self._req.event.wait(timeout):
+            raise TimeoutError(
+                f"solve request ({self._req.k} columns) not served "
+                f"within {timeout}s")
+        req = self._req
+        if req.error is not None:
+            raise req.error
+        parts = sorted(req.parts, key=lambda p: p[0])
+        x = (parts[0][1] if len(parts) == 1
+             else np.concatenate([p[1] for p in parts], axis=1))
+        return x[:, 0] if req.squeeze else x
+
+
+class SolveServer:
+    """Micro-batching solve service over one factored handle.
+
+    Parameters
+    ----------
+    lu : LUFactorization
+        A FACTORED handle (``lu.numeric`` present) — from a live
+        ``gssvx`` call or ``persist.load_lu``.
+    max_batch : int
+        Micro-batch column cap; 0/None reads ``SLU_TPU_SERVE_MAX_BATCH``
+        (whose 0 default means: the device solve's nrhs bucket cap).
+    max_wait_s : float
+        Coalescing window; None reads ``SLU_TPU_SERVE_MAX_WAIT_MS``.
+    trans / conj :
+        Serve ``AᵀX = B`` (``AᴴX = B``) through the same factors.
+    start : bool
+        Spawn the dispatcher immediately; ``start=False`` lets tests
+        enqueue a deterministic backlog first, then :meth:`start`.
+    """
+
+    def __init__(self, lu, max_batch: int | None = None,
+                 max_wait_s: float | None = None, trans: bool = False,
+                 conj: bool = False, start: bool = True):
+        from superlu_dist_tpu.utils.options import env_float, env_int
+        if lu is None or lu.numeric is None:
+            raise SuperLUError(
+                "SolveServer requires a FACTORED handle (lu.numeric is "
+                "None — factor first, or load a persisted bundle via "
+                "SolveServer.from_bundle)")
+        self.lu = lu
+        self.n = int(lu.n)
+        self.trans = bool(trans)
+        self.conj = bool(conj)
+        self._solve = (
+            (lambda b: lu.solve_factored_trans(b, conj=self.conj))
+            if self.trans else lu.solve_factored)
+        from superlu_dist_tpu.solve.plan import nrhs_buckets
+        buckets = nrhs_buckets(env_int("SLU_TPU_SOLVE_NRHS_MAX"),
+                               env_float("SLU_TPU_SOLVE_NRHS_GROWTH"))
+        if not max_batch:
+            max_batch = env_int("SLU_TPU_SERVE_MAX_BATCH")
+        if not max_batch:
+            max_batch = buckets[-1]     # the nrhs bucket cap
+        self.max_batch = int(max_batch)
+        # the batch sizes this server targets: the compiled nrhs buckets
+        # up to (and always including) its own cap
+        self._bucket_set = tuple(
+            b for b in buckets if b < self.max_batch) + (self.max_batch,)
+        if max_wait_s is None:
+            max_wait_s = env_float("SLU_TPU_SERVE_MAX_WAIT_MS") / 1000.0
+        self.max_wait_s = float(max_wait_s)
+        self.source = "live"
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        # queue of [request, columns-already-taken] — a wide request
+        # drains across batches without blocking narrower traffic
+        self._queue: collections.deque = collections.deque()
+        self._pending_cols = 0
+        self._closed = False
+        self._flush = False
+        self._thread = None
+        # totals (under _lock); the metrics registry mirrors them when on
+        self._requests = 0
+        self._columns = 0
+        self._batches = 0
+        self._batch_cols = 0
+        self._errors = 0
+        self._metrics = m = get_metrics()
+        self._metrics = m if m.enabled else None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_bundle(cls, dirpath: str, **kw) -> "SolveServer":
+        """Serve from a persisted LU bundle (persist/serial.save_lu):
+        the handle loads digest-verified and solves with ZERO
+        refactorization — the warm-start path a serving fleet restarts
+        through (FACT time stays 0.0; docs/RELIABILITY.md)."""
+        from superlu_dist_tpu.persist.serial import load_lu
+        srv = cls(load_lu(dirpath), **kw)
+        srv.source = str(dirpath)
+        return srv
+
+    # ------------------------------------------------------------------
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._dispatch_loop, name="slu-serve-dispatch",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def submit(self, b: np.ndarray) -> SolveTicket:
+        """Enqueue one right-hand side — (n,) or (n, k), original
+        labeling — and return its ticket immediately."""
+        b = np.asarray(b)
+        squeeze = b.ndim == 1
+        b2 = b[:, None] if squeeze else b
+        if b2.ndim != 2 or b2.shape[0] != self.n or b2.shape[1] == 0:
+            raise SuperLUError(
+                f"rhs shape {b.shape} does not fit an n={self.n} serve "
+                "handle (need (n,) or (n, k>0))")
+        req = _Request(b2, squeeze)
+        with self._cond:
+            if self._closed:
+                raise ServerClosedError("SolveServer is closed")
+            self._queue.append([req, 0])
+            self._pending_cols += req.k
+            self._requests += 1
+            self._columns += req.k
+            depth = self._pending_cols
+            self._cond.notify_all()
+        if self._metrics is not None:
+            self._metrics.inc("slu_serve_requests_total", 1.0)
+            self._metrics.inc("slu_serve_columns_total", float(req.k))
+            self._metrics.set("slu_serve_queue_depth", float(depth))
+        return SolveTicket(req)
+
+    def solve(self, b: np.ndarray,
+              timeout: float | None = None) -> np.ndarray:
+        """submit() + result(): the one-call convenience path."""
+        return self.submit(b).result(timeout)
+
+    def flush(self):
+        """Dispatch whatever is pending without waiting out the
+        coalescing window."""
+        with self._cond:
+            self._flush = True
+            self._cond.notify_all()
+
+    def close(self, timeout: float | None = None):
+        """Stop accepting work, drain the queue, join the dispatcher."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        """Serving counters so far (process-local; the metrics registry
+        carries the scrapeable twin)."""
+        with self._lock:
+            batches = self._batches
+            return {
+                "requests": self._requests,
+                "columns": self._columns,
+                "batches": batches,
+                "errors": self._errors,
+                "queue_depth": self._pending_cols,
+                "mean_batch_columns": (round(self._batch_cols / batches, 2)
+                                       if batches else 0.0),
+                "max_batch": self.max_batch,
+                "max_wait_s": self.max_wait_s,
+                "source": self.source,
+                "closed": self._closed,
+            }
+
+    # ------------------------------------------------------------------
+    def _take_batch(self):
+        """Under the lock: carve up to max_batch columns off the queue
+        head.  Returns [(request, req_lo, req_hi), ...] (empty on
+        shutdown with a drained queue)."""
+        segs = []
+        total = 0
+        while self._queue and total < self.max_batch:
+            entry = self._queue[0]
+            req, off = entry
+            take = min(req.k - off, self.max_batch - total)
+            segs.append((req, off, off + take))
+            total += take
+            if off + take == req.k:
+                self._queue.popleft()
+            else:
+                entry[1] = off + take
+        self._pending_cols -= total
+        return segs
+
+    def _dispatch_loop(self):
+        tracer = get_tracer()
+        while True:
+            with self._cond:
+                while not self._queue and not self._closed:
+                    self._flush = False
+                    self._cond.wait()
+                if not self._queue and self._closed:
+                    return
+                # coalescing: hold the oldest request open for the
+                # batching window unless the batch can already fill (or
+                # a flush/close asked for immediacy)
+                deadline = time.perf_counter() + self.max_wait_s
+                while (self._pending_cols < self.max_batch
+                       and not self._closed and not self._flush):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._cond.wait(left)
+                self._flush = False
+                segs = self._take_batch()
+                depth = self._pending_cols
+            if not segs:
+                continue
+            self._dispatch(segs, depth, tracer)
+
+    def _dispatch(self, segs, depth, tracer):
+        cols = sum(hi - lo for _, lo, hi in segs)
+        kb = bucket_nrhs(min(cols, self.max_batch), self._bucket_set)
+        t0 = time.perf_counter()
+        try:
+            if len(segs) == 1:
+                req, lo, hi = segs[0]
+                mat = req.b[:, lo:hi]
+            else:
+                dtype = np.result_type(*(s[0].b.dtype for s in segs))
+                mat = np.empty((self.n, cols), dtype=dtype)
+                c = 0
+                for req, lo, hi in segs:
+                    mat[:, c:c + hi - lo] = req.b[:, lo:hi]
+                    c += hi - lo
+            with tracer.span("serve-batch", cat="dispatch", columns=cols,
+                             bucket=kb, requests=len(segs),
+                             queue_depth=depth, trans=self.trans):
+                x = np.asarray(self._solve(mat))
+            err = None
+        except Exception as e:          # noqa: BLE001 — the error belongs
+            x, err = None, e            # to the tickets, not the loop
+        now = time.perf_counter()
+        done_lat = []
+        with self._lock:
+            self._batches += 1
+            self._batch_cols += cols
+            if err is not None:
+                self._errors += 1
+        c = 0
+        for req, lo, hi in segs:
+            if err is not None:
+                req.error = err
+                req.event.set()
+            else:
+                req.parts.append((lo, x[:, c:c + hi - lo]))
+                req.remaining -= hi - lo
+                if req.remaining == 0:
+                    done_lat.append(now - req.t_submit)
+                    req.event.set()
+            c += hi - lo
+        m = self._metrics
+        if m is not None:
+            m.inc("slu_serve_batches_total", 1.0)
+            m.set("slu_serve_queue_depth", float(depth))
+            m.observe("slu_serve_batch_fill", cols / max(kb, 1))
+            m.set("slu_serve_batch_seconds", now - t0)
+            if err is not None:
+                m.inc("slu_serve_errors_total", 1.0,
+                      error=type(err).__name__)
+            for lat in done_lat:
+                m.observe("slu_serve_request_seconds", lat)

@@ -1,1 +1,3 @@
 from superlu_dist_tpu.solve.trisolve import lu_solve
+from superlu_dist_tpu.solve.plan import (   # noqa: F401
+    SolvePlan, build_solve_plan, nrhs_buckets, bucket_nrhs, chunk_nrhs)

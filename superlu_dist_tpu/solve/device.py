@@ -2,19 +2,36 @@
 
 Analog of pdgstrs (SRC/pdgstrs.c:838) + the lsum kernels
 (SRC/pdgstrs_lsum.c:413,1360): forward solve L·y = d walking the supernode
-levels bottom-up, backward solve U·x = y walking them top-down.  Where the
+tree bottom-up, backward solve U·x = y walking it back down.  Where the
 reference runs an MPI event loop over per-supernode broadcast/reduce trees
-with OpenMP-task lsum updates, here each (level, bucket) group is one
-batched kernel: gather RHS segments, a vmapped triangular solve on the
-MXU, and a scatter-add of the L21·y (resp. U12·x) contributions — the
+with OpenMP-task lsum updates, here each sweep batch is one batched
+kernel: gather RHS segments, a (recursively blocked) triangular solve on
+the MXU, and a scatter-add of the L21·y (resp. U12·x) contributions — the
 lsum vector lives in device HBM, playing the role of the reference's
 distributed lsum buffers.
+
+Sweep batches come from a :class:`~superlu_dist_tpu.solve.plan.SolvePlan`
+(solve/plan.py): the PR 5 dataflow machinery regroups supernodes across
+elimination levels into maximal same-shape batches, with a second
+shape-key alignment pass on top of the factor keys.  Batches that
+coincide with a factor group alias its front arrays (zero copy); merged
+batches gather — and, for promoted keys, identity/zero-pad — a fresh
+panel stack once at solver construction.
+
+Many-RHS support is first-class: request widths map onto a CLOSED nrhs
+bucket set (power-of-two rungs then bounded geometric growth,
+solve/plan.py) and anything past the cap is column-chunked, so one
+serving process compiles at most |buckets| kernel variants per sweep
+shape no matter what traffic arrives.  Large supernode diagonal blocks
+solve via recursive blocked TRSM (``SLU_TPU_SOLVE_TRSM_LEAF``): the
+recursion turns all but the leaf triangles into batched GEMMs the MXU
+can run at rate (arXiv:2504.13821's recursive TRSM, batched).
 
 Factors never leave the device (the reference's analog: factors stay in
 each rank's memory between pdgstrf and pdgstrs); only the right-hand side
 (n·nrhs) crosses the host boundary.  Like the factorization executors, one
-kernel compiles per distinct (batch, m, w, u, nrhs) bucket and is cached
-persistently.
+kernel compiles per distinct (batch, m, w, u, nrhs-bucket) shape and is
+cached persistently.
 """
 
 from __future__ import annotations
@@ -29,6 +46,7 @@ import jax.numpy as jnp
 from superlu_dist_tpu.numeric.factor import NumericFactorization
 from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
 from superlu_dist_tpu.obs.trace import get_tracer
+from superlu_dist_tpu.solve.plan import SolvePlan, build_solve_plan, chunk_nrhs
 
 
 def _sweep_kernel_builds() -> int:
@@ -42,11 +60,42 @@ def _sweep_kernel_builds() -> int:
             + _diag_inv_kernel.cache_info().misses)
 
 
-def _bucket_nrhs(k: int) -> int:
-    return 1 if k == 1 else 1 << int(np.ceil(np.log2(k)))
+def _trsm(a, b, lower, unit, trans, leaf):
+    """Batched triangular solve op(a)·x = b with recursive blocking.
+
+    a is (B, w, w), b is (B, w, k).  At or below ``leaf`` the vmapped
+    LAPACK-style solve runs directly; above it the triangle splits in
+    half and the off-diagonal block becomes one batched GEMM — the
+    recursive blocked TRSM that keeps large diagonal blocks on the MXU
+    instead of in a length-w dependent chain (leaf <= 0 disables
+    blocking entirely).  Conjugation is the caller's job (conj the
+    triangle before calling, as the trans sweeps already do)."""
+    w = a.shape[-1]
+    if leaf <= 0 or w <= leaf:
+        return jax.vmap(lambda m, r: jax.scipy.linalg.solve_triangular(
+            m, r, lower=lower, unit_diagonal=unit, trans=trans))(a, b)
+    h = w // 2
+    a11, a22 = a[:, :h, :h], a[:, h:, h:]
+    b1, b2 = b[:, :h], b[:, h:]
+    hi = jax.lax.Precision.HIGHEST
+    if lower != bool(trans):
+        # dependency runs top-down: x1 first, then fold A21·x1 (notrans
+        # lower) / A12ᵀ·x1 (trans upper) out of b2
+        off = a[:, h:, :h] if lower else jnp.swapaxes(a[:, :h, h:], 1, 2)
+        x1 = _trsm(a11, b1, lower, unit, trans, leaf)
+        x2 = _trsm(a22, b2 - jnp.matmul(off, x1, precision=hi),
+                   lower, unit, trans, leaf)
+    else:
+        # bottom-up: x2 first (notrans upper / trans lower)
+        off = a[:, :h, h:] if not lower else jnp.swapaxes(a[:, h:, :h], 1, 2)
+        x2 = _trsm(a22, b2, lower, unit, trans, leaf)
+        x1 = _trsm(a11, b1 - jnp.matmul(off, x2, precision=hi),
+                   lower, unit, trans, leaf)
+    return jnp.concatenate([x1, x2], axis=1)
 
 
-def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv):
+def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv,
+              leaf):
     """x[cols] <- L11⁻¹(x[cols] − lsum[cols]); lsum[rows] += L21·x[cols].
 
     With use_inv, L11⁻¹ arrives precomputed and the triangular solve
@@ -63,9 +112,8 @@ def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv):
     if use_inv:
         y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
     else:
-        l11 = lpanel[:, :w, :w]
-        y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
-            l, b, lower=True, unit_diagonal=True))(l11, rhs)
+        y = _trsm(lpanel[:, :w, :w], rhs, lower=True, unit=True,
+                  trans=0, leaf=leaf)
     x = x.at[cols].set(y, mode="drop")
     if u:
         contrib = jnp.matmul(lpanel[:, w:, :], y,
@@ -74,7 +122,8 @@ def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv):
     return x, lsum
 
 
-def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv):
+def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv,
+              leaf):
     """x[cols] <- U11⁻¹(x[cols] − U12·x[rows])."""
     k = jnp.arange(w)
     cols = jnp.where(k[None, :] < ws[:, None],
@@ -87,14 +136,13 @@ def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv):
     if use_inv:
         y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
     else:
-        u11 = lpanel[:, :w, :w]
-        y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
-            r, b, lower=False))(u11, rhs)
+        y = _trsm(lpanel[:, :w, :w], rhs, lower=False, unit=False,
+                  trans=0, leaf=leaf)
     return x.at[cols].set(y, mode="drop")
 
 
 def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
-                    conj):
+                    conj, leaf):
     """Transpose forward sweep: x[cols] <- U11⁻ᵀ(x[cols] − lsum[cols]);
     lsum[rows] += U12ᵀ·x[cols].  Mᵀ = UᵀLᵀ, so Uᵀ (lower) leads — the
     trans_t path through the same factors (superlu_defs.h:628-657)."""
@@ -106,8 +154,7 @@ def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
     u11 = lpanel[:, :w, :w]
     if conj:
         u11 = u11.conj()
-    y = jax.vmap(lambda r, b: jax.scipy.linalg.solve_triangular(
-        r, b, trans=1, lower=False))(u11, rhs)
+    y = _trsm(u11, rhs, lower=False, unit=False, trans=1, leaf=leaf)
     x = x.at[cols].set(y, mode="drop")
     if u:
         u12 = upanel.conj() if conj else upanel       # (B, w, u)
@@ -117,7 +164,7 @@ def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
     return x, lsum
 
 
-def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj):
+def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj, leaf):
     """Transpose backward sweep: x[cols] <- L11⁻ᵀ(x[cols] − L21ᵀ·x[rows])."""
     k = jnp.arange(w)
     cols = jnp.where(k[None, :] < ws[:, None],
@@ -133,74 +180,92 @@ def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj):
     l11 = lpanel[:, :w, :w]
     if conj:
         l11 = l11.conj()
-    y = jax.vmap(lambda l, b: jax.scipy.linalg.solve_triangular(
-        l, b, trans=1, lower=True, unit_diagonal=True))(l11, rhs)
+    y = _trsm(l11, rhs, lower=True, unit=True, trans=1, leaf=leaf)
     return x.at[cols].set(y, mode="drop")
 
 
 @functools.lru_cache(maxsize=None)
-def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
+def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False, leaf=0):
     def step(lpanel, x, lsum, first, rows, ws, linv=None):
         return _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n,
-                         use_inv, linv)
+                         use_inv, linv, leaf)
 
     return jax.jit(step, donate_argnums=(1, 2))
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False):
+def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False, leaf=0):
     def step(lpanel, upanel, x, first, rows, ws, uinv=None):
         return _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n,
-                         use_inv, uinv)
+                         use_inv, uinv, leaf)
 
     return jax.jit(step, donate_argnums=(2,))
 
 
 @functools.lru_cache(maxsize=None)
-def _fwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False):
+def _fwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False, leaf=0):
     def step(lpanel, upanel, x, lsum, first, rows, ws):
         return _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws,
-                               w, u, n, conj)
+                               w, u, n, conj, leaf)
 
     return jax.jit(step, donate_argnums=(2, 3))
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False):
+def _bwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False, leaf=0):
     def step(lpanel, x, first, rows, ws):
-        return _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj)
+        return _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj,
+                               leaf)
 
     return jax.jit(step, donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
-def _diag_inv_kernel(w, dtype):
+def _diag_inv_kernel(w, dtype, leaf=0):
     """Batched inverses of the packed diagonal blocks — the
     pdCompute_Diag_Inv analog (SRC/pdgstrs.c:647, dtrtri per block)."""
 
     def inv(lpanel):
         f11 = lpanel[:, :w, :w]
-        eye = jnp.eye(w, dtype=lpanel.dtype)
-        linv = jax.vmap(lambda l: jax.scipy.linalg.solve_triangular(
-            l, eye, lower=True, unit_diagonal=True))(f11)
-        uinv = jax.vmap(lambda r: jax.scipy.linalg.solve_triangular(
-            r, eye, lower=False))(f11)
+        eye = jnp.broadcast_to(jnp.eye(w, dtype=lpanel.dtype),
+                               f11.shape)
+        linv = _trsm(f11, eye, lower=True, unit=True, trans=0, leaf=leaf)
+        uinv = _trsm(f11, eye, lower=False, unit=False, trans=0,
+                     leaf=leaf)
         return linv, uinv
 
     return jax.jit(inv)
 
 
+def _pad_panels(lp, up, w0, u0, W, U):
+    """Promote one factor group's panel stack from its (w0, u0) padding
+    to a merged solve key (W, U): identity on the new pivot diagonal
+    (benign under both the unit-lower and the non-unit upper solves —
+    padded columns gather from and write to the dump row only), zeros
+    everywhere else so padded L21/U12 contributions vanish exactly."""
+    piv, l21 = lp[:, :w0, :w0], lp[:, w0:, :]
+    dw, du = W - w0, U - u0
+    piv = jnp.pad(piv, ((0, 0), (0, dw), (0, dw)))
+    if dw:
+        idx = jnp.arange(w0, W)
+        piv = piv.at[:, idx, idx].set(1)
+    l21 = jnp.pad(l21, ((0, 0), (0, du), (0, dw)))
+    return (jnp.concatenate([piv, l21], axis=1),
+            jnp.pad(up, ((0, 0), (0, dw), (0, du))))
+
+
 class DeviceSolver:
     """Solve (L·U)x = d on the device, in the factor's permuted labeling.
 
-    The dSOLVEstruct_t analog (superlu_ddefs.h:216-228): per-group index
-    maps are built once and reused across repeated solves (the reference
-    caches them behind SolveInitialized, pdgssvx.c:1330-1337).
+    The dSOLVEstruct_t analog (superlu_ddefs.h:216-228): the sweep
+    schedule (a SolvePlan), per-batch index maps and panel stacks are
+    built once and reused across repeated solves (the reference caches
+    them behind SolveInitialized, pdgssvx.c:1330-1337).
 
-    fused=True traces each whole sweep (all levels) into ONE jitted XLA
+    fused=True traces each whole sweep (all batches) into ONE jitted XLA
     program per nrhs bucket — one dispatch for the forward solve and one
-    for the backward instead of one per (level, bucket) group.  The solve
-    is latency-bound (tiny per-level GEMVs — SURVEY.md §7 hard-part 5:
+    for the backward instead of one per sweep batch.  The solve is
+    latency-bound (tiny per-level GEMVs — SURVEY.md §7 hard-part 5:
     "tree-based trisolve is tiny-message dominated"), so collapsing the
     dispatch chain is the device analog of the reference's fully
     pipelined event loop.  Compile cost grows with the plan, so "auto"
@@ -208,23 +273,46 @@ class DeviceSolver:
     """
 
     def __init__(self, fact: NumericFactorization, diag_inv: bool = False,
-                 fused: str | bool = "auto", mesh=None):
+                 fused: str | bool = "auto", mesh=None,
+                 solve_plan: SolvePlan | None = None,
+                 schedule: str | None = None, window: int | None = None,
+                 align: float | None = None, trsm_leaf: int | None = None,
+                 nrhs_max: int | None = None,
+                 nrhs_growth: float | None = None):
         """mesh: a jax.sharding.Mesh the factors are sharded over.  Needed
         when the mesh spans MULTIPLE PROCESSES (the pdgstrs-over-the-grid
         case): the RHS then uploads replicated over the global mesh and
         the index maps stay numpy (pjit treats identical host arrays as
         replicated global inputs), so every controller runs the same SPMD
-        sweeps and reads the replicated result locally.  Single-process
-        solves (including virtual meshes) don't need it."""
+        sweeps and reads the replicated result locally.  The sweep
+        schedule is then pinned to "factor" — re-gathering panel stacks
+        would commit non-addressable shards to one local device — so a
+        multi-process solve keeps the factor grouping 1:1.
+        Single-process solves (including virtual meshes) don't need it."""
         self.fact = fact
         self.diag_inv = diag_inv
         self.mesh = mesh
+        plan = fact.plan
+        if trsm_leaf is None:
+            from superlu_dist_tpu.utils.options import env_int
+            trsm_leaf = env_int("SLU_TPU_SOLVE_TRSM_LEAF")
+        self.trsm_leaf = int(trsm_leaf)
+        if mesh is not None:
+            solve_plan = build_solve_plan(plan, schedule="factor",
+                                          nrhs_max=nrhs_max,
+                                          nrhs_growth=nrhs_growth)
+        elif solve_plan is None:
+            solve_plan = build_solve_plan(plan, schedule=schedule,
+                                          window=window, align=align,
+                                          nrhs_max=nrhs_max,
+                                          nrhs_growth=nrhs_growth)
+        self.splan = solve_plan
+        self.last_solve_stats = None
         if fused == "auto":
-            fused = len(fact.plan.groups) <= 256
+            fused = len(solve_plan.groups) <= 256
         self.fused = bool(fused)
         self._fused_cache = {}
         self._replicate = None
-        plan = fact.plan
         sf = plan.sf
         self.n = plan.n
         first = sf.sn_start[:-1]
@@ -236,8 +324,8 @@ class DeviceSolver:
         # a host-share factorization (stream.py SLU_TPU_HOST_FLOPS) leaves
         # the leading leaf panels as numpy: upload those once so the
         # jitted sweeps don't re-transfer them on every solve.  The
-        # uploaded list lives on the SOLVER (self.fronts) — assigning back
-        # to fact.fronts would silently flip fact.on_host and force a
+        # uploaded list lives on the SOLVER — assigning back to
+        # fact.fronts would silently flip fact.on_host and force a
         # later host solve on the same factorization to re-pull everything
         if (any(isinstance(lp, np.ndarray) for lp, _ in fact.fronts)
                 and not fact.on_host):
@@ -246,17 +334,55 @@ class DeviceSolver:
             # to one local device and break a multi-process SPMD solve
             assert mesh is None, \
                 "host-share fronts cannot meet a multi-process mesh solve"
-            self.fronts = [(jnp.asarray(lp), jnp.asarray(up))
-                           for lp, up in fact.fronts]
+            src_fronts = [(jnp.asarray(lp), jnp.asarray(up))
+                          for lp, up in fact.fronts]
         else:
-            self.fronts = fact.fronts
-        for grp, (lp, up) in zip(plan.groups, self.fronts):
-            firsts = _put(first[grp.sns])
-            rows = np.full((grp.batch, grp.u), self.n, dtype=np.int64)
-            for slot, s in enumerate(grp.sns):
+            src_fronts = fact.fronts
+        panels = []
+        for sg in solve_plan.groups:
+            if sg.reuse >= 0:
+                panels.append(src_fronts[sg.reuse])
+            else:
+                panels.append(self._gather_panels(sg, src_fronts, plan))
+            firsts = _put(first[sg.sns])
+            rows = np.full((sg.batch, sg.u), self.n, dtype=np.int64)
+            for slot, s in enumerate(sg.sns):
                 r = sf.sn_rows[s]
                 rows[slot, :len(r)] = r
-            self._groups.append((grp, firsts, _put(rows), _put(grp.ws)))
+            self._groups.append((sg, firsts, _put(rows), _put(sg.ws)))
+        self.fronts = panels
+
+    @staticmethod
+    def _gather_panels(sg, src_fronts, plan):
+        """Assemble one merged sweep batch's panel stack from the factor
+        fronts: per contiguous source-group run one fancy-index gather,
+        promoted keys identity/zero-padded, all concatenated in member
+        (slot) order.  Runs once at construction, on device."""
+        parts_l, parts_u = [], []
+        i, B = 0, sg.batch
+        while i < B:
+            g = int(sg.src_group[i])
+            j = i
+            while j < B and int(sg.src_group[j]) == g:
+                j += 1
+            slots = np.ascontiguousarray(sg.src_slot[i:j], dtype=np.int64)
+            lp, up = src_fronts[g]
+            fg = plan.groups[g]
+            if len(slots) == fg.batch and np.array_equal(
+                    slots, np.arange(fg.batch)):
+                lp, up = jnp.asarray(lp), jnp.asarray(up)   # whole group
+            else:
+                lp = jnp.asarray(lp)[slots]
+                up = jnp.asarray(up)[slots]
+            if (fg.w, fg.u) != (sg.w, sg.u):
+                lp, up = _pad_panels(lp, up, fg.w, fg.u, sg.w, sg.u)
+            parts_l.append(lp)
+            parts_u.append(up)
+            i = j
+        if len(parts_l) == 1:
+            return parts_l[0], parts_u[0]
+        return (jnp.concatenate(parts_l, axis=0),
+                jnp.concatenate(parts_u, axis=0))
 
     @property
     def _invs(self):
@@ -267,8 +393,8 @@ class DeviceSolver:
         if self._invs_cached is None:
             if self.diag_inv:
                 self._invs_cached = [
-                    _diag_inv_kernel(grp.w, str(jnp.dtype(self.fact.dtype)))(
-                        jnp.asarray(lp))
+                    _diag_inv_kernel(grp.w, str(jnp.dtype(self.fact.dtype)),
+                                     self.trsm_leaf)(jnp.asarray(lp))
                     for (grp, _, _, _), (lp, _) in zip(self._groups,
                                                        self.fronts)]
             else:
@@ -276,21 +402,22 @@ class DeviceSolver:
         return self._invs_cached
 
     def _fused_fns(self, kb):
-        """One jitted program per sweep (all levels) for this nrhs bucket.
-        (jit re-traces on shape/dtype changes anyway; the kb key just
-        avoids rebuilding the Python closures.)"""
+        """One jitted program per sweep (all batches) for this nrhs
+        bucket.  (jit re-traces on shape/dtype changes anyway; the kb key
+        just avoids rebuilding the Python closures.)"""
         fns = self._fused_cache.get(kb)
         if fns is not None:
             return fns
         n1 = self.n + 1
         use_inv = self.diag_inv
+        leaf = self.trsm_leaf
         meta = [(grp.w, grp.u) for grp, _, _, _ in self._groups]
 
         def fwd(x, lsum, fronts, idx, invs):
             for (w, u), (lp, _), (firsts, rows, ws), (linv, _) in zip(
                     meta, fronts, idx, invs):
                 x, lsum = _fwd_body(lp, x, lsum, firsts, rows, ws, w, u,
-                                    n1, use_inv, linv)
+                                    n1, use_inv, linv, leaf)
             return x, lsum
 
         def bwd(x, fronts, idx, invs):
@@ -298,7 +425,7 @@ class DeviceSolver:
                     reversed(meta), reversed(fronts), reversed(idx),
                     reversed(invs)):
                 x = _bwd_body(lp, up, x, firsts, rows, ws, w, u, n1,
-                              use_inv, uinv)
+                              use_inv, uinv, leaf)
             return x
 
         fns = (jax.jit(fwd, donate_argnums=(0, 1)),
@@ -311,20 +438,21 @@ class DeviceSolver:
         if fns is not None:
             return fns
         n1 = self.n + 1
+        leaf = self.trsm_leaf
         meta = [(grp.w, grp.u) for grp, _, _, _ in self._groups]
 
         def fwd(x, lsum, fronts, idx):
             for (w, u), (lp, up), (firsts, rows, ws) in zip(
                     meta, fronts, idx):
                 x, lsum = _fwd_body_trans(lp, up, x, lsum, firsts, rows,
-                                          ws, w, u, n1, conj)
+                                          ws, w, u, n1, conj, leaf)
             return x, lsum
 
         def bwd(x, fronts, idx):
             for (w, u), (lp, _), (firsts, rows, ws) in zip(
                     reversed(meta), reversed(fronts), reversed(idx)):
                 x = _bwd_body_trans(lp, x, firsts, rows, ws, w, u, n1,
-                                    conj)
+                                    conj, leaf)
             return x
 
         fns = (jax.jit(fwd, donate_argnums=(0, 1)),
@@ -333,63 +461,90 @@ class DeviceSolver:
         return fns
 
     def _run_sweeps(self, rhs, sweeps):
-        """Shared solve scaffolding: pad rhs into the (n+1, kb) buffer
-        (slot n is the OOB dump row), run sweeps(x, lsum, kb) -> x, then
-        unpad — one copy for the plain and transpose paths."""
+        """Shared solve scaffolding: map the request's nrhs onto the
+        closed bucket set (column-chunking past the cap), pad each chunk
+        into an (n+1, kb) buffer (slot n is the OOB dump row), run
+        sweeps(x, lsum, kb) -> x per chunk, then unpad — one copy for
+        the plain and transpose paths.  Executed-vs-structural flops
+        (shape padding × nrhs padding) are reported on the kernel span
+        and latched on ``last_solve_stats`` — the solve path's honesty
+        telemetry, matching the factor path's."""
         tracer = get_tracer()
         squeeze = rhs.ndim == 1
         r2 = rhs[:, None] if squeeze else rhs
         k = r2.shape[1]
-        kb = _bucket_nrhs(k)
-        pad = np.zeros((self.n + 1, kb), dtype=jnp.dtype(self.fact.dtype))
-        pad[:self.n, :k] = r2
+        chunks = chunk_nrhs(k, self.splan.nrhs_bucket_set)
+        kb_total = sum(b for _, _, b in chunks)
+        dt = jnp.dtype(self.fact.dtype)
+        structural = self.splan.flops_per_rhs * k
+        executed = self.splan.executed_flops_per_rhs * kb_total
+        stats = {"nrhs": k, "padded_nrhs": kb_total,
+                 "chunks": len(chunks),
+                 "solve_flops": structural, "executed_flops": executed,
+                 "padding_factor": round(executed / max(structural, 1.0),
+                                         4)}
+        out = np.empty((self.n, k), dtype=dt)
         # compile census: new sweep-kernel closures (streamed lru misses
         # or fresh fused programs) mean this call compiles — time the
         # sweep issue and account it per (n, nrhs-bucket, mode)
         builds0 = _sweep_kernel_builds() + len(self._fused_cache)
         t0_build = time.perf_counter()
+        d2h_s, d2h_bytes = 0.0, 0
         with tracer.span("device-solve", cat="kernel", n=self.n, nrhs=k,
-                         padded_nrhs=kb, fused=self.fused,
-                         n_groups=len(self._groups),
-                         dtype=str(jnp.dtype(self.fact.dtype))):
-            if self.mesh is not None:
-                # replicated over the global mesh: every process supplies
-                # the same host array, every process can read the result
-                # locally
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                rep = NamedSharding(self.mesh, P(None, None))
-                if self._replicate is None:
-                    # cached: a fresh lambda per solve would miss jax's
-                    # trace cache on every IR correction solve
-                    self._replicate = jax.jit(lambda a: a,
-                                              out_shardings=rep)
-                x = jax.device_put(pad, rep)
-                lsum = jax.device_put(np.zeros_like(pad), rep)
-                x = sweeps(x, lsum, kb)
-                # normalize whatever sharding GSPMD inferred back to fully
-                # replicated so np.asarray below is process-local
-                x = self._replicate(x)
-            else:
-                x = jnp.asarray(pad)
-                lsum = jnp.zeros_like(x)
-                x = sweeps(x, lsum, kb)
+                         padded_nrhs=kb_total, chunks=len(chunks),
+                         fused=self.fused, n_groups=len(self._groups),
+                         schedule=self.splan.schedule,
+                         solve_flops=structural, executed_flops=executed,
+                         padding_factor=stats["padding_factor"],
+                         dtype=str(dt)):
+            for lo, hi, kb in chunks:
+                pad = np.zeros((self.n + 1, kb), dtype=dt)
+                pad[:self.n, :hi - lo] = r2[:, lo:hi]
+                if self.mesh is not None:
+                    # replicated over the global mesh: every process
+                    # supplies the same host array, every process can
+                    # read the result locally
+                    from jax.sharding import (NamedSharding,
+                                              PartitionSpec as P)
+                    rep = NamedSharding(self.mesh, P(None, None))
+                    if self._replicate is None:
+                        # cached: a fresh lambda per solve would miss
+                        # jax's trace cache on every IR correction solve
+                        self._replicate = jax.jit(lambda a: a,
+                                                  out_shardings=rep)
+                    x = jax.device_put(pad, rep)
+                    lsum = jax.device_put(np.zeros_like(pad), rep)
+                    x = sweeps(x, lsum, kb)
+                    # normalize whatever sharding GSPMD inferred back to
+                    # fully replicated so np.asarray below is
+                    # process-local
+                    x = self._replicate(x)
+                else:
+                    x = jnp.asarray(pad)
+                    lsum = jnp.zeros_like(x)
+                    x = sweeps(x, lsum, kb)
+                t0 = time.perf_counter()
+                res = np.asarray(jax.block_until_ready(x))[:self.n,
+                                                           :hi - lo]
+                d2h_s += time.perf_counter() - t0
+                d2h_bytes += int(res.nbytes)
+                out[:, lo:hi] = res
             builds = (_sweep_kernel_builds() + len(self._fused_cache)
                       - builds0)
             if builds:
                 COMPILE_STATS.record(
                     "solve.device",
-                    f"solve n{self.n} nrhs{kb} "
+                    f"solve n{self.n} nrhs{kb_total} "
                     f"{'fused' if self.fused else 'stream'}",
                     t0_build, time.perf_counter() - t0_build,
                     n_args=6, builds=builds)
-            t0 = time.perf_counter()
-            out = np.asarray(jax.block_until_ready(x))[:self.n, :k]
             if tracer.enabled:
                 # the solution's D2H pull (the only factor-sized data
                 # that ever crosses the boundary per solve)
-                tracer.complete("solve-d2h", "comm", t0,
-                                time.perf_counter() - t0, op="d2h",
-                                bytes=int(out.nbytes))
+                tracer.complete("solve-d2h", "comm",
+                                time.perf_counter() - d2h_s, d2h_s,
+                                op="d2h", bytes=d2h_bytes)
+        self.last_solve_stats = stats
         return out[:, 0] if squeeze else out
 
     def solve_trans(self, rhs: np.ndarray, conj: bool = False) -> np.ndarray:
@@ -401,6 +556,7 @@ class DeviceSolver:
         n1 = self.n + 1
         dt = jnp.dtype(fact.dtype)
         conj = bool(conj)
+        leaf = self.trsm_leaf
 
         def sweeps(x, lsum, kb):
             if self.fused:
@@ -409,17 +565,17 @@ class DeviceSolver:
                        for _, firsts, rows, ws in self._groups]
                 x, lsum = fwd(x, lsum, self.fronts, idx)
                 return bwd(x, self.fronts, idx)
-            # Uᵀ forward, levels ascending
+            # Uᵀ forward, sweep batches ascending
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     self._groups, self.fronts):
                 kern = _fwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
-                                         kb, n1, str(dt), conj)
+                                         kb, n1, str(dt), conj, leaf)
                 x, lsum = kern(lp, up, x, lsum, firsts, rows, ws)
-            # Lᵀ backward, levels descending
+            # Lᵀ backward, descending
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     reversed(self._groups), reversed(self.fronts)):
                 kern = _bwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
-                                         kb, n1, str(dt), conj)
+                                         kb, n1, str(dt), conj, leaf)
                 x = kern(lp, x, firsts, rows, ws)
             return x
 
@@ -431,6 +587,7 @@ class DeviceSolver:
         n1 = self.n + 1
         dt = jnp.dtype(fact.dtype)
         use_inv = self.diag_inv
+        leaf = self.trsm_leaf
 
         def sweeps(x, lsum, kb):
             if self.fused:
@@ -440,20 +597,20 @@ class DeviceSolver:
                 x, lsum = fwd(x, lsum, self.fronts, idx, self._invs)
                 return bwd(x, self.fronts, idx, self._invs)
             # forward in dispatch order (topological: every descendant's
-            # group precedes its ancestors' under either scheduler)
+            # batch precedes its ancestors' under either scheduler)
             for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
                     self._groups, self.fronts, self._invs):
                 kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                                   str(dt), use_inv)
+                                   str(dt), use_inv, leaf)
                 x, lsum = (kern(lp, x, lsum, firsts, rows, ws, linv)
                            if use_inv else
                            kern(lp, x, lsum, firsts, rows, ws))
-            # backward, levels descending
+            # backward, descending
             for (grp, firsts, rows, ws), (lp, up), (_, uinv) in zip(
                     reversed(self._groups), reversed(self.fronts),
                     reversed(self._invs)):
                 kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                                   str(dt), use_inv)
+                                   str(dt), use_inv, leaf)
                 x = (kern(lp, up, x, firsts, rows, ws, uinv) if use_inv
                      else kern(lp, up, x, firsts, rows, ws))
             return x

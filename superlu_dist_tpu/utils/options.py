@@ -93,6 +93,37 @@ def _register_all() -> None:
     r("SLU_TPU_DIAG_INV", "flag", False,
       "precompute inverted diagonal blocks (reference DiagInv)",
       group="numeric")
+    # --- device solve / serving tier (solve/plan.py, serve/) ---------------
+    r("SLU_TPU_SOLVE_SCHEDULE", "str", "dataflow",
+      "sweep-batch scheduler for the device triangular solve: "
+      "earliest-ready dataflow batching, strict level lockstep, or the "
+      "factor plan's grouping 1:1", group="solve",
+      choices=("dataflow", "level", "factor"))
+    r("SLU_TPU_SOLVE_WINDOW", "int", 0,
+      "dataflow look-ahead window of the solve scheduler in elimination "
+      "levels (0 = unbounded — the solve holds no Schur pool, so "
+      "liveness does not bound it; 1 degenerates to the level partition)",
+      group="solve")
+    r("SLU_TPU_SOLVE_ALIGN", "float", 1.25,
+      "solve-side shape-key coalescing flop tolerance, applied on top "
+      "of the factor keys (<= 1 disables; promoted members get "
+      "identity/zero panel padding)", group="solve")
+    r("SLU_TPU_SOLVE_NRHS_MAX", "int", 1024,
+      "largest nrhs bucket — the column-chunking cap that closes the "
+      "solve-kernel compile set", group="solve")
+    r("SLU_TPU_SOLVE_NRHS_GROWTH", "float", 1.5,
+      "geometric nrhs bucket growth past the power-of-two rungs "
+      "(rounded to multiples of 32)", group="solve")
+    r("SLU_TPU_SOLVE_TRSM_LEAF", "int", 64,
+      "recursive blocked-TRSM leaf width for supernode diagonal blocks "
+      "(0 = unblocked vmapped triangular solves)", group="solve")
+    r("SLU_TPU_SERVE_MAX_BATCH", "int", 0,
+      "SolveServer micro-batch column cap (0 = the nrhs bucket cap)",
+      group="serve")
+    r("SLU_TPU_SERVE_MAX_WAIT_MS", "float", 2.0,
+      "SolveServer coalescing window: how long the dispatcher holds the "
+      "oldest pending request open for co-batching before dispatching",
+      group="serve")
     r("SLU_TPU_POOL_PARTITION", "flag", False,
       "shard the Schur update pool across all mesh devices", group="numeric")
     # --- distributed tier --------------------------------------------------
@@ -224,7 +255,9 @@ def _register_all() -> None:
             ("BENCH_GROWTH", "float", None, "bucket growth override"),
             ("BENCH_AMALG", "float", None, "amalgamation tol override"),
             ("BENCH_MATRIX", "str", "poisson3d", "bench matrix family"),
-            ("BENCH_GRANULARITY", "str", None, "stream granularity")):
+            ("BENCH_GRANULARITY", "str", None, "stream granularity"),
+            ("BENCH_SOLVE_NRHS", "str", "1,64,1024",
+             "device-solve bench nrhs sweep (comma list; empty skips)")):
         r(name, kind, default, help_, group="bench")
     # --- measurement scripts ----------------------------------------------
     for name, kind, default, help_ in (
@@ -522,6 +555,21 @@ class Options:
     # "dataflow" pad identically and stay bitwise-comparable.
     sched_align: float = dataclasses.field(
         default_factory=lambda: env_float("SLU_TPU_SCHED_ALIGN"))
+    # device-solve sweep scheduler (solve/plan.py): "dataflow" regroups
+    # supernodes across levels into maximal same-shape sweep batches
+    # (the serving hot path); "level" and "factor" are the A/B tiers —
+    # all three produce the same solution through the same factors
+    # (tests/test_solve_plan.py)
+    solve_schedule: str = dataclasses.field(
+        default_factory=lambda: env_str("SLU_TPU_SOLVE_SCHEDULE"))
+    # solve-scheduler look-ahead window (0 = unbounded: no Schur pool
+    # bounds the solve, unlike the factor's sched_window)
+    solve_window: int = dataclasses.field(
+        default_factory=lambda: env_int("SLU_TPU_SOLVE_WINDOW"))
+    # solve-side shape-key coalescing tolerance on top of the factor
+    # keys (<= 1 disables; promoted panels get identity/zero padding)
+    solve_align: float = dataclasses.field(
+        default_factory=lambda: env_float("SLU_TPU_SOLVE_ALIGN"))
     # shard the Schur update pool across ALL mesh devices (the n≈1M
     # memory path; only meaningful with a grid) — SLU_TPU_POOL_PARTITION=1
     pool_partition: bool = dataclasses.field(

@@ -1,0 +1,223 @@
+"""Solve-plan machinery (solve/plan.py): bounded nrhs buckets, dataflow
+sweep scheduling over the factor plan, shape-key promotion padding, the
+recursive blocked TRSM, and the padding-honesty telemetry."""
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import gssvx
+from superlu_dist_tpu.models.gallery import (
+    poisson2d, random_sparse)
+from superlu_dist_tpu.solve.plan import (
+    SolvePlan, bucket_nrhs, build_solve_plan, chunk_nrhs, nrhs_buckets)
+from superlu_dist_tpu.utils.options import IterRefine, Options
+
+pytestmark = pytest.mark.solveplan
+
+
+def _factor(a, **opt_kw):
+    opts = Options(iter_refine=IterRefine.NOREFINE, **opt_kw)
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert info == 0
+    return lu
+
+
+# ---------------------------------------------------------------------------
+# nrhs bucket set
+# ---------------------------------------------------------------------------
+
+def test_nrhs_buckets_bounded_and_exact_small():
+    bs = nrhs_buckets(1024, 1.5)
+    assert bs[0] == 1 and bs[-1] == 1024
+    assert list(bs) == sorted(set(bs))
+    # the latency-critical rungs pad nothing
+    for k in (1, 2, 4, 8, 16, 32, 64):
+        assert bucket_nrhs(k, bs) == k
+    # the set is CLOSED and small — the bounded-compile-set contract
+    assert len(bs) <= 16
+    # geometric rungs are multiples of 32 past the pow2 regime
+    assert all(b % 32 == 0 for b in bs if b > 64)
+
+
+def test_nrhs_bucket_lookup_and_cap():
+    bs = nrhs_buckets(1024, 1.5)
+    assert bucket_nrhs(65, bs) == 96
+    assert bucket_nrhs(97, bs) > 97
+    with pytest.raises(ValueError):
+        bucket_nrhs(1025, bs)
+
+
+def test_chunk_nrhs_splits_past_cap():
+    bs = nrhs_buckets(1024, 1.5)
+    chunks = chunk_nrhs(2500, bs)
+    assert chunks[0] == (0, 1024, 1024) and chunks[1] == (1024, 2048, 1024)
+    lo, hi, kb = chunks[-1]
+    assert hi == 2500 and kb == bucket_nrhs(2500 - lo, bs)
+    # contiguous cover
+    assert all(c1[1] == c2[0] for c1, c2 in zip(chunks, chunks[1:]))
+    assert chunk_nrhs(1, bs) == [(0, 1, 1)]
+    # a tiny cap still yields a usable (single-bucket) set
+    tiny = nrhs_buckets(4, 1.5)
+    assert tiny == (1, 2, 4)
+    assert chunk_nrhs(11, tiny) == [(0, 4, 4), (4, 8, 4), (8, 11, 4)]
+
+
+# ---------------------------------------------------------------------------
+# sweep schedule
+# ---------------------------------------------------------------------------
+
+def test_solve_plan_topological_and_bounded():
+    lu = _factor(poisson2d(16))
+    sp = build_solve_plan(lu.plan, schedule="dataflow", window=0)
+    sf = lu.plan.sf
+    # children strictly precede their parents' sweep batch (the lsum
+    # correctness invariant: a descendant's scatter must land before the
+    # ancestor's segment solves)
+    pos = np.empty(sf.n_supernodes, dtype=np.int64)
+    for i, g in enumerate(sp.groups):
+        pos[g.sns] = i
+    for s in range(sf.n_supernodes):
+        p = int(sf.sn_parent[s])
+        if p >= 0:
+            assert pos[s] < pos[p], (s, p)
+    # cross-level merging never produces MORE dispatches than the
+    # factor grouping, and occupancy never degrades
+    assert len(sp.groups) <= sp.n_factor_groups
+    assert sp.mean_occupancy >= lu.plan.mean_occupancy - 1e-9
+    assert sp.critical_path <= len(sp.groups)
+
+
+def test_window_one_equals_level_partition():
+    lu = _factor(poisson2d(12))
+    sp1 = build_solve_plan(lu.plan, schedule="dataflow", window=1)
+    spl = build_solve_plan(lu.plan, schedule="level")
+    assert len(sp1.groups) == len(spl.groups)
+    for g1, gl in zip(sp1.groups, spl.groups):
+        assert np.array_equal(g1.sns, gl.sns)
+        assert (g1.w, g1.u) == (gl.w, gl.u)
+
+
+def test_factor_schedule_aliases_every_group():
+    lu = _factor(poisson2d(12))
+    sp = build_solve_plan(lu.plan, schedule="factor")
+    assert len(sp.groups) == len(lu.plan.groups)
+    for i, g in enumerate(sp.groups):
+        assert g.reuse == i
+        fg = lu.plan.groups[i]
+        assert np.array_equal(g.sns, fg.sns)
+        assert (g.w, g.u, g.m) == (fg.w, fg.u, fg.m)
+
+
+def test_same_machinery_same_inputs_reproduces_factor_batches():
+    """When the solve scheduler runs the factor scheduler's exact knobs
+    (same window, alignment off), its batches ARE the factor groups —
+    the all-zero-copy fast path."""
+    lu = _factor(poisson2d(14))
+    plan = lu.plan
+    sp = build_solve_plan(plan, schedule=plan.schedule,
+                          window=plan.sched_window, align=1.0)
+    assert all(g.reuse >= 0 for g in sp.groups)
+    assert len(sp.groups) == len(plan.groups)
+
+
+def test_schedule_stats_fields_and_padding_honesty():
+    lu = _factor(random_sparse(90, density=0.06, seed=3),
+                 relax=4, max_supernode=12)
+    sp = build_solve_plan(lu.plan)
+    st = sp.schedule_stats(nrhs=130)
+    for key in ("schedule", "n_groups", "n_factor_groups", "occupancy",
+                "critical_path", "nrhs_buckets", "shape_padding",
+                "reused_groups", "nrhs", "padded_nrhs", "padding_factor"):
+        assert key in st, key
+    # executed always covers structural — shape padding and nrhs
+    # padding both count (the honesty-fix satellite)
+    assert st["shape_padding"] >= 1.0
+    assert st["padding_factor"] >= st["shape_padding"] - 1e-9
+    kb = sum(b for _, _, b in chunk_nrhs(130, sp.nrhs_bucket_set))
+    assert st["padded_nrhs"] == kb
+    assert sp.executed_flops(130) == sp.executed_flops_per_rhs * kb
+    assert sp.solve_flops(130) == sp.flops_per_rhs * 130
+
+
+def test_env_knobs_drive_build(monkeypatch):
+    lu = _factor(poisson2d(10))
+    monkeypatch.setenv("SLU_TPU_SOLVE_SCHEDULE", "level")
+    sp = build_solve_plan(lu.plan)
+    assert sp.schedule == "level"
+    monkeypatch.setenv("SLU_TPU_SOLVE_SCHEDULE", "bogus")
+    with pytest.raises(ValueError):
+        build_solve_plan(lu.plan)
+
+
+def test_driver_threads_solve_schedule(monkeypatch):
+    a = poisson2d(10)
+    opts = Options(iter_refine=IterRefine.NOREFINE,
+                   solve_schedule="level", solve_window=0)
+    x, lu, stats, info = gssvx(opts, a, np.ones(a.n_rows))
+    assert info == 0
+    lu.solve_path = "device"
+    lu.dev_solver = None
+    lu.solve_factored(np.ones(a.n_rows))
+    assert lu.dev_solver.splan.schedule == "level"
+
+
+# ---------------------------------------------------------------------------
+# promoted keys + merged batches still solve correctly
+# ---------------------------------------------------------------------------
+
+def test_promoted_keys_pad_benignly():
+    """A large alignment tolerance merges shape keys, so some sweep
+    batches gather identity/zero-padded panel stacks — the solution must
+    not move."""
+    from superlu_dist_tpu.solve.device import DeviceSolver
+    from superlu_dist_tpu.solve.trisolve import lu_solve
+    a = random_sparse(90, density=0.06, seed=5)
+    lu = _factor(a, relax=4, max_supernode=12, min_bucket=8,
+                 bucket_growth=1.5)
+    sp = build_solve_plan(lu.plan, schedule="dataflow", window=0,
+                          align=4.0)
+    assert any(g.reuse < 0 for g in sp.groups), \
+        "expected at least one merged/promoted batch"
+    d = np.random.default_rng(11).standard_normal((a.n_rows, 3))
+    got = DeviceSolver(lu.numeric, solve_plan=sp).solve(d)
+    want = lu_solve(lu.numeric, d)
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
+    got_t = DeviceSolver(lu.numeric, solve_plan=sp).solve_trans(d)
+    from superlu_dist_tpu.solve.trisolve import lu_solve_trans
+    want_t = lu_solve_trans(lu.numeric, d)
+    np.testing.assert_allclose(got_t, want_t, rtol=1e-9, atol=1e-11)
+
+
+# ---------------------------------------------------------------------------
+# recursive blocked TRSM
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("lower,unit,trans", [
+    (True, True, 0), (False, False, 0), (True, True, 1), (False, False, 1),
+])
+def test_blocked_trsm_matches_unblocked(lower, unit, trans):
+    from superlu_dist_tpu.solve.device import _trsm
+    rng = np.random.default_rng(3)
+    w, B, k = 37, 4, 5          # odd width exercises uneven splits
+    a = rng.standard_normal((B, w, w))
+    tri = np.tril(a) if lower else np.triu(a)
+    tri += np.eye(w) * w        # well-conditioned diagonal
+    b = rng.standard_normal((B, w, k))
+    want = np.asarray(_trsm(tri, b, lower, unit, trans, leaf=0))
+    got = np.asarray(_trsm(tri, b, lower, unit, trans, leaf=8))
+    np.testing.assert_allclose(got, want, rtol=1e-11, atol=1e-13)
+
+
+def test_blocked_trsm_leaf_knob_changes_nothing_numerically():
+    """End-to-end: a solver with deep TRSM recursion agrees with the
+    unblocked one to f64 tightness (wide supernodes force w past the
+    leaf)."""
+    from superlu_dist_tpu.solve.device import DeviceSolver
+    from superlu_dist_tpu.solve.trisolve import lu_solve
+    a = poisson2d(14)
+    lu = _factor(a)             # default max_supernode=256 -> wide root
+    d = np.random.default_rng(13).standard_normal((a.n_rows, 2))
+    want = lu_solve(lu.numeric, d)
+    for leaf in (0, 8, 64):
+        got = DeviceSolver(lu.numeric, trsm_leaf=leaf).solve(d)
+        np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-11)
