@@ -1,0 +1,176 @@
+#!/usr/bin/env python
+"""Serve-robustness gate: the serving tier's survival kit must contain
+blast radii exactly.
+
+Two phases on a small Poisson system (CPU, a few seconds):
+
+1. **Poisoned-column isolation** — one NaN column injected into a
+   coalesced 64-column backlog: EXACTLY one ticket errors (with a
+   structured ``ServePoisonedError`` naming its request-relative
+   column) and every survivor's X is BITWISE identical to an
+   uninjected run of the same backlog — per-column independence of the
+   batched sweeps, preserved by the isolation path re-serving healthy
+   columns at the original batch width.
+
+2. **Overload storm** — a server with a small column cap and armed
+   per-request deadlines is hammered by concurrent submitters: the
+   shed count must be positive (admission control actually engaged),
+   the queue must stay bounded by the cap, every ticket must resolve
+   to a result or a structured error (no waiter hangs — the
+   submit/close storm regression), and the server must close cleanly.
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
+entry point).  Gate contract (shared with the other gates): any
+regression — a second ticket failing, a survivor drifting bitwise, a
+hang, an unbounded queue — raises/asserts, which exits non-zero with
+the diagnostic on stderr.
+"""
+
+import os
+import sys
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _factored(a):
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.utils.options import IterRefine, Options
+
+    x, lu, stats, info = gssvx(Options(iter_refine=IterRefine.NOREFINE),
+                               a, np.ones(a.n_rows))
+    assert info == 0, f"factorization failed: info={info}"
+    return lu
+
+
+def _serve_backlog(srv, cols, timeout=120):
+    tickets = [srv.submit(c) for c in cols]
+    srv.start()
+    srv.flush()
+    out = []
+    for t in tickets:
+        try:
+            out.append(("ok", t.result(timeout)))
+        except Exception as e:          # noqa: BLE001
+            out.append(("err", e))
+    srv.close()
+    return out
+
+
+def check_poison_isolation(a, lu, bs):
+    from superlu_dist_tpu.serve import ServePoisonedError, SolveServer
+
+    clean = SolveServer(lu, start=False)
+    ref = _serve_backlog(clean, [bs[:, j] for j in range(64)])
+    assert all(k == "ok" for k, _ in ref), "clean backlog failed"
+    assert clean.stats()["batches"] == 1, (
+        f"backlog did not coalesce into one micro-batch "
+        f"({clean.stats()['batches']} batches)")
+
+    bp = bs.copy()
+    bp[:, 17] = np.nan
+    pois = SolveServer(lu, start=False)
+    got = _serve_backlog(pois, [bp[:, j] for j in range(64)])
+    errs = [j for j, (k, _) in enumerate(got) if k == "err"]
+    assert errs == [17], (
+        f"exactly ticket 17 must error, got error tickets {errs}")
+    err = got[17][1]
+    assert isinstance(err, ServePoisonedError), type(err).__name__
+    assert err.columns == [0], err.columns
+    drifted = [j for j in range(64) if j != 17
+               and not np.array_equal(got[j][1], ref[j][1])]
+    assert not drifted, (
+        f"survivor ticket(s) {drifted} are not bitwise identical to the "
+        "uninjected run")
+    assert pois.stats()["poisoned_columns"] == 1
+    print("  poison-isolation: 1/64 tickets errored, 63 survivors "
+          "bitwise identical")
+
+
+def check_overload_storm(a, lu, bs):
+    from superlu_dist_tpu.serve import (ServeDeadlineError,
+                                        ServeOverloadError,
+                                        ServerClosedError, SolveServer)
+
+    srv = SolveServer(lu, queue_max=16, deadline_s=0.25, max_wait_s=0.001)
+    outcomes = []
+    lock = threading.Lock()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        for _ in range(10):
+            # a burst of wide requests in flight at once — the storm
+            # shape that actually pressures the 16-column cap
+            burst = []
+            for _ in range(3):
+                j = int(rng.integers(0, bs.shape[1] - 4))
+                try:
+                    burst.append(srv.submit(bs[:, j:j + 4]))
+                except ServeOverloadError:
+                    with lock:
+                        outcomes.append("shed")
+                except ServerClosedError:
+                    with lock:
+                        outcomes.append("closed")
+            with lock:
+                depth = srv.stats()["queue_depth"]
+                assert depth <= 16, f"queue grew past its cap: {depth}"
+            for t in burst:
+                try:
+                    t.result(30)
+                    tag = "ok"
+                except ServeDeadlineError:
+                    tag = "deadline"
+                except ServerClosedError:
+                    tag = "closed"
+                except TimeoutError:
+                    tag = "HANG"
+                with lock:
+                    outcomes.append(tag)
+
+    threads = [threading.Thread(target=client, args=(s,))
+               for s in range(8)]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+        assert not t.is_alive(), "storm client hung"
+    srv.close(timeout=60)
+    wall = time.perf_counter() - t0
+    st = srv.stats()
+    assert "HANG" not in outcomes, "a ticket neither resolved nor erred"
+    assert st["shed"] > 0, (
+        "the storm never tripped admission control — the gate is not "
+        f"exercising overload (outcomes: {outcomes})")
+    assert outcomes.count("ok") > 0, "no request was served at all"
+    assert st["queue_depth"] == 0, "queue not drained at close"
+    print(f"  overload-storm: {outcomes.count('ok')} served, "
+          f"{st['shed']} shed, {st['deadline_miss']} deadline misses, "
+          f"{wall:.1f}s wall, queue bounded at {srv.queue_max}")
+
+
+def main():
+    from superlu_dist_tpu.models.gallery import poisson2d
+
+    a = poisson2d(10)
+    lu = _factored(a)
+    rng = np.random.default_rng(3)
+    xs = rng.standard_normal((a.n_rows, 64))
+    bs = np.stack([a.matvec(xs[:, j]) for j in range(64)], axis=1)
+
+    print("serve-robust gate: poisoned-column isolation")
+    check_poison_isolation(a, lu, bs)
+    print("serve-robust gate: overload storm")
+    check_overload_storm(a, lu, bs)
+    print("serve-robust gate: OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,12 @@
 #                   solve: fused vs streamed bitwise-identical, sweep
 #                   schedules agree, device vs host solve within f64
 #                   tightness, nrhs padding reported honestly
+#   serve-robust    scripts/check_serve_robust.py     SolveServer
+#                   reliability: a poisoned column in a 64-column
+#                   backlog fails exactly its own ticket (survivors
+#                   bitwise vs a clean run), and an overload storm
+#                   against a bounded queue sheds with structured
+#                   errors instead of hanging
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -54,12 +60,13 @@ declare -A GATES=(
   [verify-overhead]="python scripts/check_verify_overhead.py"
   [schedule-equiv]="python scripts/check_schedule_equiv.py"
   [solve-equiv]="python scripts/check_solve_equiv.py"
+  [serve-robust]="python scripts/check_serve_robust.py"
   [perf-regress]="python scripts/check_perf_regress.py"
   [crash-resume]="python scripts/check_crash_resume.py"
   [rank-failure]="python scripts/check_rank_failure.py"
 )
-ORDER=(slulint verify-overhead schedule-equiv solve-equiv crash-resume
-       rank-failure trace-overhead nan-guards perf-regress)
+ORDER=(slulint verify-overhead schedule-equiv solve-equiv serve-robust
+       crash-resume rank-failure trace-overhead nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

@@ -250,6 +250,43 @@ def values_digest(pattern_values, dtype, thresh) -> str:
     return h.hexdigest()
 
 
+def front_digest(arr) -> str:
+    """sha256 of one front panel's canonical ``.npy`` payload — the SAME
+    digest ``save_lu`` records in a bundle manifest, computable from a
+    live (device-resident) panel stack via one D2H pull.  This is the
+    unit the serving tier's factor-integrity scrubber compares
+    (serve/server.py ``scrub_now``): byte-for-byte, so any bit flip in
+    the resident factors — not just NaN-producing ones — mismatches."""
+    return _sha256(_npy_bytes(np.asarray(arr)))
+
+
+def front_digests(fronts) -> list:
+    """Per-front ``(sha256_L, sha256_U)`` digests of a live handle's
+    panel stacks, in group order — the construction-time ground truth
+    for scrubbing a handle that was never persisted."""
+    return [(front_digest(lp), front_digest(up)) for lp, up in fronts]
+
+
+def bundle_front_digests(dirpath: str) -> list:
+    """Per-front ``(sha256_L, sha256_U)`` digests straight from a
+    persisted LU bundle's manifest — no array reads, no digest work:
+    the DURABLE ground truth a scrubber verifies resident factors
+    against (a corrupted manifest already fails ``read_manifest``)."""
+    doc = read_manifest(dirpath, kind="lu_handle")
+    ent = doc["arrays"]
+    out = []
+    for g in range(int(doc["meta"]["n_groups"])):
+        try:
+            out.append((ent[f"front_{g:05d}_l"]["sha256"],
+                        ent[f"front_{g:05d}_u"]["sha256"]))
+        except KeyError:
+            raise CheckpointCorruptError(
+                f"bundle at {dirpath!r} is missing the manifest entry "
+                f"for front group {g} — cannot establish a scrub "
+                "baseline")
+    return out
+
+
 # ---------------------------------------------------------------------------
 # LU handle save / load
 # ---------------------------------------------------------------------------

@@ -53,6 +53,53 @@ def _normalize_correction(dx, n: int, ncols: int) -> np.ndarray:
     return dx
 
 
+def request_berrs(a: SparseCSR, b: np.ndarray, x: np.ndarray,
+                  residual_dtype=np.float64) -> np.ndarray:
+    """Per-column componentwise backward errors of x against A·x = b —
+    the quality probe the serving tier's BERR gate runs on every
+    micro-batch (serve/server.py, ``SLU_TPU_SERVE_BERR_MAX``).  One
+    batched SpMV pair for the whole batch; columns are independent, so
+    one ticket's berr never reflects a neighbor's right-hand side."""
+    b2 = b[:, None] if b.ndim == 1 else b
+    x2 = x[:, None] if x.ndim == 1 else x
+    r = (b2 - a.matvec(x2)).astype(np.promote_types(b2.dtype,
+                                                    residual_dtype))
+    out = np.empty(b2.shape[1])
+    for k in range(b2.shape[1]):
+        den = a.abs_matvec(np.abs(x2[:, k])) + np.abs(b2[:, k])
+        out[k] = componentwise_berr(r[:, k], den.real, a.nnz,
+                                    residual_dtype)
+    return out
+
+
+def refine_ticket(a: SparseCSR, b: np.ndarray, x: np.ndarray, solve_fn,
+                  berr_target: float, itmax: int = ITMAX,
+                  residual_dtype=np.float64):
+    """Per-ticket IR rung for the serving tier: refine ONE request's
+    columns through the factored solve until its componentwise berr
+    meets ``berr_target`` (or IR's own stopping rules fire), without
+    touching any other ticket of the micro-batch — the per-request
+    analog of the PR 1 escalation ladder's residual-precision rung.
+
+    Returns ``(x_out, berr_before, berr_after, adopted)``.  The ladder's
+    adoption discipline applies: the refined iterate is returned only
+    when it strictly improved the worst column's berr; otherwise the
+    original x comes back unchanged (``adopted=False``) so a
+    non-converging refinement can never make a served answer worse."""
+    berr_before = float(request_berrs(a, b, x,
+                                      residual_dtype=residual_dtype).max())
+    if berr_before <= berr_target:
+        return x, berr_before, berr_before, False
+    x_ref, _hist = iterative_refinement(a, b, x, solve_fn, itmax=itmax,
+                                        residual_dtype=residual_dtype)
+    x_ref = np.asarray(x_ref).astype(np.asarray(x).dtype, copy=False)
+    berr_after = float(request_berrs(a, b, x_ref,
+                                     residual_dtype=residual_dtype).max())
+    if berr_after < berr_before:
+        return x_ref, berr_before, berr_after, True
+    return x, berr_before, berr_before, False
+
+
 def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
                          solve_fn, itmax: int = ITMAX,
                          residual_dtype=np.float64):

@@ -1,2 +1,5 @@
 from superlu_dist_tpu.serve.server import (   # noqa: F401
-    ServerClosedError, SolveServer, SolveTicket)
+    SolveServer, SolveTicket)
+from superlu_dist_tpu.utils.errors import (   # noqa: F401
+    FactorCorruptError, ServeDeadlineError, ServeOverloadError,
+    ServePoisonedError, ServerClosedError)

@@ -483,6 +483,7 @@ class DeviceSolver:
                  "solve_flops": structural, "executed_flops": executed,
                  "padding_factor": round(executed / max(structural, 1.0),
                                          4)}
+        nonfinite_cols: list = []
         out = np.empty((self.n, k), dtype=dt)
         # compile census: new sweep-kernel closures (streamed lru misses
         # or fresh fused programs) mean this call compiles — time the
@@ -528,6 +529,15 @@ class DeviceSolver:
                                                            :hi - lo]
                 d2h_s += time.perf_counter() - t0
                 d2h_bytes += int(res.nbytes)
+                # per-column finiteness probe on the sweep output: the
+                # serving tier's poisoned-request isolation needs to
+                # know WHICH columns broke, not just that one did (one
+                # all-reduce pass when healthy, per-column only on the
+                # failure path)
+                if not np.isfinite(res).all():
+                    fin = np.isfinite(res).all(axis=0)
+                    nonfinite_cols.extend(
+                        int(lo + j) for j in np.nonzero(~fin)[0])
                 out[:, lo:hi] = res
             builds = (_sweep_kernel_builds() + len(self._fused_cache)
                       - builds0)
@@ -544,6 +554,11 @@ class DeviceSolver:
                 tracer.complete("solve-d2h", "comm",
                                 time.perf_counter() - d2h_s, d2h_s,
                                 op="d2h", bytes=d2h_bytes)
+        stats["finite"] = not nonfinite_cols
+        stats["nonfinite_cols"] = nonfinite_cols
+        if nonfinite_cols and tracer.enabled:
+            tracer.complete("solve-probe", "verify", time.perf_counter(),
+                            0.0, nonfinite=len(nonfinite_cols))
         self.last_solve_stats = stats
         return out[:, 0] if squeeze else out
 

@@ -25,6 +25,15 @@ Enable in a victim process via the registered env knob::
                                               # collective: slow, NOT dead
                                               # — the detector must not
                                               # declare it failed
+    SLU_TPU_CHAOS='poison_rhs=17'           # NaN the 17th column ever
+                                              # submitted to a SolveServer
+                                              # (poisoned-request domain)
+    SLU_TPU_CHAOS='slow_client=2,secs=1'    # the 2nd ticket's client
+                                              # stalls 1 s before collecting
+                                              # (never-collecting client)
+    SLU_TPU_CHAOS='corrupt_panel=0'         # flip a byte in front group
+                                              # 0's resident panel stack —
+                                              # the scrubber must catch it
 
 The factor path consults :func:`get_chaos` once per factorization
 (numeric/factor.py) and the streamed executor calls
@@ -80,18 +89,30 @@ class ChaosPlan:
     stall_rank: int = -1      # this rank sleeps `secs` before a
     secs: float = 0.0         # collective — slow-NOT-dead injection
     stall_op: int = 1         # ...before this public collective
-    epoch: int = 0            # comm injections fire only in this
+    epoch: int = 0            # comm/serve injections fire only in this
                               # TreeComm epoch (so a shrunken/respawned
                               # recovery epoch is not re-injected)
+    # ---- serving-tier domain (ISSUE 10) -------------------------------
+    poison_rhs: int = -1      # NaN the Cth SUBMITTED column (global
+                              # column counter across all submits)
+    slow_client: int = -1     # the Tth submitted ticket's client never
+                              # collects (result() stalls `secs` first)
+    corrupt_panel: int = -1   # flip one byte of front group F's
+                              # resident L stack before the next scrub
 
     @property
     def armed(self) -> bool:
         return (self.kill_group >= 0 or self.nan_supernode >= 0
-                or self.comm_armed)
+                or self.comm_armed or self.serve_armed)
 
     @property
     def comm_armed(self) -> bool:
         return self.kill_op >= 0 or self.stall_rank >= 0
+
+    @property
+    def serve_armed(self) -> bool:
+        return (self.poison_rhs >= 0 or self.slow_client >= 0
+                or self.corrupt_panel >= 0)
 
 
 def parse_chaos_spec(spec: str) -> ChaosPlan:
@@ -113,7 +134,8 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
             if at:
                 plan.kill_group = int(group)
         elif key in ("kill_group", "nan_supernode", "kill_op",
-                     "stall_rank", "stall_op", "epoch"):
+                     "stall_rank", "stall_op", "epoch", "poison_rhs",
+                     "slow_client", "corrupt_panel"):
             setattr(plan, key, int(val))
         elif key == "secs":
             plan.secs = float(val)
@@ -148,6 +170,7 @@ class ChaosMonkey:
         self.plan = plan
         self.groups_seen = 0
         self._stalled = False
+        self._panel_corrupted = False
 
     def _kill_self(self) -> None:
         sig = (signal.SIGTERM if self.plan.signal == "term"
@@ -196,6 +219,59 @@ class ChaosMonkey:
             import time
             time.sleep(p.secs)
 
+    # ---- serving-tier domain (SolveServer hooks) ------------------------
+    def _serve_epoch_ok(self) -> bool:
+        # serve injections are epoch-scoped like the comm ones: a server
+        # rebuilt inside a recovery epoch is never re-injected
+        return _BOUND["epoch"] == self.plan.epoch
+
+    def poison_submit(self, b2: np.ndarray, col0: int) -> np.ndarray:
+        """``poison_rhs=C``: if the Cth globally-submitted column falls
+        in this request's ``[col0, col0+k)`` range, return a COPY with
+        that column NaN'd — the poisoned-request domain the isolation
+        path must confine to one ticket.  No-op (same array) otherwise."""
+        c = self.plan.poison_rhs
+        if c < 0 or not self._serve_epoch_ok():
+            return b2
+        if not (col0 <= c < col0 + b2.shape[1]):
+            return b2
+        out = np.array(b2, copy=True)
+        out[:, c - col0] = np.nan
+        return out
+
+    def is_slow_client(self, ticket_index: int) -> bool:
+        """``slow_client=T``: the Tth submitted ticket's client never
+        collects promptly — its ``result()`` stalls ``secs`` first (the
+        served answer must survive uncollected; the server must never
+        block on it)."""
+        return (self.plan.slow_client == ticket_index
+                and self._serve_epoch_ok())
+
+    def corrupt_resident_panel(self, fronts) -> int:
+        """``corrupt_panel=F``: flip one byte in front group F's
+        resident L panel stack (in-place in the fronts list — the
+        handle now SERVES from the corrupted stack), modeling the
+        silent HBM/DRAM bit rot the integrity scrubber exists to catch.
+        Fires once; returns the corrupted group index or -1."""
+        f = self.plan.corrupt_panel
+        if f < 0 or self._panel_corrupted or not self._serve_epoch_ok():
+            return -1
+        if not (0 <= f < len(fronts)):
+            raise ValueError(
+                f"chaos corrupt_panel={f}: handle has only "
+                f"{len(fronts)} front groups")
+        lp, up = fronts[f]
+        was_np = isinstance(lp, np.ndarray)
+        buf = np.array(np.asarray(lp), copy=True)
+        raw = buf.view(np.uint8).reshape(-1)
+        raw[len(raw) // 2] ^= 0xFF          # deterministic single flip
+        if not was_np:
+            import jax.numpy as jnp
+            buf = jnp.asarray(buf)
+        fronts[f] = (buf, up)
+        self._panel_corrupted = True
+        return f
+
     # ---- numeric-poison domain -----------------------------------------
     def poke_nan(self, plan, pattern_values: np.ndarray) -> np.ndarray:
         """Poison supernode ``nan_supernode``: NaN one A-entry that
@@ -236,6 +312,17 @@ def get_comm_chaos() -> ChaosMonkey | None:
     stays one ``is None`` test on the production path."""
     monkey = get_chaos()
     if monkey is None or not monkey.plan.comm_armed:
+        return None
+    return monkey
+
+
+def get_serve_chaos() -> ChaosMonkey | None:
+    """Serving-tier injector for SolveServer (poison_rhs / slow_client /
+    corrupt_panel specs).  Consulted ONCE at server construction — a
+    server's lifetime is the run — and None unless a serve injection is
+    armed, so submit/scrub hooks stay one ``is None`` test."""
+    monkey = get_chaos()
+    if monkey is None or not monkey.plan.serve_armed:
         return None
     return monkey
 

@@ -176,6 +176,109 @@ class RankFailureError(SuperLUError):
         _flight_dump(self)
 
 
+class ServerClosedError(SuperLUError):
+    """A ``SolveServer`` request could not be served because the server
+    closed: either ``submit()`` was called after ``close()`` (the request
+    was never enqueued), or the request was still queued/undelivered when
+    the server shut down — ``close()`` delivers this to every undelivered
+    ticket deterministically, so a waiter can never hang on a server that
+    no longer exists (serve/server.py)."""
+
+
+class ServeOverloadError(SuperLUError):
+    """Admission control shed this request: accepting its columns would
+    push the pending queue past ``SLU_TPU_SERVE_QUEUE_MAX``, or the
+    server is in drain mode and rejects new work.  Raised AT SUBMIT —
+    the request never queues, so an overload degrades into fast
+    structured rejections instead of an unbounded queue whose every
+    entry eventually misses its deadline (docs/SERVING.md failure-domain
+    matrix).  Retry with backoff, route to another replica, or widen the
+    cap."""
+
+    def __init__(self, columns: int, pending_cols: int, queue_max: int,
+                 reason: str = "queue_full"):
+        self.columns = int(columns)
+        self.pending_cols = int(pending_cols)
+        self.queue_max = int(queue_max)
+        self.reason = reason
+        why = ("server is draining (finishing in-flight work, rejecting "
+               "new requests)" if reason == "draining" else
+               f"queue holds {pending_cols} columns of a "
+               f"{queue_max}-column cap")
+        super().__init__(
+            f"solve request ({columns} column(s)) shed by admission "
+            f"control: {why}")
+
+
+class ServeDeadlineError(SuperLUError):
+    """The request's serving deadline (``SLU_TPU_SERVE_DEADLINE_MS``)
+    expired while its columns were still queued — the dispatcher (or the
+    waiting ticket itself, when the dispatcher is stalled) expired it
+    instead of serving an answer the caller has already abandoned.
+    Expired work is removed from the queue, so a backlog of dead
+    requests cannot starve live ones."""
+
+    def __init__(self, deadline_s: float, waited_s: float, columns: int):
+        self.deadline_s = float(deadline_s)
+        self.waited_s = float(waited_s)
+        self.columns = int(columns)
+        super().__init__(
+            f"solve request ({columns} column(s)) missed its "
+            f"{deadline_s:.3f}s serving deadline after {waited_s:.3f}s "
+            "in queue (shed, not served)")
+
+
+class ServePoisonedError(SuperLUError):
+    """THIS request poisoned (or was poisoned inside) a serving
+    micro-batch: its column(s) produced non-finite results, or the batch
+    solve raised ``NumericBreakdownError`` and bisection pinned the
+    blame on them.  The healthy neighbors of the same micro-batch were
+    isolated and served bit-identically to an unpoisoned run — one bad
+    right-hand side costs only its own ticket (serve/server.py,
+    ``_isolate``).  ``columns`` are request-relative 0-based column
+    indices.  Dumps a flight-recorder postmortem at construction."""
+
+    def __init__(self, columns, batch_columns: int = 0, where: str = ""):
+        self.columns = sorted(int(c) for c in columns)
+        self.batch_columns = int(batch_columns)
+        self.where = where
+        stage = f" during {where}" if where else ""
+        batch = (f" of a {batch_columns}-column micro-batch"
+                 if batch_columns else "")
+        super().__init__(
+            f"request column(s) {','.join(map(str, self.columns))} "
+            f"poisoned the solve{stage}{batch}: non-finite results "
+            "isolated to this ticket (healthy neighbors were re-served "
+            "unaffected)")
+        _flight_dump(self)
+
+
+class FactorCorruptError(SuperLUError):
+    """The factor-integrity scrubber (``SLU_TPU_SERVE_SCRUB_S``)
+    re-hashed the handle's resident panel stacks and found front
+    group(s) whose sha256 digest no longer matches the persist-bundle
+    (or construction-time) ground truth — silent data corruption in the
+    factors.  The handle is QUARANTINED: every queued and future request
+    fails with this error instead of being served garbage X, until
+    ``server.swap()`` installs a fresh handle.  Dumps a flight-recorder
+    postmortem at construction (``dump=False`` for the per-submit
+    re-raises of an already-reported quarantine)."""
+
+    def __init__(self, groups, source: str = "", dump: bool = True):
+        self.groups = sorted(int(g) for g in groups)
+        self.source = source
+        src = f" (digest baseline: {source})" if source else ""
+        super().__init__(
+            f"factor integrity scrub failed: front group(s) "
+            f"{','.join(map(str, self.groups))} no longer match their "
+            f"sha256 digests{src} — handle quarantined; swap in a fresh "
+            "factorization (server.swap) instead of serving corrupt X")
+        if dump:
+            _flight_dump(self)
+        else:
+            self.flightrec_dump = None
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

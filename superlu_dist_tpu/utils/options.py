@@ -124,6 +124,25 @@ def _register_all() -> None:
       "SolveServer coalescing window: how long the dispatcher holds the "
       "oldest pending request open for co-batching before dispatching",
       group="serve")
+    r("SLU_TPU_SERVE_QUEUE_MAX", "int", 0,
+      "SolveServer admission cap in pending COLUMNS: a submit that "
+      "would exceed it is shed with ServeOverloadError instead of "
+      "queueing (0 = unbounded, the legacy behavior)", group="serve")
+    r("SLU_TPU_SERVE_DEADLINE_MS", "float", 0.0,
+      "per-request serving deadline: columns still queued past it are "
+      "expired with ServeDeadlineError and removed from the queue "
+      "(0 = off)", group="serve")
+    r("SLU_TPU_SERVE_BERR_MAX", "float", 0.0,
+      "per-request componentwise-berr quality gate: a served ticket "
+      "whose berr exceeds it is routed through a per-ticket iterative-"
+      "refinement rung (refine/ir.refine_ticket) before delivery "
+      "(0 = off; needs the original matrix on the handle)",
+      group="serve")
+    r("SLU_TPU_SERVE_SCRUB_S", "float", 0.0,
+      "factor-integrity scrub period: a background thread re-hashes "
+      "the handle's panel stacks against their persist-bundle sha256 "
+      "digests every this-many seconds, quarantining the handle with "
+      "FactorCorruptError on mismatch (0 = off)", group="serve")
     r("SLU_TPU_POOL_PARTITION", "flag", False,
       "shard the Schur update pool across all mesh devices", group="numeric")
     # --- distributed tier --------------------------------------------------

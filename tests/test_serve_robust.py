@@ -1,0 +1,617 @@
+"""Serving-tier survival kit (serve/server.py reliability layer):
+admission control / load shedding, per-request deadlines under a
+stalled dispatcher, poisoned-request isolation (bitwise-preserving),
+the per-ticket BERR gate, hot handle swap under traffic, factor-
+integrity scrubbing with quarantine, drain semantics, and the
+deterministic ServerClosedError delivery at close."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import gssvx
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.serve import (FactorCorruptError, ServeDeadlineError,
+                                    ServeOverloadError, ServePoisonedError,
+                                    ServerClosedError, SolveServer)
+from superlu_dist_tpu.utils.errors import NumericBreakdownError
+from superlu_dist_tpu.utils.options import IterRefine, Options
+
+pytestmark = pytest.mark.serve
+
+
+@pytest.fixture(scope="module")
+def factored():
+    a = poisson2d(10)
+    rng = np.random.default_rng(0)
+    xs = rng.standard_normal((a.n_rows, 70))
+    bs = np.stack([a.matvec(xs[:, j]) for j in range(70)], axis=1)
+    x, lu, stats, info = gssvx(
+        Options(iter_refine=IterRefine.NOREFINE), a, bs[:, 0])
+    assert info == 0
+    return a, lu, bs, xs
+
+
+def _refactor(a):
+    b = a.matvec(np.ones(a.n_rows))
+    x, lu, stats, info = gssvx(
+        Options(iter_refine=IterRefine.NOREFINE), a, b)
+    assert info == 0
+    return lu
+
+
+# ---------------------------------------------------------------------------
+# admission control / shedding
+# ---------------------------------------------------------------------------
+
+def test_shed_at_queue_cap(factored):
+    """A submit that would exceed SLU_TPU_SERVE_QUEUE_MAX columns is
+    shed with a structured ServeOverloadError — it never queues, and
+    already-admitted work still completes."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(lu, queue_max=4, start=False)
+    t1 = srv.submit(bs[:, :3])
+    with pytest.raises(ServeOverloadError) as ei:
+        srv.submit(bs[:, 3:6])          # 3 + 3 > 4
+    assert ei.value.pending_cols == 3 and ei.value.queue_max == 4
+    assert ei.value.reason == "queue_full"
+    t2 = srv.submit(bs[:, 3])           # one more column still fits
+    srv.start()
+    np.testing.assert_allclose(t1.result(60), xs[:, :3],
+                               rtol=1e-7, atol=1e-9)
+    np.testing.assert_allclose(t2.result(60), xs[:, 3],
+                               rtol=1e-7, atol=1e-9)
+    st = srv.stats()
+    assert st["shed"] == 1 and st["queue_depth"] == 0
+    srv.close()
+
+
+def test_shed_metric_and_env_knob(factored, monkeypatch):
+    from superlu_dist_tpu.obs import metrics as metrics_mod
+    a, lu, bs, xs = factored
+    monkeypatch.setenv("SLU_TPU_SERVE_QUEUE_MAX", "2")
+    m = metrics_mod.Metrics()
+    prev = metrics_mod.install(m)
+    try:
+        srv = SolveServer(lu, start=False)
+        assert srv.queue_max == 2
+        srv.submit(bs[:, 0])
+        with pytest.raises(ServeOverloadError):
+            srv.submit(bs[:, 1:3])
+        srv.start()
+        srv.close()
+    finally:
+        metrics_mod.install(prev)
+    snap = m.snapshot()
+    assert snap["counters"].get(
+        'slu_serve_shed_total{reason="queue_full"}') == 1.0
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+def test_deadline_expiry_under_stalled_dispatcher(factored):
+    """With the dispatcher stalled (never started), an armed per-request
+    deadline surfaces as ServeDeadlineError at the deadline — the waiter
+    itself expires the request instead of hanging to its timeout."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(lu, deadline_s=0.08, start=False)
+    t = srv.submit(bs[:, 0])
+    t0 = time.perf_counter()
+    with pytest.raises(ServeDeadlineError) as ei:
+        t.result(10)
+    waited = time.perf_counter() - t0
+    assert waited < 5.0, "expiry must come from the deadline, not timeout"
+    assert ei.value.waited_s >= 0.08 and ei.value.columns == 1
+    assert srv.stats()["deadline_miss"] == 1
+    assert srv.stats()["queue_depth"] == 0    # expired work left the queue
+    srv.close()
+
+
+def test_dispatcher_expires_stale_requests_before_batching(factored):
+    """The dispatcher sweeps expired requests out of the queue before
+    carving a batch: a dead backlog never reaches the solver, live
+    requests still do."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(lu, deadline_s=0.05, start=False)
+    dead = [srv.submit(bs[:, j]) for j in range(3)]
+    time.sleep(0.12)                    # all three expire while stalled
+    srv.start()
+    live = srv.submit(bs[:, 3])         # fresh deadline, dispatcher live
+    np.testing.assert_allclose(live.result(60), xs[:, 3],
+                               rtol=1e-7, atol=1e-9)
+    for t in dead:
+        with pytest.raises(ServeDeadlineError):
+            t.result(10)
+    assert srv.stats()["deadline_miss"] == 3
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# poisoned-request isolation
+# ---------------------------------------------------------------------------
+
+def _serve_all(srv, cols):
+    tickets = [srv.submit(c) for c in cols]
+    srv.start()
+    srv.flush()
+    out = []
+    for t in tickets:
+        try:
+            out.append(("ok", t.result(120)))
+        except Exception as e:          # noqa: BLE001
+            out.append(("err", e))
+    return out
+
+
+def test_poisoned_column_isolates_bitwise(factored):
+    """One NaN column inside a coalesced 64-column micro-batch: exactly
+    that ticket fails with ServePoisonedError naming its column, and
+    every neighbor's X is BITWISE identical to an unpoisoned run."""
+    a, lu, bs, xs = factored
+    clean = SolveServer(lu, start=False)
+    ref = _serve_all(clean, [bs[:, j] for j in range(64)])
+    clean.close()
+    assert all(kind == "ok" for kind, _ in ref)
+    assert clean.stats()["batches"] == 1
+
+    bp = bs.copy()
+    bp[:, 17] = np.nan
+    pois = SolveServer(lu, start=False)
+    got = _serve_all(pois, [bp[:, j] for j in range(64)])
+    assert pois.stats()["batches"] >= 1
+    for j, (kind, val) in enumerate(got):
+        if j == 17:
+            assert kind == "err" and isinstance(val, ServePoisonedError)
+            assert val.columns == [0]       # request-relative
+            assert val.flightrec_dump is None  # flightrec off here
+        else:
+            assert kind == "ok"
+            assert np.array_equal(val, ref[j][1]), \
+                f"neighbor column {j} drifted"
+    assert pois.stats()["poisoned_columns"] == 1
+    pois.close()
+
+
+def test_poisoned_columns_inside_wide_request(factored):
+    """A multi-column request with one bad column fails alone, naming
+    its request-relative column; the batch's other requests survive."""
+    a, lu, bs, xs = factored
+    wide = bs[:, :5].copy()
+    wide[:, 3] = np.inf
+    srv = SolveServer(lu, start=False)
+    got = _serve_all(srv, [wide, bs[:, 10], bs[:, 11]])
+    kind, err = got[0]
+    assert kind == "err" and isinstance(err, ServePoisonedError)
+    assert err.columns == [3]
+    for (kind, val), j in zip(got[1:], (10, 11)):
+        assert kind == "ok"
+        np.testing.assert_allclose(val, xs[:, j], rtol=1e-7, atol=1e-9)
+    srv.close()
+
+
+def test_batch_raise_bisects_to_offending_ticket(factored):
+    """When the batch solve RAISES NumericBreakdownError (instead of
+    returning NaN), bisection pins the offending column and the healthy
+    tickets are re-served at the original batch width — bitwise equal
+    to an undisturbed run."""
+    a, lu, bs, xs = factored
+    clean = SolveServer(lu, start=False)
+    ref = _serve_all(clean, [bs[:, j] for j in range(8)])
+    clean.close()
+
+    srv = SolveServer(lu, start=False)
+    base = srv._solve
+
+    def strict(mat):
+        out = np.asarray(base(mat))
+        if not np.isfinite(out).all():
+            raise NumericBreakdownError(where="serve-test")
+        return out
+
+    srv._solve = strict
+    bp = [bs[:, j].copy() for j in range(8)]
+    bp[5][0] = np.nan
+    got = _serve_all(srv, bp)
+    for j, (kind, val) in enumerate(got):
+        if j == 5:
+            assert kind == "err" and isinstance(val, ServePoisonedError)
+        else:
+            assert kind == "ok" and np.array_equal(val, ref[j][1])
+    srv.close()
+
+
+def test_chaos_poison_rhs_spec(factored, monkeypatch):
+    """SLU_TPU_CHAOS=poison_rhs=C NaNs the Cth submitted column
+    deterministically — the injection drives the same isolation path."""
+    a, lu, bs, xs = factored
+    monkeypatch.setenv("SLU_TPU_CHAOS", "poison_rhs=5")
+    srv = SolveServer(lu, start=False)
+    got = _serve_all(srv, [bs[:, j] for j in range(8)])
+    bad = [j for j, (kind, _) in enumerate(got) if kind == "err"]
+    assert bad == [5]
+    assert isinstance(got[5][1], ServePoisonedError)
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# BERR gate
+# ---------------------------------------------------------------------------
+
+def test_berr_gate_escalates_one_ticket_only(factored):
+    """A ticket whose componentwise berr exceeds SLU_TPU_SERVE_BERR_MAX
+    is routed through the per-ticket IR rung; its neighbors in the same
+    micro-batch are untouched (no rung, no extra work)."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(lu, berr_max=1e-6, start=False)
+    base = srv._solve
+    state = {"fired": False}
+
+    def perturbed(mat):
+        out = np.asarray(base(mat))
+        if not state["fired"] and mat.shape[1] == 8:
+            state["fired"] = True
+            out = out.copy()
+            out[:, 2] += 1e-2           # degrade exactly ticket 2
+        return out
+
+    srv._solve = perturbed
+    tickets = [srv.submit(bs[:, j]) for j in range(8)]
+    srv.start()
+    srv.flush()
+    res = [t.result(60) for t in tickets]
+    assert state["fired"]
+    assert len(tickets[2].rungs) == 1
+    rung = tickets[2].rungs[0]
+    assert rung["rung"] == "serve-ir" and rung["adopted"]
+    assert rung["berr_before"] > 1e-6 > rung["berr_after"]
+    assert all(not t.rungs for j, t in enumerate(tickets) if j != 2), \
+        "only the degraded ticket may escalate"
+    np.testing.assert_allclose(res[2], xs[:, 2], rtol=1e-8, atol=1e-10)
+    assert srv.stats()["refined"] == 1
+    srv.close()
+
+
+def test_berr_gate_requires_matrix(factored):
+    a, lu, bs, xs = factored
+    import dataclasses
+    bare = dataclasses.replace(lu, a=None)
+    from superlu_dist_tpu.utils.errors import SuperLUError
+    with pytest.raises(SuperLUError, match="original matrix"):
+        SolveServer(bare, berr_max=1e-8, start=False)
+    # passing the matrix explicitly satisfies the gate
+    srv = SolveServer(bare, berr_max=1e-12, a=a, max_wait_s=0.0)
+    srv.solve(bs[:, 0], timeout=60)
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# hot swap
+# ---------------------------------------------------------------------------
+
+def test_hot_swap_mid_traffic_loses_nothing(factored):
+    """server.swap() under concurrent traffic: every ticket submitted
+    before, during and after the swap resolves correctly — zero lost
+    tickets — and the swap is visible in the stats."""
+    a, lu, bs, xs = factored
+    lu2 = _refactor(a)
+    srv = SolveServer(lu, max_wait_s=0.001)
+    errs, done = [], []
+    stop = threading.Event()
+
+    def client(seed):
+        rng = np.random.default_rng(seed)
+        while not stop.is_set():
+            j = int(rng.integers(0, 64))
+            try:
+                got = srv.solve(bs[:, j], timeout=60)
+                np.testing.assert_allclose(got, xs[:, j],
+                                           rtol=1e-7, atol=1e-9)
+                done.append(j)
+            except Exception as e:      # noqa: BLE001
+                errs.append(e)
+                return
+
+    ts = [threading.Thread(target=client, args=(s,)) for s in range(4)]
+    for t in ts:
+        t.start()
+    time.sleep(0.05)
+    srv.swap(lu2)
+    time.sleep(0.05)
+    stop.set()
+    for t in ts:
+        t.join(60)
+    srv.close()
+    assert not errs, errs
+    assert len(done) > 0
+    assert srv.stats()["swaps"] == 1
+    assert srv.lu is lu2
+
+
+def test_swap_validates_handle(factored):
+    a, lu, bs, xs = factored
+    from superlu_dist_tpu.utils.errors import SuperLUError
+    srv = SolveServer(lu, start=False)
+    import dataclasses
+    with pytest.raises(SuperLUError, match="FACTORED"):
+        srv.swap(dataclasses.replace(lu, numeric=None))
+    big = poisson2d(11)
+    with pytest.raises(SuperLUError, match="same-sized"):
+        srv.swap(_refactor(big))
+    srv.close()
+
+
+def test_swap_from_bundle(factored, tmp_path):
+    from superlu_dist_tpu.persist.serial import save_lu
+    a, lu, bs, xs = factored
+    d = str(tmp_path / "swap_handle")
+    save_lu(_refactor(a), d)
+    srv = SolveServer(lu, max_wait_s=0.0)
+    srv.swap(d)
+    assert srv.source == d
+    np.testing.assert_allclose(srv.solve(bs[:, 0], timeout=60), xs[:, 0],
+                               rtol=1e-7, atol=1e-9)
+    srv.close()
+
+
+# ---------------------------------------------------------------------------
+# factor-integrity scrubbing
+# ---------------------------------------------------------------------------
+
+def _flip_front_byte(numeric, g=0, off=7):
+    lp, up = numeric.fronts[g]
+    buf = np.array(np.asarray(lp), copy=True)
+    buf.view(np.uint8).reshape(-1)[off] ^= 0xFF
+    numeric.fronts[g] = (buf, up)
+
+
+def test_scrub_detects_flipped_byte_and_quarantines(factored):
+    """A single flipped byte in a resident panel stack fails the next
+    scrub: the handle quarantines (queued tickets errored, submits
+    refused) and a fresh swap() restores service."""
+    a, lu, bs, xs = factored
+    lu2 = _refactor(a)
+    srv = SolveServer(lu2, scrub_s=3600, start=False)  # baseline latched
+    assert srv.scrub_now() == []                       # clean pass
+    queued = srv.submit(bs[:, 0])
+    _flip_front_byte(srv.lu.numeric)
+    with pytest.raises(FactorCorruptError) as ei:
+        srv.scrub_now()
+    assert ei.value.groups == [0]
+    with pytest.raises(FactorCorruptError):            # queued ticket too
+        queued.result(10)
+    with pytest.raises(FactorCorruptError):            # admission refused
+        srv.submit(bs[:, 1])
+    st = srv.stats()
+    assert st["quarantined"] and st["scrub_failures"] == 1
+    assert st["scrub_runs"] == 2
+    # recovery: swap in a fresh handle, service resumes, scrub is clean
+    srv.swap(_refactor(a))
+    srv.start()
+    np.testing.assert_allclose(srv.solve(bs[:, 0], timeout=60), xs[:, 0],
+                               rtol=1e-7, atol=1e-9)
+    assert srv.scrub_now() == []
+    assert not srv.stats()["quarantined"]
+    srv.close()
+
+
+def test_scrub_baseline_from_bundle(factored, tmp_path):
+    """from_bundle servers scrub against the bundle manifest's sha256
+    digests — the durable ground truth — and a corruption of the
+    resident copy is caught even though the bundle itself is intact."""
+    from superlu_dist_tpu.persist.serial import bundle_front_digests, save_lu
+    a, lu, bs, xs = factored
+    d = str(tmp_path / "scrub_handle")
+    save_lu(_refactor(a), d)
+    srv = SolveServer.from_bundle(d, scrub_s=3600, start=False)
+    assert srv._digests == bundle_front_digests(d)
+    assert srv.scrub_now() == []
+    _flip_front_byte(srv.lu.numeric, g=1)
+    with pytest.raises(FactorCorruptError) as ei:
+        srv.scrub_now()
+    assert ei.value.groups == [1] and d in ei.value.source
+    srv.close()
+
+
+def test_chaos_corrupt_panel_spec(factored, monkeypatch):
+    """SLU_TPU_CHAOS=corrupt_panel=F flips a byte in front group F's
+    resident stack right before the scrub — the detection path end to
+    end, with the flight-recorder postmortem attached when armed."""
+    from superlu_dist_tpu.obs import flightrec
+    a, lu, bs, xs = factored
+    monkeypatch.setenv("SLU_TPU_CHAOS", "corrupt_panel=1")
+    monkeypatch.setenv("SLU_TPU_FLIGHTREC", "1")
+    flightrec._reset()
+    try:
+        srv = SolveServer(_refactor(a), scrub_s=3600, start=False)
+        with pytest.raises(FactorCorruptError) as ei:
+            srv.scrub_now()
+        assert ei.value.groups == [1]
+        assert ei.value.flightrec_dump        # postmortem dumped
+        import os
+        os.unlink(ei.value.flightrec_dump)
+        srv.close()
+    finally:
+        monkeypatch.delenv("SLU_TPU_FLIGHTREC")
+        flightrec._reset()
+
+
+def test_scrub_background_thread_runs(factored):
+    a, lu, bs, xs = factored
+    srv = SolveServer(_refactor(a), scrub_s=0.05, start=False)
+    deadline = time.perf_counter() + 10
+    while srv.stats()["scrub_runs"] < 2:
+        assert time.perf_counter() < deadline, "scrub thread never ran"
+        time.sleep(0.02)
+    srv.close()
+    assert srv.stats()["scrub_failures"] == 0
+
+
+# ---------------------------------------------------------------------------
+# drain / close semantics
+# ---------------------------------------------------------------------------
+
+def test_drain_semantics(factored):
+    """drain() finishes queued work, rejects new submissions with the
+    structured draining error, and resume() lifts it."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(lu, start=False)
+    tickets = [srv.submit(bs[:, j]) for j in range(3)]
+    srv.start()
+    assert srv.drain(timeout=60)
+    for t, j in zip(tickets, range(3)):
+        np.testing.assert_allclose(t.result(10), xs[:, j],
+                                   rtol=1e-7, atol=1e-9)
+    with pytest.raises(ServeOverloadError) as ei:
+        srv.submit(bs[:, 0])
+    assert ei.value.reason == "draining"
+    assert srv.stats()["draining"]
+    srv.resume()
+    np.testing.assert_allclose(srv.solve(bs[:, 0], timeout=60), xs[:, 0],
+                               rtol=1e-7, atol=1e-9)
+    srv.close()
+
+
+def test_close_delivers_closed_error_to_stranded_tickets(factored):
+    """The satellite bug fix: tickets that no dispatcher will ever serve
+    (never-started server) receive ServerClosedError at close() instead
+    of hanging their waiters."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(lu, start=False)
+    tickets = [srv.submit(bs[:, j]) for j in range(4)]
+    srv.close()
+    for t in tickets:
+        with pytest.raises(ServerClosedError):
+            t.result(5)
+
+
+def test_submit_close_storm_never_hangs(factored):
+    """Submit/close storm: concurrent submitters racing close() — every
+    ticket either yields a result or a structured error within a bound;
+    no waiter hangs (the close-window race regression)."""
+    a, lu, bs, xs = factored
+    for _ in range(3):                  # repeat to shake the race window
+        srv = SolveServer(lu, max_wait_s=0.0)
+        outcomes = []
+        lock = threading.Lock()
+
+        def client(seed):
+            rng = np.random.default_rng(seed)
+            for _ in range(8):
+                j = int(rng.integers(0, 16))
+                try:
+                    t = srv.submit(bs[:, j])
+                    got = t.result(30)
+                    ok = np.allclose(got, xs[:, j], rtol=1e-6, atol=1e-8)
+                    with lock:
+                        outcomes.append("ok" if ok else "WRONG")
+                except (ServerClosedError, ServeOverloadError):
+                    with lock:
+                        outcomes.append("closed")
+                except TimeoutError:
+                    with lock:
+                        outcomes.append("HANG")
+
+        ts = [threading.Thread(target=client, args=(s,))
+              for s in range(6)]
+        for t in ts:
+            t.start()
+        time.sleep(0.01)
+        srv.close()
+        for t in ts:
+            t.join(60)
+            assert not t.is_alive(), "submitter thread hung"
+        assert "HANG" not in outcomes and "WRONG" not in outcomes, outcomes
+
+
+def test_chaos_slow_client_spec(factored, monkeypatch):
+    """SLU_TPU_CHAOS=slow_client=T: the Tth ticket's client stalls
+    before collecting — the server must close without waiting on it and
+    the delivered result must outlive the server."""
+    a, lu, bs, xs = factored
+    monkeypatch.setenv("SLU_TPU_CHAOS", "slow_client=1,secs=0.2")
+    srv = SolveServer(lu, max_wait_s=0.0)
+    fast = srv.submit(bs[:, 0])
+    slow = srv.submit(bs[:, 1])         # ticket index 1: the slow one
+    np.testing.assert_allclose(fast.result(60), xs[:, 0],
+                               rtol=1e-7, atol=1e-9)
+    t0 = time.perf_counter()
+    srv.close(timeout=30)               # must not block on the collector
+    assert time.perf_counter() - t0 < 10
+    got = slow.result(60)               # stalls ~0.2 s, then delivers
+    assert time.perf_counter() - t0 >= 0.0
+    np.testing.assert_allclose(got, xs[:, 1], rtol=1e-7, atol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# metrics
+# ---------------------------------------------------------------------------
+
+def test_reliability_metrics_series(factored):
+    """The survival-kit series land in the registry: shed, deadline
+    miss, poisoned, swaps, scrub runs/failures, queue-wait histogram."""
+    from superlu_dist_tpu.obs import metrics as metrics_mod
+    a, lu, bs, xs = factored
+    m = metrics_mod.Metrics()
+    prev = metrics_mod.install(m)
+    try:
+        srv = SolveServer(_refactor(a), queue_max=2, deadline_s=0.05,
+                          scrub_s=3600, start=False)
+        srv.scrub_now()
+        srv.submit(bs[:, 0])
+        with pytest.raises(ServeOverloadError):
+            srv.submit(bs[:, 1:4])
+        time.sleep(0.1)
+        srv.start()
+        srv.flush()
+        time.sleep(0.05)
+        bp = bs[:, :2].copy()
+        bp[:, 1] = np.nan
+        t = srv.submit(bp)
+        with pytest.raises((ServePoisonedError, ServeDeadlineError)):
+            t.result(30)
+        srv.swap(_refactor(a))
+        _flip_front_byte(srv.lu.numeric)
+        with pytest.raises(FactorCorruptError):
+            srv.scrub_now()
+        srv.close()
+    finally:
+        metrics_mod.install(prev)
+    snap = m.snapshot()
+    c = snap["counters"]
+    assert c.get('slu_serve_shed_total{reason="queue_full"}') == 1.0
+    assert c.get("slu_serve_deadline_miss_total", 0) >= 1.0
+    assert c.get("slu_serve_swaps_total") == 1.0
+    assert c.get("slu_serve_scrub_runs_total") == 2.0
+    assert c.get("slu_serve_scrub_failures_total") == 1.0
+    wait = snap["histograms"].get("slu_serve_queue_wait_seconds")
+    assert wait and wait["count"] >= 1
+
+
+def test_poisoned_error_flightrec_postmortem(factored, monkeypatch):
+    """ServePoisonedError construction dumps the flight recorder — the
+    postmortem exists even when the caller swallows the error."""
+    from superlu_dist_tpu.obs import flightrec
+    a, lu, bs, xs = factored
+    monkeypatch.setenv("SLU_TPU_FLIGHTREC", "1")
+    flightrec._reset()
+    try:
+        srv = SolveServer(lu, start=False)
+        bp = bs[:, 0].copy()
+        bp[0] = np.nan
+        got = _serve_all(srv, [bp])
+        kind, err = got[0]
+        assert kind == "err" and isinstance(err, ServePoisonedError)
+        assert err.flightrec_dump
+        import json
+        import os
+        doc = json.load(open(err.flightrec_dump))
+        assert doc["reason"].startswith("ServePoisonedError")
+        os.unlink(err.flightrec_dump)
+        srv.close()
+    finally:
+        monkeypatch.delenv("SLU_TPU_FLIGHTREC")
+        flightrec._reset()
