@@ -115,6 +115,13 @@ def _watchdog():
         blk = COMPILE_STATS.block(top=16)
         RESULT.setdefault("compile_seconds", blk["seconds"])
         RESULT.setdefault("compile_census", blk["census"])
+        # a factor-compile death names the shape keys still UNCOMPILED
+        # (announced by the executor, retired per build): the census
+        # delta the next BENCH_r02-style postmortem needs to blame the
+        # offending buckets instead of just counting them
+        pending = COMPILE_STATS.pending()
+        if pending:
+            RESULT.setdefault("pending_kernels", pending)
         # durable frontier FIRST (persist/checkpoint.py): flush whatever
         # the factor loop completed, record the bundle path and its
         # resume eligibility in the row — the next BENCH run of this
@@ -297,7 +304,9 @@ def main():
               "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
               "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV",
               "SLU_TPU_SCHEDULE", "SLU_TPU_SCHED_WINDOW",
-              "SLU_TPU_SCHED_ALIGN",
+              "SLU_TPU_SCHED_ALIGN", "SLU_TPU_BUCKET_BASE",
+              "SLU_TPU_BUCKET_GROWTH", "SLU_TPU_BUCKET_CLOSED",
+              "SLU_TPU_BUCKET_KEYS", "SLU_TPU_EXECUTOR",
               # solve-kernel-set knobs (solve/plan.py): a set one means
               # a deliberate solve sweep with its own deadline discipline
               "BENCH_SOLVE_NRHS", "SLU_TPU_SOLVE_SCHEDULE",
@@ -389,7 +398,18 @@ def main():
     col_order = get_perm_c(opts, a, sym)
     sf = symbolic_factorize(sym, col_order, relax=RELAX,
                             max_supernode=MAX_SUPER, amalg_tol=AMALG)
-    plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH)
+    # executor granularity resolved BEFORE the plan: the mega executor
+    # wants the shape-key set CLOSED at plan build (numeric/plan.py —
+    # the O(1)-compiled-programs contract), which an explicit
+    # SLU_TPU_BUCKET_CLOSED setting can still override either way
+    gran = os.environ.get("BENCH_GRANULARITY",
+                          "fused" if backend == "cpu" else "group")
+    _closed = (True if gran == "mega"
+               and "SLU_TPU_BUCKET_CLOSED" not in os.environ else None)
+    plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH,
+                      closed=_closed)
+    RESULT["bucket_set_digest"] = plan.bucket_set_digest()
+    RESULT["bucket_closed"] = plan.closed
     if plan.pool_size >= 2 ** 31 and not jax.config.jax_enable_x64:
         # beyond-int32 pool (n>=~600k at f32): indices must stay int64
         # (the reference's XSDK_INDEX_SIZE=64 tier); costs some index
@@ -431,17 +451,21 @@ def main():
     from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
     _comp0 = COMPILE_STATS.marker()
     # BENCH_GRANULARITY: "group" (one kernel per shape key, streamed),
-    # "level" (one program per elimination level), or "fused" (the WHOLE
-    # factorization as one XLA program — viable again now that
-    # amalgamation leaves ~45 groups; zero dispatch overhead, XLA
-    # schedules across groups).  Default follows get_executor's "auto"
-    # rule (numeric/factor.py): fused on CPU — per-group streaming there
-    # spent 56% of factor time in Python dispatch (BENCH_r03, 0.66x
-    # scipy) while compile is cheap; group on accelerators, where
-    # per-kernel compile through the tunnel dominates instead.
-    gran = os.environ.get("BENCH_GRANULARITY",
-                          "fused" if backend == "cpu" else "group")
-    if gran == "fused":
+    # "level" (one program per elimination level), "mega" (ONE
+    # data-driven program per closed shape bucket, numeric/mega.py —
+    # the O(1)-compiled-programs executor for the TPU compile wall), or
+    # "fused" (the WHOLE factorization as one XLA program — viable
+    # again now that amalgamation leaves ~45 groups; zero dispatch
+    # overhead, XLA schedules across groups).  Default follows
+    # get_executor's "auto" rule (numeric/factor.py): fused on CPU —
+    # per-group streaming there spent 56% of factor time in Python
+    # dispatch (BENCH_r03, 0.66x scipy) while compile is cheap; group
+    # on accelerators, where per-kernel compile through the tunnel
+    # dominates instead.  (gran itself is resolved above, pre-plan.)
+    if gran == "mega":
+        from superlu_dist_tpu.numeric.mega import MegaExecutor
+        ex = MegaExecutor(plan, DTYPE)
+    elif gran == "fused":
         from superlu_dist_tpu.numeric.factor import make_factor_fn
 
         class _Fused:
@@ -472,7 +496,7 @@ def main():
     # reps below run with checkpointing disarmed: the interval flush
     # blocks the async dispatch stream and would poison the measurement.
     _ckpt = None
-    if gran == "group" and DTYPE != "bfloat16":
+    if gran in ("group", "mega") and DTYPE != "bfloat16":
         try:
             from superlu_dist_tpu.persist.checkpoint import (
                 FactorCheckpointer, load_checkpoint)
@@ -516,6 +540,18 @@ def main():
     RESULT["compile_seconds"] = _blk["seconds"]
     RESULT["compile_census"] = _blk["census"]
     RESULT["compile_persistent_hits"] = _blk["persistent_hits"]
+    # programs actually built this run (vs n_kernels = the full set)
+    RESULT["n_kernels_compiled"] = _blk["builds"]
+    # time spent on builds the persistent cache did NOT serve from disk
+    # — exactly 0 on a bucket-set warm start (the acceptance field; the
+    # plain compile_seconds keeps trace/lower/cache-load overhead)
+    RESULT["compile_fresh_seconds"] = _blk["fresh_seconds"]
+    # the mega executor AOT-stages, so the exact XLA-compile stage (the
+    # part the persistent cache eliminates) is known separately
+    _xla = sum(r.compile_seconds or 0.0
+               for r in COMPILE_STATS.records[_comp0:])
+    if _xla:
+        RESULT["xla_compile_seconds"] = round(_xla, 4)
     tracer.complete("factor-compile", "phase", t_phase,
                     time.perf_counter() - t_phase,
                     kernels=ex.n_kernels, offload=ex.offload,

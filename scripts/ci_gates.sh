@@ -39,6 +39,11 @@
 #                   bitwise vs a clean run), and an overload storm
 #                   against a bounded queue sheds with structured
 #                   errors instead of hanging
+#   compile-budget  scripts/compile_census.py --buckets  the closed
+#                   bucket set stays O(1): the mega executor's
+#                   compiled-program count must be CONSTANT across
+#                   n = 4096/32768/110592 (the BENCH_r02 compile-wall
+#                   gallery), every bucket program AOT-stageable
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -64,9 +69,11 @@ declare -A GATES=(
   [perf-regress]="python scripts/check_perf_regress.py"
   [crash-resume]="python scripts/check_crash_resume.py"
   [rank-failure]="python scripts/check_rank_failure.py"
+  [compile-budget]="python scripts/compile_census.py --buckets 16 32 48 --stage"
 )
 ORDER=(slulint verify-overhead schedule-equiv solve-equiv serve-robust
-       crash-resume rank-failure trace-overhead nan-guards perf-regress)
+       crash-resume rank-failure compile-budget trace-overhead nan-guards
+       perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

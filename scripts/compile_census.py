@@ -23,6 +23,19 @@ Usage:
       approximates with first-call wall time).  CPU compile cost ranks
       buckets the same way the TPU tunnel does, ~proportionally.
 
+  compile_census.py --buckets [NX ...] [--stage]
+      The compile-BUDGET check (ci_gates.sh gate `compile-budget`):
+      build the CLOSED bench plan (SLU_TPU_BUCKET_CLOSED semantics,
+      numeric/plan._close_shape_keys) for a gallery of poisson3d sizes
+      (default 16 32 48 — n = 4096 / 32768 / 110592, the BENCH_r02
+      acceptance ladder) and FAIL (exit 1) unless the mega executor's
+      compiled-program count is CONSTANT in n.  This is the invariant
+      that killed BENCH_r02: the streamed kernel count grew with the
+      matrix (119 kernels at n=110592) until compile time, not
+      arithmetic, was the scaling wall.  --stage additionally
+      AOT-stages (trace+lower, no backend compile) every bucket
+      program, proving the closed set is buildable.
+
 Output: per-bucket ranked table (seconds, share, builds, disk hits) and
 the totals line.  Exit 1 when no census evidence is found.
 """
@@ -226,7 +239,82 @@ def report(rows: list, staged: bool) -> int:
     return 0
 
 
+# ---------------------------------------------------------------------------
+# closed-bucket budget check (the `compile-budget` CI gate)
+# ---------------------------------------------------------------------------
+
+def bucket_budget(nxs: list, stage: bool) -> int:
+    """Closed bucket sets across a size gallery: print one line per
+    size, fail unless the mega program count is constant in n."""
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.numeric.mega import MegaExecutor, _mega_kernel
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.utils.options import Options
+
+    import numpy as np
+    import jax.numpy as jnp
+    import time
+
+    counts = {}
+    for nx in nxs:
+        t0 = time.perf_counter()
+        a = poisson3d(nx)
+        sym = symmetrize_pattern(a)
+        sf = symbolic_factorize(sym, get_perm_c(Options(), a, sym),
+                                relax=128, max_supernode=256,
+                                amalg_tol=1.05)
+        plan = build_plan(sf, min_bucket=16, growth=1.05, closed=True)
+        ex = MegaExecutor(plan, "float32")
+        staged = 0
+        if stage:
+            idt = jnp.asarray(np.zeros(0, dtype=np.int64)).dtype
+            from jax import ShapeDtypeStruct as Sds
+            f32 = jnp.dtype("float32")
+            for key in sorted({k for k, _, _, _, _ in ex._steps},
+                              key=str):
+                (b, m, w, u), la, (ns_, cm, ub), pl, av, dt = key
+                args = (Sds((av,), f32), Sds((pl,), f32), Sds((), f32),
+                        Sds((la,), idt), Sds((la,), idt),
+                        Sds((la,), idt), Sds((b,), idt), Sds((b,), idt),
+                        Sds((ns_, cm), idt), Sds((ns_, cm), idt),
+                        Sds((ns_,), idt), Sds((ns_, cm, ub), idt))
+                kern = _mega_kernel(*key, "blocked")
+                try:
+                    kern.trace(*args).lower()
+                except AttributeError:
+                    kern.lower(*args)
+                staged += 1
+        counts[nx] = ex.n_kernels
+        print(f"nx={nx:3d} n={a.n_rows:7d} groups={len(plan.groups):4d} "
+              f"mega_kernels={ex.n_kernels} "
+              f"digest={plan.bucket_set_digest()} "
+              f"staged={staged} ({time.perf_counter() - t0:.1f}s)",
+              flush=True)
+    distinct = sorted(set(counts.values()))
+    if len(distinct) != 1:
+        print(f"compile-budget: FAIL — compiled-program count is NOT "
+              f"constant in n: {counts} (the closure pass must clamp "
+              f"every gallery size to the same SLU_TPU_BUCKET_KEYS "
+              f"bucket count)", file=sys.stderr)
+        return 1
+    print(f"compile-budget: OK — {distinct[0]} programs at every "
+          f"gallery size (streamed-executor comparison: BENCH_r02 "
+          f"needed 119 at n=110592)")
+    return 0
+
+
 def main(argv) -> int:
+    if argv and argv[0] == "--buckets":
+        rest = [a for a in argv[1:] if a != "--stage"]
+        stage = "--stage" in argv[1:]
+        nxs = [int(x) for x in rest] or [16, 32, 48]
+        return bucket_budget(nxs, stage)
     if argv and argv[0] == "--live":
         nx = int(argv[1]) if len(argv) > 1 else 8
         return report(live_rows(nx), staged=True)

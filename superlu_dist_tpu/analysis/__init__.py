@@ -6,6 +6,7 @@ Rules:
   SLU103 index-width discipline   (rules_index.py, flow-based)
   SLU104 env-knob registry        (rules_env.py)
   SLU105 jit-cache-key hygiene    (rules_trace.py, call-graph-aware)
+  SLU107 jit-key shape diversity  (rules_trace.py)
   SLU106 runtime lockstep verify  (parallel/treecomm.py +
                                    numeric/stream.py retrace sentinel,
                                    env SLU_TPU_VERIFY_COLLECTIVES=1)

@@ -34,7 +34,8 @@ def _build_parser():
         description="slulint: project-native static analysis "
                     "(collective-safety SLU101, trace-purity SLU102, "
                     "index-width SLU103, env-knob registry SLU104, "
-                    "jit-cache-key hygiene SLU105; the SLU106 runtime "
+                    "jit-cache-key hygiene SLU105, jit-key shape "
+                    "diversity SLU107; the SLU106 runtime "
                     "twin lives in parallel/treecomm.py under "
                     "SLU_TPU_VERIFY_COLLECTIVES=1)")
     p.add_argument("paths", nargs="*", default=DEFAULT_PATHS,

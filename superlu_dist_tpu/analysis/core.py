@@ -177,12 +177,12 @@ PARSE_ERROR_RULE = "SLU100"
 
 def default_rules() -> list:
     from superlu_dist_tpu.analysis.rules_collective import CollectiveRule
-    from superlu_dist_tpu.analysis.rules_trace import (JitCacheKeyRule,
-                                                       TracePurityRule)
+    from superlu_dist_tpu.analysis.rules_trace import (
+        JitCacheKeyRule, JitKeyShapeDiversityRule, TracePurityRule)
     from superlu_dist_tpu.analysis.rules_index import IndexWidthRule
     from superlu_dist_tpu.analysis.rules_env import EnvKnobRule
     return [CollectiveRule(), TracePurityRule(), IndexWidthRule(),
-            EnvKnobRule(), JitCacheKeyRule()]
+            EnvKnobRule(), JitCacheKeyRule(), JitKeyShapeDiversityRule()]
 
 
 def analyze_source(source: str, path: str, rules, project=None) -> list:

@@ -1,4 +1,5 @@
-"""SLU102 trace-purity and SLU105 jit-cache-key hygiene.
+"""SLU102 trace-purity, SLU105 jit-cache-key hygiene, SLU107 jit-key
+shape diversity.
 
 SLU102 — host coercions inside jitted code.  ``float()``/``int()``/
 ``bool()``/``.item()``/``np.asarray`` on a traced value force a device
@@ -25,6 +26,23 @@ reading the env inline).  One idiom is exempt: a zero-argument
 lru_cached env reader (``ops/dense._precision``) is a read-once latched
 process constant, so baking it in without a key is sound
 (analysis/dataflow.py's ``latched_env``).
+
+SLU107 — raw (unbucketed) dimensions in jit-factory cache keys.  An
+``lru_cache``d jit factory compiles one program per distinct key, so a
+key axis fed a RAW size — ``len(x)``, ``x.shape[0]``, ``x.size`` —
+makes the compiled-program count grow with the data.  This is exactly
+the axis that produced the BENCH_r02 compile wall (119 kernels for 455
+groups at n=110592, dead in `factor-compile` before one factor FLOP):
+every distinct batch/index length minted a fresh kernel.  The fix is
+the canonical bucket ladder (``numeric/plan.bucket_rung`` /
+``stream._bucket_len``): round the size onto a rung BEFORE it enters
+the key, so shapes repeat and the program set is bounded.  Flagged: a
+call to an lru_cached jit factory (defined in the same module) whose
+argument contains ``len()``/``.shape``/``.size`` with no bucketing
+call (a name containing "bucket"/"rung"/"ladder") anywhere in the same
+argument expression.  Lexical and false-negative-leaning like every
+slulint rule; new intentional violations join the committed baseline
+(the SLU105 policy).
 """
 
 from __future__ import annotations
@@ -258,3 +276,71 @@ class JitCacheKeyRule(Rule):
                     f"`{node.id}` from an enclosing function — it shapes "
                     "the compiled kernel but is missing from the cache "
                     "key"))
+
+
+_BUCKETIZER_HINTS = ("bucket", "rung", "ladder")
+
+
+def _is_bucketized(node: ast.AST) -> bool:
+    """The expression routes through a bucketing helper somewhere
+    (bucket_rung / _bucket_len / nrhs_buckets / ladder_rungs ...)."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            name = dotted_name(sub.func).rsplit(".", 1)[-1].lower()
+            if any(h in name for h in _BUCKETIZER_HINTS):
+                return True
+    return False
+
+
+def _raw_dim(node: ast.AST):
+    """First raw-dimension read inside the expression: a len() call, a
+    .shape access, or a .size access.  Returns (label, anchor) or
+    None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call) and dotted_name(sub.func) == "len":
+            return "len(...)", sub
+        if isinstance(sub, ast.Attribute) and sub.attr in ("shape", "size") \
+                and isinstance(sub.ctx, ast.Load):
+            return f".{sub.attr}", sub
+    return None
+
+
+class JitKeyShapeDiversityRule(Rule):
+    rule_id = "SLU107"
+    title = "jit-key-shape-diversity"
+    hint = ("round raw sizes onto the canonical bucket ladder before "
+            "they enter a jit-factory cache key (numeric/plan.bucket_rung"
+            " / stream._bucket_len): a key axis fed len(x)/x.shape mints "
+            "one compiled program per distinct value — the compile-count-"
+            "grows-with-n axis that killed BENCH_r02")
+
+    def check(self, tree, source, path, project=None):
+        factories = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(_is_lru_decorator(d)
+                            for d in node.decorator_list) \
+                    and JitCacheKeyRule._contains_jit(node):
+                factories.add(node.name)
+        if not factories:
+            return []
+        findings = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = dotted_name(node.func).rsplit(".", 1)[-1]
+            if fname not in factories:
+                continue
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if _is_bucketized(arg):
+                    continue
+                raw = _raw_dim(arg)
+                if raw is not None:
+                    findings.append(self.finding(
+                        path, raw[1],
+                        f"lru_cached jit factory `{fname}` called with a "
+                        f"raw (unbucketed) dimension `{raw[0]}` — every "
+                        "distinct size compiles a fresh program, so the "
+                        "kernel count grows with the data instead of "
+                        "staying a closed bucket set"))
+        return findings

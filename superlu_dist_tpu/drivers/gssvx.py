@@ -310,7 +310,8 @@ def analyze(options: Options, a: SparseCSR,
                               growth=options.bucket_growth,
                               schedule=options.schedule,
                               window=options.sched_window,
-                              align=options.sched_align)
+                              align=options.sched_align,
+                              closed=options.bucket_closed)
         pattern_mismatch = sym.nnz != len(sf.value_perm)
         if not pattern_mismatch and reuse_symbolic:
             # nnz equality is not enough: a moved entry with equal count
@@ -395,6 +396,7 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
             numeric = numeric_factorize(
                 plan, bvals, lu.anorm, dtype=dtype,
                 replace_tiny=options.replace_tiny_pivot,
+                executor=getattr(options, "executor", "auto") or "auto",
                 mesh=grid.mesh if grid is not None else None,
                 pool_partition=options.pool_partition,
                 check_finite=options.recovery.sentinels,

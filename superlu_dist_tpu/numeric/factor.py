@@ -30,16 +30,48 @@ from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.ops.dense import group_partial_factor
 
 
+def extend_add_set(f, pool, m, ub, child_off, child_slot, rel):
+    """One child-set's extend-add: gather each child's padded ub×ub Schur
+    block from the pool and scatter-add it into the parent fronts at
+    rel[c,i]·m + rel[c,j] (rel == m is the OOB sentinel).  SHARED
+    MACHINERY: ``group_step`` unrolls a Python loop of these per group
+    (one call per ChildSet), and the mega executor (numeric/mega.py)
+    lax.scan's the SAME function over uniform padded child tables with a
+    TRACED ``ub`` — keep it shape-polymorphic in (C, UB) and exact in
+    the per-child gather indices (off + i·ub + j), which is what makes
+    the two executors bitwise-identical."""
+    c, ubmax = rel.shape
+    ii = jnp.arange(ubmax)
+    # per-child 2-D gather: row stride is the child's REAL ub (a python
+    # int here, the per-set bucket in the mega scan), so entries past a
+    # child's real block read out of its pool slab — always paired with
+    # an OOB rel sentinel, hence dropped below
+    src = (child_off[:, None, None] + ii[None, :, None] * ub
+           + ii[None, None, :]).reshape(c, ubmax * ubmax)
+    vals = pool.at[src].get(mode="fill", fill_value=0)
+    ri, rj = rel[:, :, None], rel[:, None, :]
+    # any sentinel (rel == m) in the pair must push the flat index OOB —
+    # a mixed pair's ri*m + rj would land in-bounds at (ri+1, 0)
+    dst = jnp.where((ri >= m) | (rj >= m), m * m,
+                    ri * m + rj).reshape(c, ubmax * ubmax)
+    return f.at[(child_slot[:, None], dst)].add(vals, mode="drop")
+
+
 def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
                children, front_sharding=None, pivot_sharding=None,
                replicated=None, pivot="blocked"):
     """One (level, bucket) group: assemble + factor + write back.
 
-    dims = (batch, m, w, u) static; `children` is a list of
-    (ub, child_off, child_slot, rel) with device arrays.  Index padding
-    convention (used by the streamed executor): scatter slots == batch and
-    gather sources past the array end are dropped/filled — all index
-    arithmetic keeps OOB entries OOB (rel sentinel == m maps past m*m).
+    dims = (batch, m, w, u) static; `children` is either a list of
+    (ub, child_off, child_slot, rel) with device arrays (the fused and
+    streamed executors — one unrolled extend-add per set), or a 4-tuple
+    of STACKED tables (child_off (S,C), child_slot (S,C), child_ub (S,),
+    rel (S,C,UB)) which the mega executor folds in with ONE lax.scan —
+    same per-set arithmetic, program size independent of the set count.
+    Index padding convention (used by the streamed executor): scatter
+    slots == batch and gather sources past the array end are
+    dropped/filled — all index arithmetic keeps OOB entries OOB (rel
+    sentinel == m maps past m*m).
     """
     batch, m, w, u = dims
     dt = pool.dtype
@@ -56,15 +88,19 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     if a_src.shape[0]:
         vals = avals.at[a_src].get(mode="fill", fill_value=0)
         f = f.at[(a_slot, a_flat)].add(vals, mode="drop")
-    for (ub, child_off, child_slot, rel) in children:
-        src = child_off[:, None] + jnp.arange(ub * ub)
-        vals = pool.at[src].get(mode="fill", fill_value=0)
-        ri, rj = rel[:, :, None], rel[:, None, :]
-        # any sentinel (rel == m) in the pair must push the flat index OOB —
-        # a mixed pair's ri*m + rj would land in-bounds at (ri+1, 0)
-        dst = jnp.where((ri >= m) | (rj >= m), m * m,
-                        ri * m + rj).reshape(-1, ub * ub)
-        f = f.at[(child_slot[:, None], dst)].add(vals, mode="drop")
+    if isinstance(children, tuple):
+        # stacked child tables (mega executor): scan the shared per-set
+        # extend-add — the sets fold into f in the same sequence the
+        # Python loop below runs them, so the factors stay bitwise equal
+        c_off, c_slot, c_ub, c_rel = children
+        if c_off.shape[0]:
+            def body(fc, xs):
+                co, cs, ub, r = xs
+                return extend_add_set(fc, pool, m, ub, co, cs, r), None
+            f, _ = jax.lax.scan(body, f, (c_off, c_slot, c_ub, c_rel))
+    else:
+        for (ub, child_off, child_slot, rel) in children:
+            f = extend_add_set(f, pool, m, ub, child_off, child_slot, rel)
     f = f.reshape(batch, m, m)
     if front_sharding is not None:
         f = wsc(f, front_sharding)
@@ -270,10 +306,19 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     ("snode", "panel"); pool_partition shards the Schur pool across all
     mesh devices (see make_factor_fn).
     """
+    if executor not in ("auto", "fused", "stream", "mega"):
+        raise ValueError(f"executor must be auto|fused|stream|mega, "
+                         f"got {executor!r}")
     if executor == "auto":
         multiproc = mesh is not None and jax.process_count() > 1
         executor = ("fused" if jax.default_backend() == "cpu"
                     and not multiproc else "stream")
+    if executor == "mega" and mesh is not None:
+        # the mega executor has no SPMD story yet (its per-bucket
+        # programs take metadata as runtime arguments the partitioner
+        # would have to replicate anyway) — mesh runs keep the streamed
+        # per-key kernels, which shard
+        executor = "stream"
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
@@ -294,6 +339,9 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
             from superlu_dist_tpu.numeric.stream import StreamExecutor
             fn = StreamExecutor(plan, dtype, mesh=mesh,
                                 pool_partition=pool_partition)
+        elif executor == "mega":
+            from superlu_dist_tpu.numeric.mega import MegaExecutor
+            fn = MegaExecutor(plan, dtype)
         else:
             fn = make_factor_fn(plan, dtype, mesh=mesh,
                                 pool_partition=pool_partition)
@@ -363,7 +411,8 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     ckpt = None
     want_ckpt = bool(ckpt_dir) or ckpt_every > 0
     if want_ckpt or resume_from:
-        # only the streamed executor has per-group boundaries
+        # checkpoints need per-group boundaries: the streamed and mega
+        # executors have them, the fused whole-program jit does not
         if executor in ("auto", "fused"):
             executor = "stream"
     if want_ckpt:

@@ -97,10 +97,24 @@ class FactorPlan:
     sched_window: int = 0          # dataflow look-ahead window (levels)
     n_level_groups: int = 0        # groups a pure level schedule yields
     critical_path: int = 0         # longest chain of dependent groups
+    closed: bool = False           # shape-key set closed onto ladder rungs
+    bucket_set: tuple = ()         # sorted distinct (W, U) keys over groups
 
     @property
     def n_levels(self) -> int:
         return int(self.sf.sn_level.max()) + 1 if len(self.sf.sn_level) else 0
+
+    def bucket_set_digest(self) -> str:
+        """Stable short digest of the (W, U) shape-key set (plus the
+        closure flag): the identity of the compiled-program set the mega
+        executor needs for this plan.  The fleet warm-start tier keys
+        its prebaked-cache markers on it (utils/jaxcache.py,
+        scripts/warm_compile_cache.py) and the bench row records it —
+        two matrices with equal digests share one compiled kernel set
+        (up to dtype and the derived batch/index rungs)."""
+        import hashlib
+        blob = repr((bool(self.closed), tuple(self.bucket_set)))
+        return hashlib.sha256(blob.encode()).hexdigest()[:12]
 
     @property
     def mean_occupancy(self) -> float:
@@ -152,12 +166,55 @@ class FactorPlan:
                 "without it jax silently downcasts the int64 index maps")
 
 
+# ---------------------------------------------------------------------------
+# The canonical bucket ladder — ONE source of truth for every pad-to-rung
+# rounding in the project.  Historically the plan's front buckets
+# (_bucket_sizes) and the streamed executor's array padding
+# (stream._bucket_len) rounded with different rungs/growth, so schedule
+# alignment and kernel caching could disagree about what "the same shape"
+# means; both now sit on this recurrence (and the solve plan's nrhs rungs
+# follow the same closed-set discipline, solve/plan.nrhs_buckets).
+# Defaults come from the knob registry: SLU_TPU_BUCKET_BASE / _GROWTH.
+# ---------------------------------------------------------------------------
+
+def ladder_rungs(lo: int, growth: float):
+    """Infinite generator of ladder rungs: ``lo``, then
+    ``max(prev + step, ceil(prev * growth / step) * step)`` with step = 8
+    (multiple-of-8 rungs) above the base, step = 1 below it.  growth=2
+    from lo=8 reproduces the streamed executor's historical pow-2 rungs;
+    growth=1.5 from a plan ``min_bucket`` reproduces _bucket_sizes'."""
+    step = 8 if lo >= 8 else 1
+    s = int(lo)
+    while True:
+        yield s
+        s = max(s + step, int(np.ceil(s * growth / step) * step))
+
+
+def bucket_rung(n: int, lo: int | None = None,
+                growth: float | None = None) -> int:
+    """Smallest ladder rung >= n.  ``lo``/``growth`` default to the
+    registered SLU_TPU_BUCKET_BASE / SLU_TPU_BUCKET_GROWTH knobs —
+    the n-independent canonical ladder the closure pass rounds onto."""
+    from superlu_dist_tpu.utils.options import env_float, env_int
+    if lo is None:
+        lo = env_int("SLU_TPU_BUCKET_BASE")
+    if growth is None:
+        growth = env_float("SLU_TPU_BUCKET_GROWTH")
+    for s in ladder_rungs(int(lo), max(float(growth), 1.01)):
+        if s >= n:
+            return s
+
+
 def _bucket_sizes(max_needed: int, min_bucket: int, growth: float):
+    """Front-size rungs for one plan: the shared ladder's rungs below
+    ``max_needed`` plus one tight top rung hugging the largest front
+    (the legacy open-ladder behavior; a CLOSED plan re-rounds every key
+    onto canonical ladder rungs afterwards — _close_shape_keys)."""
     sizes = []
-    s = min_bucket
-    while s < max_needed:
+    for s in ladder_rungs(min_bucket, growth):
+        if s >= max_needed:
+            break
         sizes.append(s)
-        s = max(s + 8, int(np.ceil(s * growth / 8.0) * 8))
     sizes.append(int(np.ceil(max_needed / 8.0) * 8) if max_needed > min_bucket
                  else min_bucket)
     return np.unique(np.array(sizes, dtype=np.int64))
@@ -216,6 +273,68 @@ def _align_shape_keys(sn_W, sn_U, tol: float):
         alive[b] = False
         rep[b] = a
     # path-compress representatives, then map supernodes through
+    for i in range(k):
+        r = i
+        while rep[r] != r:
+            r = rep[r]
+        rep[i] = r
+    return W[rep[inv]], U[rep[inv]]
+
+
+def _close_shape_keys(sn_W, sn_U, max_keys: int):
+    """The global shape-key CLOSURE pass (the mega-executor prerequisite,
+    arXiv:2406.10511's one-engine-every-front-shape discipline): map the
+    aligned (W, U) key set onto at most ``max_keys`` keys whose values
+    are canonical ladder rungs (bucket_rung), so the compiled-program
+    count is bounded by ``max_keys`` INDEPENDENT of matrix size and two
+    matrices of the same size class land on the same compiled set.
+
+    Unlike _align_shape_keys (a flop-budgeted OPTIMIZATION), closure is
+    a hard bound: merges proceed cheapest-flop-ratio-first until the
+    count target is met, and every surviving key is rounded up to ladder
+    rungs — the padding cost is the price of the closed compile set
+    (docs/PERFORMANCE.md quantifies it).  Like alignment it runs BEFORE
+    the schedule branch, so level and dataflow pad identically and the
+    bitwise schedule-equivalence guarantee carries over to closed plans.
+
+    Returns (sn_W, sn_U) with closed assignments.
+    """
+    from superlu_dist_tpu.symbolic.symbfact import _front_flops
+    if len(sn_W) == 0:
+        return sn_W, sn_U
+    rung = np.vectorize(bucket_rung, otypes=[np.int64])
+    pairs = np.stack([rung(np.maximum(sn_W, 1)),
+                      np.where(sn_U > 0, rung(np.maximum(sn_U, 1)), 0)],
+                     axis=1)
+    keys, inv, cnt = np.unique(pairs, axis=0, return_inverse=True,
+                               return_counts=True)
+    k = len(keys)
+    W = keys[:, 0].astype(np.int64).copy()
+    U = keys[:, 1].astype(np.int64).copy()
+    n_mem = cnt.astype(np.int64).copy()
+    base = n_mem * _front_flops(W, U)
+    rep = np.arange(k)
+    alive = np.ones(k, dtype=bool)
+    while alive.sum() > max(int(max_keys), 1):
+        ai = np.flatnonzero(alive)
+        # merged key = rung-rounded (max W, max U): the ratio accounts
+        # the TRUE padded flops of the canonical merged rung
+        Wm = rung(np.maximum.outer(W[ai], W[ai]))
+        Um = np.maximum.outer(U[ai], U[ai])
+        Um = np.where(Um > 0, rung(np.maximum(Um, 1)), 0)
+        tot = n_mem[ai][:, None] + n_mem[ai][None, :]
+        ratio = tot * _front_flops(Wm, Um) / (base[ai][:, None]
+                                              + base[ai][None, :])
+        np.fill_diagonal(ratio, np.inf)
+        i, j = np.unravel_index(np.argmin(ratio), ratio.shape)
+        a, b = int(ai[i]), int(ai[j])
+        a, b = min(a, b), max(a, b)
+        W[a] = int(Wm[i, j])
+        U[a] = int(Um[i, j])
+        n_mem[a] += n_mem[b]
+        base[a] += base[b]
+        alive[b] = False
+        rep[b] = a
     for i in range(k):
         r = i
         while rep[r] != r:
@@ -344,7 +463,9 @@ def _dataflow_batches(sf: SymbolicFact, sn_W, sn_U, window: int) -> list:
 def build_plan(sf: SymbolicFact, min_bucket: int = 8,
                growth: float = 1.5, schedule: str | None = None,
                window: int | None = None,
-               align: float | None = None) -> FactorPlan:
+               align: float | None = None,
+               closed: bool | None = None,
+               max_keys: int | None = None) -> FactorPlan:
     """Precompute all index maps.  Pure numpy; cost is O(nnz(A) + nnz(L)).
 
     schedule selects the dispatch-group former: "dataflow" (default via
@@ -356,8 +477,15 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
     (SLU_TPU_SCHED_ALIGN; <= 1 disables), applied before the schedule
     branch so both schedules pad every supernode identically.  Both
     schedules produce bitwise-identical factors — only dispatch count
-    and batch occupancy differ."""
-    from superlu_dist_tpu.utils.options import env_float, env_int, env_str
+    and batch occupancy differ.
+
+    closed (SLU_TPU_BUCKET_CLOSED) additionally runs the shape-key
+    CLOSURE pass (_close_shape_keys): the (W, U) key set is merged onto
+    at most ``max_keys`` (SLU_TPU_BUCKET_KEYS) canonical ladder rungs,
+    bounding the compiled-program count independent of matrix size —
+    the mega-executor (numeric/mega.py) contract."""
+    from superlu_dist_tpu.utils.options import (env_flag, env_float,
+                                                env_int, env_str)
     if schedule is None:
         schedule = env_str("SLU_TPU_SCHEDULE")
     if schedule not in ("level", "dataflow"):
@@ -367,6 +495,10 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
         window = env_int("SLU_TPU_SCHED_WINDOW")
     if align is None:
         align = env_float("SLU_TPU_SCHED_ALIGN")
+    if closed is None:
+        closed = env_flag("SLU_TPU_BUCKET_CLOSED")
+    if max_keys is None:
+        max_keys = env_int("SLU_TPU_BUCKET_KEYS")
     n = sf.n
     ns = sf.n_supernodes
     indptr, indices = sf.pattern_indptr, sf.pattern_indices
@@ -381,6 +513,8 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
     sn_U = np.where(us == 0, 0,
                     u_sizes[np.searchsorted(u_sizes, np.maximum(us, 1))])
     sn_W, sn_U = _align_shape_keys(sn_W, sn_U, float(align))
+    if closed:
+        sn_W, sn_U = _close_shape_keys(sn_W, sn_U, int(max_keys))
 
     if schedule == "dataflow":
         batches = _dataflow_batches(sf, sn_W, sn_U, int(window))
@@ -552,4 +686,6 @@ def build_plan(sf: SymbolicFact, min_bucket: int = 8,
                       flops=sf.flops, front_bytes=front_bytes,
                       schedule=schedule, sched_window=int(window),
                       n_level_groups=n_level_groups,
-                      critical_path=critical_path)
+                      critical_path=critical_path,
+                      closed=bool(closed),
+                      bucket_set=tuple(sorted({(g.w, g.u) for g in groups})))

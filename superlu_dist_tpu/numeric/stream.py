@@ -90,10 +90,14 @@ RETRACE_SENTINEL = RetraceSentinel()
 
 
 def _bucket_len(n: int, lo: int = 8, base: float = 2.0) -> int:
-    """Next power of `base` (min lo) — pads arrays so shapes repeat.
+    """Next rung of the canonical bucket ladder (plan.bucket_rung — the
+    ONE ladder shared with the plan's front bucketing, so schedule
+    alignment and kernel caching can never disagree about what "the same
+    shape" means).  The defaults reproduce the historical pow-2 rounding;
     base=4 for index arrays whose padding costs only a cheap gather:
     coarser rungs collapse more compile keys."""
-    return max(lo, int(base ** int(np.ceil(np.log(max(n, 1)) / np.log(base)))))
+    from superlu_dist_tpu.numeric.plan import bucket_rung
+    return bucket_rung(max(int(n), 1), lo=lo, growth=base)
 
 
 def _pad_to(arr: np.ndarray, length: int, fill) -> np.ndarray:
@@ -258,8 +262,21 @@ class StreamExecutor:
         self._n_host_groups = sum(1 for g in plan.groups
                                   if g.level in self._host_levels)
 
+        # executor-resident lengths the call loop reads (the mega
+        # subclass pads both to canonical ladder rungs so its programs
+        # are matrix-size-independent)
+        self._pool_len = plan.pool_size
+        self._steps = self._build_steps()
+        self._announce_keys()
+
+    def _build_steps(self) -> list:
+        """Per-group (key, assembly arrays, child arrays, batch, on_host)
+        tuples in dispatch order.  Overridden by the mega executor
+        (numeric/mega.py), which packs the same metadata onto
+        per-bucket-canonical shapes instead of per-group ones."""
+        plan = self.plan
         n_avals = len(plan.pattern_indices)
-        self._steps = []
+        steps = []
         for grp in plan.groups:
             on_host = grp.level in self._host_levels
             # host-group index arrays go straight numpy -> cpu device (a
@@ -286,8 +303,59 @@ class StreamExecutor:
                 child_shapes.append((cs.ub, c))
             key = ((b, grp.m, grp.w, grp.u), la, tuple(child_shapes),
                    plan.pool_size, self.dtype)
-            self._steps.append((key, tuple(_put(x) for x in a),
-                               tuple(child_arrs), grp.batch, on_host))
+            steps.append((key, tuple(_put(x) for x in a),
+                          tuple(child_arrs), grp.batch, on_host))
+        return steps
+
+    # ---- compile-census integration (obs/compilestats.py) ---------------
+    # The executor knows its FULL expected kernel set up front, so it
+    # announces the per-key census labels at construction; a watchdog
+    # fire mid-compile can then name the keys still PENDING (the
+    # BENCH_r02 postmortem gap — 119 kernels, no record of which were
+    # left).  Group granularity only: the level-traced programs are
+    # per-wave aggregates with no stable per-key identity.
+
+    _census_site = "stream._kernel"
+
+    @staticmethod
+    def _census_label(key) -> str:
+        (b, m, w, u) = key[0]
+        return f"lu b{b} m{m} w{w} u{u}"
+
+    def _announce_keys(self) -> None:
+        if self.granularity != "group":
+            return
+        COMPILE_STATS.announce(
+            self._census_site,
+            sorted({self._census_label(key)
+                    for key, _, _, _, _ in self._steps}))
+
+    def _get_kernel(self, key, pivot, args):
+        """The jitted program for one step key.  ``args`` is the exact
+        call tuple (for AOT shape derivation in the mega subclass —
+        unused here: stream kernels compile inside their first call)."""
+        return _kernel(*key, self.mesh, self.pool_partition, pivot)
+
+    def _census_pending(self, key, pivot) -> bool:
+        """True when this step's FIRST invocation will build (and should
+        be timed into the census by the call loop)."""
+        ck = ("group", key, self.mesh, self.pool_partition, pivot)
+        return ck not in _CENSUSED_KEYS
+
+    def _census_record(self, key, pivot, t0, n_args) -> None:
+        _CENSUSED_KEYS.add(("group", key, self.mesh, self.pool_partition,
+                            pivot))
+        COMPILE_STATS.record(self._census_site, self._census_label(key),
+                             t0, time.perf_counter() - t0, n_args=n_args)
+
+    def _prep_avals(self, avals):
+        """Upload/cast the pattern values (mega pads to its rung)."""
+        return jnp.asarray(avals, dtype=self.dtype)
+
+    def _ckpt_pool(self, pool):
+        """The pool view a checkpoint frontier stores (mega strips its
+        rung padding so frontiers stay executor-portable)."""
+        return pool
 
     @property
     def n_kernels(self) -> int:
@@ -353,8 +421,8 @@ class StreamExecutor:
 
     def __call__(self, avals, thresh):
         plan = self.plan
-        pool = jnp.zeros(plan.pool_size, dtype=self.dtype)
-        avals = jnp.asarray(avals, dtype=self.dtype)
+        pool = jnp.zeros(self._pool_len, dtype=self.dtype)
+        avals = self._prep_avals(avals)
         if self.mesh is not None:
             from jax.sharding import NamedSharding, PartitionSpec as P
             from superlu_dist_tpu.numeric.factor import pool_spec
@@ -421,12 +489,14 @@ class StreamExecutor:
                 tiny = jnp.zeros((), jnp.int32)
                 avals, thresh = avals_dev, thresh_dev
                 on_host_now = False
-            kern = _kernel(*key, self.mesh, self.pool_partition, pivot)
+            kern = self._get_kernel(key, pivot,
+                                    (avals, pool, thresh, *a, *child_arrs))
             # compile census: the FIRST invocation per shape key runs the
             # synchronous trace+lower+compile inside the dispatch — time
-            # it (no extra blocking; execution stays async)
-            ck = ("group", key, self.mesh, self.pool_partition, pivot)
-            cold = ck not in _CENSUSED_KEYS
+            # it (no extra blocking; execution stays async).  The mega
+            # subclass AOT-builds inside _get_kernel instead and reports
+            # the exact trace/lower/compile split there.
+            cold = self._census_pending(key, pivot)
             if self._progress and gi % self._progress == 0:
                 print(f"[stream] issuing group {gi}/{len(self._steps)} "
                       f"(+{time.perf_counter() - t_issue0:.1f}s)",
@@ -435,12 +505,8 @@ class StreamExecutor:
                 t0 = time.perf_counter()
             (lp, up), pool, t = kern(avals, pool, thresh, *a, *child_arrs)
             if cold:
-                _CENSUSED_KEYS.add(ck)
-                (b, m, w, u) = key[0]
-                COMPILE_STATS.record(
-                    "stream._kernel", f"lu b{b} m{m} w{w} u{u}", t0,
-                    time.perf_counter() - t0,
-                    n_args=8 + len(child_arrs))
+                self._census_record(key, pivot, t0,
+                                    n_args=8 + len(child_arrs))
             if tracer.enabled:
                 # async-issue span: how long the DISPATCH took (Python +
                 # transfer setup), before any blocking — the
@@ -451,7 +517,7 @@ class StreamExecutor:
             if profile:
                 jax.block_until_ready(lp)
                 dt = time.perf_counter() - t0
-                (b, m, w, u), _, _, _, _ = key
+                (b, m, w, u) = key[0]
                 grp = plan.groups[gi]
                 gflop = float(_front_flops(w, u)) * grp.batch / 1e9
                 self.last_profile.append({
@@ -466,7 +532,8 @@ class StreamExecutor:
                 # frontier bookkeeping (interval flushes inside note);
                 # BEFORE the chaos hook so an injected kill at group gi
                 # leaves gi's interval checkpoint durable
-                self.checkpoint.note(gi, fronts, pool, tiny)
+                self.checkpoint.note(gi, fronts, self._ckpt_pool(pool),
+                                     tiny)
             if self.chaos is not None:
                 self.chaos.on_group(gi)
         tiny = tiny + tiny_host + tiny_resumed
@@ -728,8 +795,8 @@ class StreamExecutor:
                 # boundary this granularity has (group-mode resume can
                 # still consume it: frontiers are group-aligned)
                 if self.checkpoint is not None:
-                    self.checkpoint.note(len(fronts) - 1, fronts, pool,
-                                         tiny)
+                    self.checkpoint.note(len(fronts) - 1, fronts,
+                                         self._ckpt_pool(pool), tiny)
                 if self.chaos is not None:
                     self.chaos.on_group(len(fronts) - 1)
         self.last_offload_wait_seconds = self._offload_wait

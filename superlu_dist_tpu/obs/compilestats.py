@@ -72,6 +72,14 @@ class CompileStats:
         self.records: list[CompileRecord] = []
         self._cache_dir: str | None = None
         self._cache_entries: int | None = None
+        # pending-key accounting: executors announce their FULL expected
+        # kernel set at construction (they know it from the plan), and
+        # record() retires keys as they build — so a watchdog firing
+        # mid-compile can name the shape keys still UNCOMPILED (the
+        # BENCH_r02 postmortem gap: "died in factor-compile, 119
+        # kernels" with no record of which were left)
+        self._announced: set = set()
+        self._built: set = set()
 
     # ---- persistent-cache boundary (utils/jaxcache.py) -----------------
     def note_cache_dir(self, path: str | None) -> None:
@@ -116,6 +124,8 @@ class CompileStats:
                                 lower_seconds=lower_seconds,
                                 compile_seconds=compile_seconds)
             self.records.append(rec)
+            self._built.add((site, key))
+            self._announced.discard((site, key))
         from superlu_dist_tpu.obs.trace import get_tracer
         tr = get_tracer()
         if tr.enabled:
@@ -123,6 +133,25 @@ class CompileStats:
                         key=key, n_args=int(n_args), builds=int(builds),
                         persistent_hit=hit)
         return rec
+
+    # ---- pending-key accounting ----------------------------------------
+    def announce(self, site: str, keys) -> None:
+        """An executor declares the kernel keys it EXPECTS to build
+        (before any of them compile).  Keys this process already built
+        are not re-announced — a warmed executor re-running the same
+        plan leaves nothing pending."""
+        with self._lock:
+            for key in keys:
+                if (site, key) not in self._built:
+                    self._announced.add((site, str(key)))
+
+    def pending(self) -> list[dict]:
+        """Announced-but-unbuilt kernel keys, sorted — the census delta
+        a factor-compile watchdog row emits so the postmortem names the
+        offending buckets (bench.py `pending_kernels`)."""
+        with self._lock:
+            return [{"site": s, "key": k}
+                    for s, k in sorted(self._announced)]
 
     # ---- querying ------------------------------------------------------
     def marker(self) -> int:
@@ -153,20 +182,30 @@ class CompileStats:
         return out
 
     def block(self, since: int = 0, top: int = 8) -> dict:
-        """The ``stats.compile`` block: totals plus the top buckets."""
+        """The ``stats.compile`` block: totals plus the top buckets.
+
+        ``fresh_seconds`` counts only builds the persistent cache did
+        NOT serve from disk — the time spent actually COMPILING, which
+        a bucket-set-keyed warm start drives to ~0 (``seconds`` keeps
+        the first-invocation total: trace + lower + cache load)."""
         recs = self.records[since:]
         return {
             "builds": sum(r.builds for r in recs),
             "seconds": round(sum(r.seconds for r in recs), 4),
+            "fresh_seconds": round(sum(r.seconds for r in recs
+                                       if not r.persistent_hit), 4),
             "persistent_hits": sum(1 for r in recs if r.persistent_hit),
             "cache_dir": self._cache_dir,
             "census": self.census(since)[:top],
         }
 
     def _reset(self) -> None:
-        """Test hygiene: drop all records (the cache-dir note survives)."""
+        """Test hygiene: drop all records and pending announcements (the
+        cache-dir note and the built-key set survive — they are
+        process-wide facts, like the executors' kernel caches)."""
         with self._lock:
             self.records = []
+            self._announced = set()
 
 
 COMPILE_STATS = CompileStats()

@@ -96,6 +96,42 @@ def warm_marker_path(name: str, base_dir: str) -> str:
                         f"{name}.{machine_fingerprint()}")
 
 
+def _repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def bucket_warm_marker(digest: str, base_dir: str | None = None) -> str:
+    """Warm-cache marker path for one CLOSED bucket set (the mega
+    executor's compiled-program identity, FactorPlan.bucket_set_digest).
+    The persistent cache is thereby keyed by the BUCKET SET rather than
+    the matrix: the marker vouches that every program of that set is
+    resident in this machine's cache dir, so a serving fleet (or a
+    persist.from_bundle cold start) whose plans map onto the same
+    buckets compiles nothing — `compile_seconds ≈ 0` on the second run
+    of ANY matrix whose buckets are already resident."""
+    return warm_marker_path(f"bucketset.{digest}",
+                            base_dir or _repo_root())
+
+
+def bucket_set_warm(digest: str, base_dir: str | None = None) -> bool:
+    """True when scripts/warm_compile_cache.py (or a completed mega
+    prebake) has marked this bucket set's programs resident."""
+    return os.path.exists(bucket_warm_marker(digest, base_dir))
+
+
+def mark_bucket_set_warm(digest: str, base_dir: str | None = None) -> str:
+    """Record a prebaked bucket set (never raises — markers are an
+    optimization, exactly like the cache they vouch for)."""
+    path = bucket_warm_marker(digest, base_dir)
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        open(path, "a").close()
+    except OSError:
+        pass
+    return path
+
+
 def enable_compile_cache(cache_dir: str | None = None) -> None:
     """Point jax at the persistent compile cache (default: the repo's
     machine-scoped `.cache/jax-mach-<fp>`).  Caches every entry

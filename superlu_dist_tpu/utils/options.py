@@ -90,6 +90,30 @@ def _register_all() -> None:
     r("SLU_TPU_SCHED_ALIGN", "float", 1.1,
       "shape-key coalescing flop tolerance for batch packing "
       "(<= 1 disables)", group="numeric")
+    # --- bucket-ladder closure / mega executor (numeric/{plan,mega}.py) -----
+    r("SLU_TPU_BUCKET_BASE", "int", 8,
+      "smallest rung of the canonical bucket ladder shared by the plan "
+      "bucketing and every executor's pad-to-rung rounding "
+      "(numeric/plan.bucket_rung — the one source of truth)",
+      group="numeric")
+    r("SLU_TPU_BUCKET_GROWTH", "float", 2.0,
+      "geometric growth of the canonical bucket ladder (rungs rounded "
+      "to multiples of 8 above the base)", group="numeric")
+    r("SLU_TPU_BUCKET_CLOSED", "flag", False,
+      "close the factor plan's shape-key set: merge every (W, U) "
+      "dispatch key onto <= SLU_TPU_BUCKET_KEYS canonical ladder rungs "
+      "so the compiled-program count is independent of matrix size "
+      "(the mega-executor prerequisite)", group="numeric")
+    r("SLU_TPU_BUCKET_KEYS", "int", 6,
+      "maximum distinct (W, U) shape keys a closed plan may carry "
+      "(SLU_TPU_BUCKET_CLOSED=1); the mega executor compiles exactly "
+      "one program per key", group="numeric")
+    r("SLU_TPU_EXECUTOR", "str", "auto",
+      "numeric-factorization executor: one whole-program jit (fused), "
+      "one kernel per shape key (stream), one data-driven program per "
+      "closed shape bucket (mega), or the backend-dependent default "
+      "(auto).  df64 factorization keeps its own executor",
+      group="numeric", choices=("auto", "fused", "stream", "mega"))
     r("SLU_TPU_DIAG_INV", "flag", False,
       "precompute inverted diagonal blocks (reference DiagInv)",
       group="numeric")
@@ -574,6 +598,16 @@ class Options:
     # "dataflow" pad identically and stay bitwise-comparable.
     sched_align: float = dataclasses.field(
         default_factory=lambda: env_float("SLU_TPU_SCHED_ALIGN"))
+    # numeric executor selection (numeric/factor.get_executor): "mega"
+    # is the bucketed data-driven executor whose compiled-program count
+    # is bounded by the closed shape-key set (numeric/mega.py) — pair it
+    # with SLU_TPU_BUCKET_CLOSED=1 for the O(1)-in-n compile guarantee.
+    # "auto" keeps the backend default (fused on CPU, stream elsewhere).
+    executor: str = dataclasses.field(
+        default_factory=lambda: env_str("SLU_TPU_EXECUTOR"))
+    # close the shape-key set at plan build (numeric/plan._close_shape_keys)
+    bucket_closed: bool = dataclasses.field(
+        default_factory=lambda: env_flag("SLU_TPU_BUCKET_CLOSED"))
     # device-solve sweep scheduler (solve/plan.py): "dataflow" regroups
     # supernodes across levels into maximal same-shape sweep batches
     # (the serving hot path); "level" and "factor" are the A/B tiers —

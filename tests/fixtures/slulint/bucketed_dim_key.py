@@ -1,0 +1,39 @@
+"""slulint fixture: SLU107 negative — the same lru_cached jit factory
+called with LADDER-ROUNDED dimensions.
+
+The raw sizes route through a bucketing helper (a canonical-ladder
+rounding like numeric/plan.bucket_rung / stream._bucket_len) before
+they enter the cache key, so shapes repeat and the compiled-program
+set stays bounded.  SLU107 must stay quiet here.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def _bucket_len(n, lo=8, base=2.0):
+    s = lo
+    while s < n:
+        s = int(s * base)
+    return s
+
+
+@functools.lru_cache(maxsize=None)
+def _kern(batch, width):
+    def step(x):
+        padded = jnp.zeros((batch, width), x.dtype)
+        padded = padded.at[:x.shape[0], :x.shape[1]].set(x)
+        return jnp.sum(padded, axis=1)
+
+    return jax.jit(step)
+
+
+def run(chunks):
+    outs = []
+    for x in chunks:
+        # GOOD: both key axes are ladder rungs — shapes repeat
+        fn = _kern(_bucket_len(x.shape[0]), _bucket_len(len(x[0])))
+        outs.append(fn(jnp.asarray(x)))
+    return outs

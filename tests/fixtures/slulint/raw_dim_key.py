@@ -1,0 +1,32 @@
+"""slulint fixture: SLU107 positive — an lru_cached jit factory keyed
+on RAW (unbucketed) dimensions.
+
+This is the exact pattern that produced the BENCH_r02 119-kernel
+compile wall: every distinct batch length / index count mints a fresh
+compiled program, so the kernel count grows with the matrix instead of
+staying a closed bucket set.  The v1 lexical SLU105 tier does NOT flag
+this (no env read, no closure) — SLU107 exists for it.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.lru_cache(maxsize=None)
+def _kern(batch, width):
+    def step(x):
+        return jnp.sum(x.reshape(batch, width), axis=1)
+
+    return jax.jit(step)
+
+
+def run(chunks):
+    outs = []
+    for x in chunks:
+        # BAD: len(x) and x.shape[0] feed the cache key raw — one
+        # compiled program per distinct chunk size
+        fn = _kern(x.shape[0], len(x[0]))
+        outs.append(fn(jnp.asarray(x)))
+    return outs

@@ -559,6 +559,45 @@ def test_slu103_interprocedural_fixture_lexical_v1_misses():
     assert v1 == []
 
 
+def test_slu107_raw_dim_fixture_pair():
+    """Acceptance (ISSUE 11 satellite): the committed raw-dimension
+    jit-factory fixture is flagged by SLU107 — the exact pattern that
+    produced the BENCH_r02 119-kernel blowup — while the ladder-rounded
+    twin stays clean."""
+    from superlu_dist_tpu.analysis import analyze_paths
+    raw = analyze_paths([os.path.join(FIXDIR, "raw_dim_key.py")])
+    assert sorted(f.rule for f in raw) == ["SLU107", "SLU107"]
+    msgs = " ".join(f.message for f in raw)
+    assert "raw (unbucketed) dimension" in msgs
+    assert "len(...)" in msgs and ".shape" in msgs
+    assert "bucket" in raw[0].hint
+    clean = analyze_paths([os.path.join(FIXDIR, "bucketed_dim_key.py")])
+    assert clean == []
+
+
+SLU107_INLINE = """
+import functools, jax, jax.numpy as jnp
+
+@functools.lru_cache(maxsize=None)
+def make(n):
+    return jax.jit(lambda x: x[:n])
+
+def a(x):
+    return make(x.size)(x)          # raw .size -> flagged
+
+def b(x):
+    return make(_bucket_len(x.size))(x)   # rung-rounded -> clean
+"""
+
+
+def test_slu107_flags_size_and_respects_bucketizers():
+    from superlu_dist_tpu.analysis import analyze_source
+    fs = analyze_source(SLU107_INLINE, "mod.py", default_rules())
+    slu107 = [f for f in fs if f.rule == "SLU107"]
+    assert len(slu107) == 1
+    assert ".size" in slu107[0].message
+
+
 SLU101_RANK_TEMP = """
 def solve(tc, x, root):
     r = tc.rank
