@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""SLU106 verify-mode overhead smoke (check_trace_overhead.py style).
+"""SLU106 + SLU109 verify-mode overhead smoke.
 
 Runs TreeComm collectives in fresh subprocesses:
 
@@ -11,11 +11,20 @@ Runs TreeComm collectives in fresh subprocesses:
   checked exactly once (composites/chunks exempt), and payloads
   round-trip bit-exactly through the digest-guarded path.
 
+And the SLU109 runtime lock-order verifier (utils/lockwatch.py):
+
+* locks OFF — ``make_lock`` hands out a PLAIN ``threading.Lock`` (no
+  wrapper type) and ``lockwatch._WATCH`` stays None: the off path
+  allocates no watch state at all;
+* locks ON  — nested acquisitions land in the global order graph and
+  the wrappers are the instrumented type.
+
 Exit 0 = pass.  Gate contract (shared with run_slulint.sh,
 check_nan_guards.sh and check_trace_overhead.py — see
 scripts/ci_gates.sh): any regression raises/asserts, which exits
-non-zero.  Skips cleanly (exit 0 with a notice) when the native
-library is unavailable — the verifier rides the native tree transport.
+non-zero.  The collective half skips cleanly (exit 0 with a notice)
+when the native library is unavailable — the verifier rides the native
+tree transport; the lock half has no native dependency and always runs.
 """
 
 import json
@@ -57,14 +66,34 @@ with treecomm.TreeComm(name, 1, 0, max_len=64, create=True) as tc:
 """
 
 
-def run_child(extra_env):
+LOCK_CHILD = r"""
+import json, threading
+from superlu_dist_tpu.utils import lockwatch
+
+a = lockwatch.make_lock("gate.A")
+b = lockwatch.make_lock("gate.B")
+with a:
+    with b:
+        pass
+plain = type(a) is type(threading.Lock())
+print(json.dumps({
+    "plain_lock": plain,
+    "no_watch": lockwatch._WATCH is None,
+    "graph": lockwatch.order_graph(),
+    "lock_type": type(a).__name__,
+}))
+"""
+
+
+def run_child(extra_env, code=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     for k in ("SLU_TPU_VERIFY_COLLECTIVES", "SLU_TPU_COMM_TIMEOUT_S",
-              "SLU_TPU_CHAOS"):
+              "SLU_TPU_CHAOS", "SLU_TPU_VERIFY_LOCKS"):
         env.pop(k, None)
     env.update(extra_env)
-    r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
-                       stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    r = subprocess.run([sys.executable, "-c", code or CHILD], env=env,
+                       cwd=REPO, stdout=subprocess.PIPE,
+                       stderr=subprocess.PIPE)
     if r.returncode != 0:
         sys.stderr.write(r.stderr.decode())
         raise SystemExit(f"child failed (rc={r.returncode})")
@@ -77,6 +106,23 @@ def fail(msg):
 
 
 def main():
+    # ---- SLU109 lock-order verifier (no native dependency) --------------
+    loff = run_child({}, code=LOCK_CHILD)
+    if not loff["plain_lock"]:
+        fail(f"lock off-path allocated a wrapper: {loff['lock_type']}")
+    if not loff["no_watch"]:
+        fail("lock off-path allocated the order-graph watch")
+    if loff["graph"]:
+        fail(f"lock off-path recorded order edges: {loff['graph']}")
+    lon = run_child({"SLU_TPU_VERIFY_LOCKS": "1"}, code=LOCK_CHILD)
+    if lon["lock_type"] != "InstrumentedLock":
+        fail(f"lock verify mode handed out: {lon['lock_type']}")
+    if lon["graph"].get("gate.A") != ["gate.B"]:
+        fail(f"lock verify mode missed the A->B edge: {lon['graph']}")
+    print("check_verify_overhead: locks OK (off path plain+stateless; "
+          "on path records the order graph)")
+
+    # ---- SLU106 collective lockstep verifier ----------------------------
     off = run_child({})
     if off.get("skip"):
         print(f"check_verify_overhead: SKIP ({off['skip']})")

@@ -6,14 +6,18 @@
 # a hard per-gate timeout):
 #
 #   slulint         scripts/run_slulint.sh          static analysis
-#                   (SLU101-SLU105, interprocedural tier) over the
-#                   package, scripts/, bench.py and examples/
+#                   (SLU101-SLU105 + SLU107-SLU110, interprocedural
+#                   tier) over the package, scripts/, bench.py and
+#                   examples/
 #   nan-guards      scripts/check_nan_guards.sh     JAX_DEBUG_NANS smoke
 #   trace-overhead  scripts/check_trace_overhead.py tracer off-path
 #                   allocation + artifact well-formedness
 #   verify-overhead scripts/check_verify_overhead.py  SLU106 lockstep
 #                   verifier: disabled path allocates no verifier state,
-#                   enabled path round-trips and counts checks
+#                   enabled path round-trips and counts checks; plus the
+#                   SLU109 lock-order verifier (SLU_TPU_VERIFY_LOCKS):
+#                   off path hands out plain locks and builds no watch,
+#                   on path records the order graph
 #   schedule-equiv  scripts/check_schedule_equiv.py   level vs dataflow
 #                   dispatch schedules produce bitwise-identical L/U;
 #                   dataflow never exceeds the level group count
@@ -44,6 +48,10 @@
 #                   compiled-program count must be CONSTANT across
 #                   n = 4096/32768/110592 (the BENCH_r02 compile-wall
 #                   gallery), every bucket program AOT-stageable
+#   tsan-native     scripts/check_tsan_native.sh      -fsanitize=thread
+#                   build of the native shared segment + a threaded
+#                   heartbeat/bulletin/seqlock stress; SKIPs loudly
+#                   (never silent-green) when the toolchain lacks TSan
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -70,10 +78,11 @@ declare -A GATES=(
   [crash-resume]="python scripts/check_crash_resume.py"
   [rank-failure]="python scripts/check_rank_failure.py"
   [compile-budget]="python scripts/compile_census.py --buckets 16 32 48 --stage"
+  [tsan-native]="scripts/check_tsan_native.sh"
 )
 ORDER=(slulint verify-overhead schedule-equiv solve-equiv serve-robust
-       crash-resume rank-failure compile-budget trace-overhead nan-guards
-       perf-regress)
+       crash-resume rank-failure compile-budget tsan-native trace-overhead
+       nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

@@ -35,9 +35,13 @@ def _build_parser():
                     "(collective-safety SLU101, trace-purity SLU102, "
                     "index-width SLU103, env-knob registry SLU104, "
                     "jit-cache-key hygiene SLU105, jit-key shape "
-                    "diversity SLU107; the SLU106 runtime "
-                    "twin lives in parallel/treecomm.py under "
-                    "SLU_TPU_VERIFY_COLLECTIVES=1)")
+                    "diversity SLU107, shared-mutable access SLU108, "
+                    "lock-order/hold-discipline SLU109, thread "
+                    "lifecycle SLU110; the SLU106 runtime twin lives "
+                    "in parallel/treecomm.py under "
+                    "SLU_TPU_VERIFY_COLLECTIVES=1, the SLU109 runtime "
+                    "twin in utils/lockwatch.py under "
+                    "SLU_TPU_VERIFY_LOCKS=1)")
     p.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
                    help="files/directories to scan (default: the package, "
                         "scripts/, bench.py, examples/)")

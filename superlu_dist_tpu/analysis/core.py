@@ -181,8 +181,13 @@ def default_rules() -> list:
         JitCacheKeyRule, JitKeyShapeDiversityRule, TracePurityRule)
     from superlu_dist_tpu.analysis.rules_index import IndexWidthRule
     from superlu_dist_tpu.analysis.rules_env import EnvKnobRule
+    from superlu_dist_tpu.analysis.rules_shared import SharedMutableRule
+    from superlu_dist_tpu.analysis.rules_lockorder import LockOrderRule
+    from superlu_dist_tpu.analysis.rules_lifecycle import \
+        ThreadLifecycleRule
     return [CollectiveRule(), TracePurityRule(), IndexWidthRule(),
-            EnvKnobRule(), JitCacheKeyRule(), JitKeyShapeDiversityRule()]
+            EnvKnobRule(), JitCacheKeyRule(), JitKeyShapeDiversityRule(),
+            SharedMutableRule(), LockOrderRule(), ThreadLifecycleRule()]
 
 
 def analyze_source(source: str, path: str, rules, project=None) -> list:

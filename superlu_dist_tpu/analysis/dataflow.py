@@ -121,6 +121,12 @@ class Summary:
     # transitive: (qname of the function owning the witness, witness)
     reaches_collective: tuple | None = None
     reaches_env: tuple | None = None
+    # concurrency lattice facts (analysis/concurrency.py resolves them):
+    # raw with-statement lock-acquisition candidates
+    # ("self"|"name", text, line) and raw blocking-operation witnesses
+    # (kind, receiver-text, line) lexically in this function's own body
+    acquires_raw: list = dataclasses.field(default_factory=list)
+    blocking_raw: list = dataclasses.field(default_factory=list)
 
 
 def _site(path: str, node: ast.AST) -> str:
@@ -177,6 +183,70 @@ def _is_lru_decorated(fn) -> bool:
     return False
 
 
+# ---- concurrency lattice: raw lock/blocking facts -------------------------
+# The concurrency rules (SLU108-SLU110, analysis/concurrency.py) need two
+# lexical facts per function: which locks its body acquires via
+# ``with`` statements, and which blocking operations it performs while
+# they may be held.  Collected here — alongside the other Summary facts,
+# so the transitive fixpoints ride the same call-graph edges — as RAW
+# (unresolved) records; identity resolution (which class attr is a Lock,
+# which module global) needs the project-wide attr tables that
+# concurrency.Model builds.
+
+#: blocking-call kinds recognized lexically (collectives are covered by
+#: Summary.collective/reaches_collective already)
+BLOCKING_KINDS = ("open", "wait", "join", "block_until_ready", "sleep")
+
+_MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "extend", "add", "update", "pop", "popleft",
+    "remove", "discard", "clear", "insert", "setdefault", "sort"})
+
+
+def _acquire_candidate(item: ast.withitem):
+    """("self"|"name", text, line) for a with-item whose context is a
+    bare name/attribute (locks are with-ed directly; context-manager
+    CALLS — tracer spans, nullcontext — are not lock acquisitions)."""
+    ctx = item.context_expr
+    if isinstance(ctx, ast.Attribute) and isinstance(ctx.value, ast.Name) \
+            and ctx.value.id == "self":
+        return ("self", ctx.attr, ctx.lineno)
+    if isinstance(ctx, ast.Name):
+        return ("name", ctx.id, ctx.lineno)
+    return None
+
+
+def _blocking_candidate(node: ast.Call):
+    """(kind, receiver-text, line) when `node` is a recognized blocking
+    call: file open, a no-timeout ``.wait()`` / ``.join()``, a jax
+    ``.block_until_ready()``, or ``time.sleep``."""
+    fn = node.func
+    if isinstance(fn, ast.Name) and fn.id == "open":
+        return ("open", "open", node.lineno)
+    if not isinstance(fn, ast.Attribute):
+        return None
+    recv = dotted_name(fn.value) or "<expr>"
+    if fn.attr == "block_until_ready":
+        return ("block_until_ready", recv, node.lineno)
+    if fn.attr in ("wait", "join") and not node.args and not node.keywords:
+        return (fn.attr, recv, node.lineno)
+    if fn.attr == "sleep" and recv == "time":
+        return ("sleep", recv, node.lineno)
+    return None
+
+
+def _concurrency_facts(fi, summary: Summary) -> None:
+    for node in _own_body_nodes(fi.node):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                cand = _acquire_candidate(item)
+                if cand is not None:
+                    summary.acquires_raw.append(cand)
+        elif isinstance(node, ast.Call):
+            cand = _blocking_candidate(node)
+            if cand is not None:
+                summary.blocking_raw.append(cand)
+
+
 def _is_latched_const(fi, direct_env) -> bool:
     """Zero-argument lru_cached env reader: reads once per process, so
     its value is a process constant (ops/dense._precision)."""
@@ -195,6 +265,7 @@ def summarize(proj) -> None:
         s.collective = _direct_collective(fi)
         s.env = _direct_env(proj, fi)
         s.latched_env = _is_latched_const(fi, s.env)
+        _concurrency_facts(fi, s)
         if s.collective:
             s.reaches_collective = (q, s.collective)
         if s.env and not s.latched_env:

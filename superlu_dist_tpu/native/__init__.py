@@ -18,11 +18,13 @@ import threading
 
 import numpy as np
 
+from superlu_dist_tpu.utils.lockwatch import make_lock
+
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "slu_host.cpp")
 _LIB = os.path.join(_HERE, "_slu_host.so")
 
-_lock = threading.Lock()
+_lock = make_lock("native._lock")
 _lib = None
 _tried = False
 

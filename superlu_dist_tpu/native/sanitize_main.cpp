@@ -42,6 +42,12 @@ void* slu_tree_attach_shared(void* creator_handle, i64 rank);
 void slu_tree_detach(void* h, const char* name, i64 unlink_seg);
 void slu_tree_bcast(void* h, i64 root, double* buf, i64 len);
 void slu_tree_reduce_sum(void* h, i64 root, double* buf, i64 len);
+void slu_tree_set_pid(void* h, i64 pid);
+i64 slu_tree_get_pid(void* h, i64 rank);
+void slu_tree_heartbeat(void* h);
+i64 slu_tree_get_heartbeat(void* h, i64 rank);
+i64 slu_tree_post(void* h, double* buf, i64 len);
+i64 slu_tree_peek(void* h, i64 rank, double* out, i64 len);
 }
 
 // 2-D 5-point Poisson pattern (symmetrized, with diagonal), CSR
@@ -212,6 +218,78 @@ int main() {
                    (long)nr);
       rc |= 1;
     }
+  }
+
+  // ---- heartbeat / bulletin-board / seqlock stress ----------------------
+  // The PR 8 failure-detector surface (pid + heartbeat atomics in the
+  // collective domain, wait-free post/peek seqlock on the .ftx board)
+  // had never been raced ON PURPOSE: Python-level analysis cannot see
+  // these atomics at all, so this is the one component whose
+  // thread-safety only a sanitizer run can certify.  8 threads as
+  // ranks, every rank concurrently: bumping its heartbeat, re-posting
+  // a monotonically-versioned 4-double record into its own board slot,
+  // and peeking every peer — asserting each snapshot is INTERNALLY
+  // CONSISTENT (all four doubles carry the same value; a torn read the
+  // seqlock failed to reject would mix versions).
+  {
+    const i64 nr = 8, kIters = 400;
+    char name[64];
+    std::snprintf(name, sizeof name, "/slu_tsan_ftx_%d", getpid());
+    void* root_h = slu_tree_attach(name, nr, 8, 0, 1);
+    if (!root_h) {
+      std::fprintf(stderr, "FAIL: ftx stress attach (creator)\n");
+      return rc | 1;
+    }
+    std::vector<char> fail(nr, 0);
+    auto body = [&](void* h, i64 r) {
+      slu_tree_set_pid(h, (i64)getpid() + r);
+      double rec[4], got[4];
+      for (i64 it = 1; it <= kIters; ++it) {
+        double v = (double)(r * 1000000 + it);
+        for (int j = 0; j < 4; ++j) rec[j] = v;
+        slu_tree_post(h, rec, 4);
+        slu_tree_heartbeat(h);
+        i64 peer = (r + it) % nr;
+        i64 ver = slu_tree_peek(h, peer, got, 4);
+        if (ver > 0 &&
+            (got[0] != got[1] || got[0] != got[2] || got[0] != got[3])) {
+          fail[r] = 1;   // torn snapshot slipped past the seqlock
+          return;
+        }
+        if (slu_tree_get_pid(h, peer) < 0) fail[r] = 1;
+        (void)slu_tree_get_heartbeat(h, peer);
+      }
+    };
+    std::vector<std::thread> ts;
+    for (i64 r = 1; r < nr; ++r)
+      ts.emplace_back([&, r]() {
+        void* h = slu_tree_attach_shared(root_h, r);
+        if (!h) {
+          fail[r] = 1;
+          return;
+        }
+        body(h, r);
+        slu_tree_detach(h, nullptr, 0);
+      });
+    body(root_h, 0);
+    for (auto& t : ts) t.join();
+    // every rank's final post must be readable, committed and exact
+    double got[4];
+    for (i64 r = 0; r < nr && rc == 0; ++r) {
+      i64 ver = slu_tree_peek(root_h, r, got, 4);
+      if (ver <= 0 || got[0] != (double)(r * 1000000 + kIters)) {
+        std::fprintf(stderr, "FAIL: board slot %ld ver=%ld val=%f\n",
+                     (long)r, (long)ver, got[0]);
+        rc |= 1;
+      }
+    }
+    slu_tree_detach(root_h, name, 1);
+    for (i64 r = 0; r < nr; ++r)
+      if (fail[r]) {
+        std::fprintf(stderr, "FAIL: ftx stress rank %ld (torn peek or "
+                             "attach)\n", (long)r);
+        rc |= 1;
+      }
   }
 
   if (rc == 0) std::puts("sanitize harness PASS");

@@ -1841,6 +1841,34 @@ i64 slu_tree_get_heartbeat(void* vh, i64 rank) {
   return (i64)h->slots[rank].hb.load(std::memory_order_acquire);
 }
 
+// The seqlock payload is copied element-wise through relaxed atomic
+// u64 accesses (bit patterns of the doubles): a reader's speculative
+// copy RACES the writer's store by design — the version check discards
+// torn snapshots — but with plain loads that race is undefined
+// behavior and a true ThreadSanitizer report (the classic seqlock
+// pitfall).  Atomic accesses make the race defined (any value may be
+// read; the seq re-check rejects inconsistent ones) and keep the TSan
+// gate (scripts/check_tsan_native.sh) meaningful for the REAL protocol
+// bugs.  BOARD_LEN is 4 doubles — the per-element cost is noise.
+static_assert(sizeof(double) == sizeof(uint64_t), "seqlock payload");
+
+static inline void seqlock_store(double* dst, const double* src, i64 len) {
+  auto* d = reinterpret_cast<std::atomic<uint64_t>*>(dst);
+  for (i64 i = 0; i < len; ++i) {
+    uint64_t bits;
+    std::memcpy(&bits, src + i, sizeof bits);
+    d[i].store(bits, std::memory_order_relaxed);
+  }
+}
+
+static inline void seqlock_load(double* dst, const double* src, i64 len) {
+  auto* s = reinterpret_cast<const std::atomic<uint64_t>*>(src);
+  for (i64 i = 0; i < len; ++i) {
+    uint64_t bits = s[i].load(std::memory_order_relaxed);
+    std::memcpy(dst + i, &bits, sizeof bits);
+  }
+}
+
 // Publish len doubles into my board slot.  Odd seq = write in progress,
 // even = committed; returns the committed version (>= 2).
 i64 slu_tree_post(void* vh, double* buf, i64 len) {
@@ -1850,7 +1878,8 @@ i64 slu_tree_post(void* vh, double* buf, i64 len) {
   double* my_buf = h->bufs + (size_t)h->rank * h->hdr->max_len;
   uint64_t s = mine.seq.load(std::memory_order_relaxed) & ~1ull;
   mine.seq.store(s + 1, std::memory_order_release);
-  std::memcpy(my_buf, buf, (size_t)len * sizeof(double));
+  std::atomic_thread_fence(std::memory_order_release);
+  seqlock_store(my_buf, buf, len);
   mine.seq.store(s + 2, std::memory_order_release);
   return (i64)(s + 2);
 }
@@ -1870,7 +1899,7 @@ i64 slu_tree_peek(void* vh, i64 rank, double* out, i64 len) {
       ::usleep(20);
       continue;
     }
-    std::memcpy(out, rb, (size_t)len * sizeof(double));
+    seqlock_load(out, rb, len);
     std::atomic_thread_fence(std::memory_order_acquire);
     if (rs.seq.load(std::memory_order_acquire) == s1) return (i64)s1;
   }

@@ -34,6 +34,7 @@ from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
 from superlu_dist_tpu.obs.metrics import get_metrics
 from superlu_dist_tpu.obs.trace import NULL_TRACER, get_tracer
 from superlu_dist_tpu.symbolic.symbfact import _front_flops
+from superlu_dist_tpu.utils.lockwatch import make_lock
 from superlu_dist_tpu.utils.options import env_flag, env_float, env_int
 
 #: Shape keys whose first (compiling) invocation the compile census has
@@ -69,10 +70,15 @@ class RetraceSentinel:
     def __init__(self):
         self.total = 0            # unexpected rebuilds, process-wide
         self.events = []          # (factory, builds), bounded window
+        # module-global sentinel, bumped from whichever thread ran the
+        # executor (a SolveServer dispatcher, a user thread, the
+        # scrubber's re-serve) — totals must not tear across them
+        self._lock = make_lock("stream.RetraceSentinel._lock")
 
     def record(self, factory: str, builds: int, tracer=None) -> None:
-        self.total += builds
-        self.events = (self.events + [(factory, int(builds))])[-32:]
+        with self._lock:
+            self.total += builds
+            self.events = (self.events + [(factory, int(builds))])[-32:]
         print(f"[SLU106] retrace sentinel: {builds} unexpected jit kernel "
               f"build(s) in {factory} after warmup — a cache-key input "
               "(env knob, mesh identity, dtype) changed mid-run; a warmed "

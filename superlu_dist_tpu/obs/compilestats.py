@@ -38,6 +38,8 @@ from __future__ import annotations
 
 import os
 import threading
+
+from superlu_dist_tpu.utils.lockwatch import make_lock
 import time
 from dataclasses import dataclass
 
@@ -68,7 +70,7 @@ class CompileStats:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("CompileStats._lock")
         self.records: list[CompileRecord] = []
         self._cache_dir: str | None = None
         self._cache_entries: int | None = None
@@ -154,19 +156,29 @@ class CompileStats:
                     for s, k in sorted(self._announced)]
 
     # ---- querying ------------------------------------------------------
+    # Export-path readers snapshot under the lock: a SolveServer
+    # dispatcher (or scrubber postmortem) records builds concurrently
+    # with a census/flightrec export, and an unlocked slice racing
+    # record()/_reset() tears the window (slulint SLU108's discipline,
+    # applied to this singleton by hand — it spawns no thread itself).
+    def _snap(self, since: int = 0) -> list:
+        with self._lock:
+            return list(self.records[since:])
+
     def marker(self) -> int:
         """Opaque position marker for windowed accounting."""
-        return len(self.records)
+        with self._lock:
+            return len(self.records)
 
     def total_seconds(self, since: int = 0) -> float:
-        return float(sum(r.seconds for r in self.records[since:]))
+        return float(sum(r.seconds for r in self._snap(since)))
 
     def census(self, since: int = 0) -> list[dict]:
         """Per-(site, key) aggregation of the records after ``since``,
         sorted by total seconds descending — the "which buckets dominate
         cold-compile" table."""
         agg: dict[tuple, dict] = {}
-        for r in self.records[since:]:
+        for r in self._snap(since):
             row = agg.get((r.site, r.key))
             if row is None:
                 row = agg[(r.site, r.key)] = {
@@ -188,7 +200,7 @@ class CompileStats:
         NOT serve from disk — the time spent actually COMPILING, which
         a bucket-set-keyed warm start drives to ~0 (``seconds`` keeps
         the first-invocation total: trace + lower + cache load)."""
-        recs = self.records[since:]
+        recs = self._snap(since)
         return {
             "builds": sum(r.builds for r in recs),
             "seconds": round(sum(r.seconds for r in recs), 4),

@@ -46,6 +46,7 @@ import time
 # safe one-way dependency: trace.py imports this module only lazily
 # (inside get_tracer), never at module load
 from superlu_dist_tpu.obs.trace import NULL_SPAN
+from superlu_dist_tpu.utils.lockwatch import make_lock
 
 
 class NullFlightRecorder:
@@ -128,7 +129,7 @@ class FlightRecorder:
         self.depth = depth
         self._ring = collections.deque(maxlen=depth)
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = make_lock("FlightRecorder._lock")
         self._stacks: dict[int, list] = {}
         # wall-clock anchor: monotonic span timestamps become absolute
         # times via unix ≈ anchor_unix + (ts_ns − anchor_perf_ns)/1e9 —
@@ -246,7 +247,7 @@ class FlightRecorder:
 # ---- process-global recorder ------------------------------------------------
 
 _flightrec = None
-_init_lock = threading.Lock()
+_init_lock = make_lock("obs.flightrec._init_lock")
 _FLAG_FALSE = ("", "0", "false", "no", "off")
 
 
@@ -303,7 +304,9 @@ def get_flightrec():
                 else:
                     _flightrec = FlightRecorder(
                         raw if _looks_like_path(raw) else None)
-                    _arm_sigterm(_flightrec)
+                    # the dump the call graph reaches runs in the
+                    # DEFERRED signal handler, not under this lock
+                    _arm_sigterm(_flightrec)  # slulint: disable=SLU109
             fr = _flightrec
     return fr
 

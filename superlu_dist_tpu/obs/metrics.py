@@ -38,6 +38,8 @@ import threading
 
 import numpy as np
 
+from superlu_dist_tpu.utils.lockwatch import make_lock
+
 #: Histogram bucket upper bounds (seconds-flavored log decades); the
 #: implicit +Inf bucket is always last.
 HIST_BUCKETS = (1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 1e-1, 1.0, 10.0, 100.0)
@@ -95,7 +97,7 @@ class Metrics:
     enabled = True
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("Metrics._lock")
         self._counters: dict[tuple, float] = {}
         self._gauges: dict[tuple, float] = {}
         # histogram: [count, sum, min, max, per-bucket counts]
@@ -233,7 +235,7 @@ class Metrics:
 # ---- process-global registry ----------------------------------------------
 
 _metrics = None
-_init_lock = threading.Lock()
+_init_lock = make_lock("obs.metrics._init_lock")
 
 
 def _looks_like_path(value: str) -> bool:

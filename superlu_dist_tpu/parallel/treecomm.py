@@ -362,6 +362,12 @@ class FailureDetector:
 
     def close(self, unlink: bool | None = None) -> None:
         self._hb_stop.set()
+        if self._hb_thread is not None:
+            # bounded join (SLU110): the daemon wakes from its
+            # stop-event wait immediately; never leave it racing the
+            # segment unmap below or interpreter teardown
+            self._hb_thread.join(2.0)
+            self._hb_thread = None
         if self._h:
             if unlink is None:
                 unlink = self._created

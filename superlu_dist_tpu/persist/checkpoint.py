@@ -41,6 +41,7 @@ import threading
 import numpy as np
 
 from superlu_dist_tpu.persist import serial
+from superlu_dist_tpu.utils.lockwatch import make_lock
 from superlu_dist_tpu.utils.errors import (
     CheckpointError, CheckpointMismatchError)
 
@@ -49,7 +50,7 @@ from superlu_dist_tpu.utils.errors import (
 # flight-recorder postmortems to reference)
 _ACTIVE: list = []
 _LAST_PATH: list = []
-_REG_LOCK = threading.Lock()
+_REG_LOCK = make_lock("persist.checkpoint._REG_LOCK")
 
 
 @dataclasses.dataclass
@@ -90,7 +91,7 @@ class FactorCheckpointer:
         self.tiny_base = 0            # tiny count carried in from a
                                       # resumed frontier (executor sets it)
         self._flushed_k = -1
-        self._lock = threading.Lock()
+        self._lock = make_lock("FactorCheckpointer._lock")
         self.last_path = None
         self.flushes = 0
         with _REG_LOCK:
@@ -114,13 +115,21 @@ class FactorCheckpointer:
                 lp, up = fronts[len(self._host)]
                 self._host.append((np.asarray(lp), np.asarray(up)))
             pool_np = np.asarray(pool)
+            # the flush lock exists to serialize exactly these
+            # bundle writes (interval flush vs breakdown/SIGTERM
+            # flush racing on one dirpath): the I/O IS the guarded
+            # operation, so the SLU109 hold-discipline findings on
+            # this block are intended behavior
             for g in range(k):
                 lp, up = self._host[g]
-                serial.write_array(self.dirpath, f"front_{g:05d}_l", lp,
-                                   self._entries, skip_existing=True)
-                serial.write_array(self.dirpath, f"front_{g:05d}_u", up,
-                                   self._entries, skip_existing=True)
-            serial.write_array(self.dirpath, "pool", pool_np, self._entries)
+                serial.write_array(  # slulint: disable=SLU109
+                    self.dirpath, f"front_{g:05d}_l", lp,
+                    self._entries, skip_existing=True)
+                serial.write_array(  # slulint: disable=SLU109
+                    self.dirpath, f"front_{g:05d}_u", up,
+                    self._entries, skip_existing=True)
+            serial.write_array(self.dirpath, "pool", pool_np,  # slulint: disable=SLU109
+                               self._entries)
             meta = {
                 "k": int(k),
                 "n_groups": self.n_groups,
@@ -130,8 +139,8 @@ class FactorCheckpointer:
                 "values_digest": self.values_fp,
                 "reason": reason,
             }
-            path = serial.write_manifest(self.dirpath, "factor_checkpoint",
-                                         meta, self._entries)
+            path = serial.write_manifest(  # slulint: disable=SLU109
+                self.dirpath, "factor_checkpoint", meta, self._entries)
             self._flushed_k = k
             self.flushes += 1
             self.last_path = path

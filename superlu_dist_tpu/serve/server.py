@@ -74,6 +74,7 @@ import time
 import numpy as np
 
 from superlu_dist_tpu.obs.metrics import get_metrics
+from superlu_dist_tpu.utils.lockwatch import make_condition, make_lock
 from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.solve.plan import bucket_nrhs
 from superlu_dist_tpu.utils.errors import (
@@ -258,8 +259,10 @@ class SolveServer:
                     "handle that carries lu.a (persist bundles do not)")
             self._berr_op = src.transpose() if self.trans else src
         self.source = "live"
-        self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
+        # instrumented under SLU_TPU_VERIFY_LOCKS=1 (utils/lockwatch):
+        # the condition shares the lock's identity — one mutex
+        self._lock = make_lock("SolveServer._lock")
+        self._cond = make_condition("SolveServer._cond", self._lock)
         # queue of [request, columns-already-taken] — a wide request
         # drains across batches without blocking narrower traffic
         self._queue: collections.deque = collections.deque()
@@ -314,8 +317,13 @@ class SolveServer:
                                                      load_lu)
         srv = cls(load_lu(dirpath), **kw)
         srv.source = str(dirpath)
-        srv._digests = bundle_front_digests(dirpath)
-        srv._digest_source = f"bundle {dirpath}"
+        # the scrubber thread (scrub_s > 0) is already live here: the
+        # digest re-base must happen under the lock it scans with
+        # (SLU108); hash outside, assign inside
+        digests = bundle_front_digests(dirpath)
+        with srv._lock:
+            srv._digests = digests
+            srv._digest_source = f"bundle {dirpath}"
         return srv
 
     def _make_solve(self, lu):
@@ -352,7 +360,10 @@ class SolveServer:
                 raise ServerClosedError("SolveServer is closed")
             if self._quarantine is not None:
                 q = self._quarantine
-                raise FactorCorruptError(q.groups, q.source, dump=False)
+                # dump=False: this re-raise of an already-reported
+                # quarantine performs NO postmortem I/O under the lock
+                raise FactorCorruptError(  # slulint: disable=SLU109
+                    q.groups, q.source, dump=False)
             now = time.perf_counter()
             self._expire_due_locked(now)
             if self._draining:
@@ -438,17 +449,26 @@ class SolveServer:
             self._cond.notify_all()
         return self
 
-    def close(self, timeout: float | None = None):
+    def close(self, timeout: float | None = 10.0):
         """Stop accepting work, drain the queue, join the dispatcher —
         then deliver :class:`ServerClosedError` to every ticket still
         undelivered (a never-started or dead dispatcher cannot strand a
-        waiter; the satellite fix for the submit/close race)."""
+        waiter; the satellite fix for the submit/close race).
+
+        The joins are BOUNDED by default (SLU110's canonical fix):
+        interpreter shutdown must never race a live daemon against
+        module teardown, so a wedged dispatcher is abandoned after
+        ``timeout`` (its queued tickets still get their structured
+        error) instead of hanging ``close()`` forever.  Pass
+        ``timeout=None`` to wait indefinitely."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         self._scrub_stop.set()
         if self._scrub_thread is not None:
-            self._scrub_thread.join(1.0)
+            self._scrub_thread.join(1.0 if timeout is None else
+                                    min(1.0, timeout))
+            self._scrub_thread = None
         if self._thread is not None:
             self._thread.join(timeout)
         if self._thread is None or not self._thread.is_alive():
@@ -489,8 +509,13 @@ class SolveServer:
                 f"swap() handle is n={int(lu.n)}, server is n={self.n} "
                 "— a swapped handle must factor the same-sized system")
         solve = self._make_solve(lu)
+        # the scrubber thread re-bases self._digests under the lock, so
+        # even this presence test must hold it (SLU108); the digest
+        # hashing itself stays OUTSIDE the lock (SLU109 hold discipline)
+        with self._lock:
+            rebase = self.scrub_s > 0 or self._digests is not None
         digests = None
-        if self.scrub_s > 0 or self._digests is not None:
+        if rebase:
             digests = (bundle_front_digests(source) if source is not None
                        else self._compute_digests(lu))
         berr_op = self._berr_op
@@ -547,13 +572,17 @@ class SolveServer:
                 m.inc("slu_serve_scrub_runs_total", 1.0)
             return []
         bad = [g for g, (c, b) in enumerate(zip(cur, base)) if c != b]
-        err = None
+        # construct (and flight-dump) the error OUTSIDE the lock: the
+        # postmortem write must not stall submit/dispatch on the server
+        # lock (SLU109 hold discipline).  A swap racing the scrub makes
+        # the dump a stale-handle artifact — rare, and still evidence.
+        err = (FactorCorruptError(bad, source=self._digest_source)
+               if bad else None)
         with self._cond:
             if epoch != self._handle_epoch:
                 return []    # swapped mid-scrub: the scan is stale
             self._scrub_runs += 1
-            if bad:
-                err = FactorCorruptError(bad, source=self._digest_source)
+            if err is not None:
                 self._quarantine = err
                 self._scrub_failures += 1
                 self._purge_queue_locked(lambda req: err)

@@ -279,6 +279,34 @@ class FactorCorruptError(SuperLUError):
             self.flightrec_dump = None
 
 
+class LockOrderError(SuperLUError):
+    """Lock-verify mode (``SLU_TPU_VERIFY_LOCKS=1``, slulint's runtime
+    rule SLU109 twin — ``utils/lockwatch.py``) detected a lock-order
+    inversion: this thread is about to acquire ``inner`` while holding
+    ``outer``, but the global order graph already records ``inner`` held
+    while ``outer`` was acquired (at ``inverse_site``).  Two threads
+    entering that cycle from different ends freeze forever; with
+    verification on, the acquisition raises HERE — before blocking —
+    naming both acquisition sites (the SLU106 deadlock-to-diagnosis
+    conversion, for threads instead of ranks).  Dumps a flight-recorder
+    postmortem at construction."""
+
+    def __init__(self, outer: str, inner: str, site: str,
+                 inverse_site: str):
+        self.outer = outer
+        self.inner = inner
+        self.site = site
+        self.inverse_site = inverse_site
+        super().__init__(
+            f"lock-order inversion (SLU109 runtime): acquiring "
+            f"`{inner}` while holding `{outer}` at {site}, but the "
+            f"inverse order `{inner}` -> `{outer}` was recorded at "
+            f"{inverse_site} — two threads entering this cycle from "
+            "different ends deadlock (this acquisition raised instead "
+            "of blocking; SLU_TPU_VERIFY_LOCKS=1)")
+        _flight_dump(self)
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

@@ -181,6 +181,13 @@ def _register_all() -> None:
       "(call-site, op, shape/dtype, seq) digest across ranks and raise "
       "CollectiveMismatchError instead of deadlocking (runtime SLU106)",
       group="parallel")
+    r("SLU_TPU_VERIFY_LOCKS", "flag", False,
+      "lock-order verify mode (utils/lockwatch.py): instrument every "
+      "make_lock/make_condition lock, record per-thread acquisition "
+      "stacks into a global order graph, and raise LockOrderError "
+      "naming both call sites on the first inversion instead of "
+      "deadlocking (runtime SLU109); feeds the slu_lock_hold_seconds "
+      "histogram when metrics are on", group="parallel")
     # --- rank-failure tolerance (parallel/recover.py, docs/RELIABILITY.md) --
     r("SLU_TPU_COMM_TIMEOUT_S", "float", 0.0,
       "bounded-wait collectives: every native tree leg's spin loop gets "

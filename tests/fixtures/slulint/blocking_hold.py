@@ -1,0 +1,19 @@
+"""SLU109 true-positive fixture (hold discipline): file I/O and a
+TreeComm collective inside a held lock stall every contender — and the
+collective can deadlock the whole rank fleet on one process's lock."""
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = []
+
+    def flush(self, path):
+        with self._lock:
+            with open(path, "w") as f:
+                f.write(repr(self._events))
+
+    def ship(self, tc, payload):
+        with self._lock:
+            return tc.bcast_any(payload)

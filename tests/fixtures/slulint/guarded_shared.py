@@ -1,0 +1,32 @@
+"""SLU108 clean negative: every cross-thread touch of self._count
+holds the owning lock; immutable-after-init state (self._interval) is
+read freely."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._count = 0
+        self._interval = 0.01
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            with self._lock:
+                self._bump_locked()
+
+    def _bump_locked(self):
+        self._count += 1
+
+    def stats(self):
+        with self._lock:
+            return self._count
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(1.0)

@@ -1,0 +1,22 @@
+"""SLU109 true-positive fixture: the two methods acquire the same two
+locks in opposite orders — two threads entering from different ends
+deadlock."""
+import threading
+
+
+class Pair:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.x = 0
+        self.y = 0
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                return self.x + self.y
+
+    def backward(self):
+        with self._b:
+            with self._a:
+                self.x += 1

@@ -1,0 +1,32 @@
+"""SLU109 clean negative: one global acquisition order (a before b),
+and the blocking work — file I/O, the collective — runs OUTSIDE the
+lock on a snapshot taken under it."""
+import threading
+
+
+class Flusher:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self._events = []
+
+    def nested(self):
+        with self._a:
+            with self._b:
+                return len(self._events)
+
+    def also_nested(self):
+        with self._a:
+            with self._b:
+                self._events.append(1)
+
+    def flush(self, path):
+        with self._a:
+            snapshot = list(self._events)
+        with open(path, "w") as f:
+            f.write(repr(snapshot))
+
+    def ship(self, tc, payload):
+        with self._a:
+            out = payload.copy()
+        return tc.bcast_any(out)

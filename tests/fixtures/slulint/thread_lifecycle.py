@@ -1,0 +1,21 @@
+"""SLU110 true-positive fixture: a daemon started in __init__ before
+its dependency exists, never joined, plus an event nothing ever waits
+on."""
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._unused = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        self._interval = 0.5
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            pass
+
+    def close(self):
+        self._stop.set()
+        self._unused.set()

@@ -1,0 +1,20 @@
+"""SLU110 clean negative: dependencies assigned before start(), the
+daemon joined with a bounded timeout, every event both set and
+waited."""
+import threading
+
+
+class Daemon:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._interval = 0.5
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(self._interval):
+            pass
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(1.0)

@@ -1,0 +1,28 @@
+"""SLU108 true-positive fixture: the worker thread writes self._count
+under the lock, but the public stats() read skips it — a cross-thread
+data race slulint must flag (and the clean twin guarded_shared.py must
+not)."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._count = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def start(self):
+        self._thread.start()
+
+    def _loop(self):
+        while not self._stop.wait(0.01):
+            with self._lock:
+                self._count += 1
+
+    def stats(self):
+        return self._count
+
+    def close(self):
+        self._stop.set()
+        self._thread.join(1.0)

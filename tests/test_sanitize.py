@@ -36,11 +36,14 @@ def _build_and_run(tmp_path, flag, name):
     if not _sanitizer_available(tmp_path, flag):
         pytest.skip(f"-fsanitize={flag} toolchain unavailable")
     exe = str(tmp_path / name)
-    r = subprocess.run(
-        ["g++", "-O1", "-g", f"-fsanitize={flag}", "-std=c++17",
-         "-pthread", os.path.join(NATIVE, "sanitize_main.cpp"),
-         os.path.join(NATIVE, "slu_host.cpp"), "-o", exe],
-        capture_output=True)
+    cmd = ["g++", "-O1", "-g", f"-fsanitize={flag}", "-std=c++17",
+           "-pthread", os.path.join(NATIVE, "sanitize_main.cpp"),
+           os.path.join(NATIVE, "slu_host.cpp"), "-o", exe]
+    r = subprocess.run(cmd, capture_output=True)
+    if r.returncode != 0:
+        # glibc < 2.34 keeps shm_open/shm_unlink in librt (the
+        # native/__init__.py production-build fallback)
+        r = subprocess.run(cmd + ["-lrt"], capture_output=True)
     assert r.returncode == 0, r.stderr.decode()
     out = subprocess.run([exe], capture_output=True, timeout=600)
     text = out.stdout.decode() + out.stderr.decode()
