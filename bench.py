@@ -122,6 +122,13 @@ def _watchdog():
         pending = COMPILE_STATS.pending()
         if pending:
             RESULT.setdefault("pending_kernels", pending)
+        _aud = COMPILE_STATS.audit_block()
+        if _aud["programs"]:
+            RESULT.setdefault("programs_audited", _aud["programs"])
+            RESULT.setdefault("donation_coverage_pct",
+                              _aud["donation_coverage_pct"])
+            RESULT.setdefault("baked_const_bytes",
+                              _aud["baked_const_bytes"])
         # durable frontier FIRST (persist/checkpoint.py): flush whatever
         # the factor loop completed, record the bundle path and its
         # resume eligibility in the row — the next BENCH run of this
@@ -552,6 +559,15 @@ def main():
                for r in COMPILE_STATS.records[_comp0:])
     if _xla:
         RESULT["xla_compile_seconds"] = round(_xla, 4)
+    # program-audit fields (SLU_TPU_VERIFY_PROGRAMS=1, slulint v4): how
+    # much of the executors' declared-dead input volume is donated and
+    # how many bytes the compiled programs bake as constants — the
+    # peak-memory and warm-start honesty axes of the IR-audit tier
+    _aud = COMPILE_STATS.audit_block()
+    if _aud["programs"]:
+        RESULT["programs_audited"] = _aud["programs"]
+        RESULT["donation_coverage_pct"] = _aud["donation_coverage_pct"]
+        RESULT["baked_const_bytes"] = _aud["baked_const_bytes"]
     tracer.complete("factor-compile", "phase", t_phase,
                     time.perf_counter() - t_phase,
                     kernels=ex.n_kernels, offload=ex.offload,

@@ -19,6 +19,15 @@ And the SLU109 runtime lock-order verifier (utils/lockwatch.py):
 * locks ON  — nested acquisitions land in the global order graph and
   the wrappers are the instrumented type.
 
+And the SLU111/SLU112/SLU114 program auditor (utils/programaudit.py):
+
+* programs OFF — a full factorization + device solve allocates NO
+  auditor state (``programaudit._AUDITOR is None``), performs no extra
+  tracing, and the compile census records no audit notes;
+* programs ON  — the auditor exists, every distinct program was audited
+  exactly once, and the census audit block reports full donation
+  coverage.
+
 Exit 0 = pass.  Gate contract (shared with run_slulint.sh,
 check_nan_guards.sh and check_trace_overhead.py — see
 scripts/ci_gates.sh): any regression raises/asserts, which exits
@@ -85,10 +94,47 @@ print(json.dumps({
 """
 
 
+PROG_CHILD = r"""
+import json, os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax
+jax.config.update("jax_enable_x64", True)
+import numpy as np
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.ordering.dispatch import get_perm_c
+from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+from superlu_dist_tpu.utils.options import Options
+from superlu_dist_tpu.numeric.plan import build_plan
+from superlu_dist_tpu.numeric.factor import numeric_factorize
+from superlu_dist_tpu.solve.device import DeviceSolver
+
+a = poisson2d(8)
+sym = symmetrize_pattern(a)
+sf = symbolic_factorize(sym, get_perm_c(Options(), a, sym))
+plan = build_plan(sf)
+fact = numeric_factorize(plan, sym.data[sf.value_perm], a.norm_max(),
+                         executor="stream")
+DeviceSolver(fact).solve(np.ones(plan.n))
+
+from superlu_dist_tpu.utils import programaudit
+from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+aud = programaudit._AUDITOR
+blk = COMPILE_STATS.audit_block()
+print(json.dumps({
+    "auditor": aud is not None,
+    "audited": len(aud.audited) if aud is not None else 0,
+    "census_programs": blk["programs"],
+    "coverage": blk["donation_coverage_pct"],
+}))
+"""
+
+
 def run_child(extra_env, code=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     for k in ("SLU_TPU_VERIFY_COLLECTIVES", "SLU_TPU_COMM_TIMEOUT_S",
-              "SLU_TPU_CHAOS", "SLU_TPU_VERIFY_LOCKS"):
+              "SLU_TPU_CHAOS", "SLU_TPU_VERIFY_LOCKS",
+              "SLU_TPU_VERIFY_PROGRAMS"):
         env.pop(k, None)
     env.update(extra_env)
     r = subprocess.run([sys.executable, "-c", code or CHILD], env=env,
@@ -121,6 +167,23 @@ def main():
         fail(f"lock verify mode missed the A->B edge: {lon['graph']}")
     print("check_verify_overhead: locks OK (off path plain+stateless; "
           "on path records the order graph)")
+
+    # ---- SLU111/112/114 program auditor ---------------------------------
+    poff = run_child({}, code=PROG_CHILD)
+    if poff["auditor"]:
+        fail("program-audit off-path allocated an auditor")
+    if poff["census_programs"] != 0:
+        fail(f"program-audit off-path left census audit notes: {poff}")
+    pon = run_child({"SLU_TPU_VERIFY_PROGRAMS": "1"}, code=PROG_CHILD)
+    if not pon["auditor"] or pon["audited"] == 0:
+        fail(f"program-audit verify mode audited nothing: {pon}")
+    if pon["census_programs"] != pon["audited"]:
+        fail(f"census audit notes disagree with the auditor: {pon}")
+    if pon["coverage"] != 100.0:
+        fail(f"executors' declared-dead buffers not fully donated: {pon}")
+    print(f"check_verify_overhead: programs OK (off path allocates no "
+          f"auditor; on path audited {pon['audited']} programs at "
+          f"{pon['coverage']}% donation coverage)")
 
     # ---- SLU106 collective lockstep verifier ----------------------------
     off = run_child({})

@@ -52,6 +52,19 @@
 #                   build of the native shared segment + a threaded
 #                   heartbeat/bulletin/seqlock stress; SKIPs loudly
 #                   (never silent-green) when the toolchain lacks TSan
+#   program-audit   scripts/check_program_audit.py    slulint v4 IR
+#                   rules over the REAL executors: every jitted program
+#                   (fused/stream/mega factor + device solve sweeps)
+#                   passes SLU111 donation, SLU112 baked-const and
+#                   SLU114 collective-lockstep audits under
+#                   SLU_TPU_VERIFY_PROGRAMS=1; donation coverage 100%,
+#                   baked const bytes 0
+#
+# Scan sharing: the slulint gate (and any other in-tree slulint
+# invocation) reads/writes the content-hash scan cache
+# (.slulint-cache.json, analysis/cache.py), so the tree is parsed and
+# dataflow-analyzed ONCE per content state — repeat gate invocations on
+# an unchanged tree are sub-second cache hits.
 #
 # Usage:  scripts/ci_gates.sh [gate ...]      (default: all gates)
 #         CI_GATE_TIMEOUT_S=900 scripts/ci_gates.sh
@@ -79,10 +92,11 @@ declare -A GATES=(
   [rank-failure]="python scripts/check_rank_failure.py"
   [compile-budget]="python scripts/compile_census.py --buckets 16 32 48 --stage"
   [tsan-native]="scripts/check_tsan_native.sh"
+  [program-audit]="python scripts/check_program_audit.py"
 )
-ORDER=(slulint verify-overhead schedule-equiv solve-equiv serve-robust
-       crash-resume rank-failure compile-budget tsan-native trace-overhead
-       nan-guards perf-regress)
+ORDER=(slulint program-audit verify-overhead schedule-equiv solve-equiv
+       serve-robust crash-resume rank-failure compile-budget tsan-native
+       trace-overhead nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

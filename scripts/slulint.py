@@ -3,10 +3,13 @@
 
 Kept as a script so the gates (run_slulint.sh / ci_gates.sh), editors,
 and pre-commit hooks have a stable path that works from any cwd.  See
-docs/ANALYSIS.md for the rule catalog (SLU101-SLU105 + SLU107-SLU110
-static, SLU106 + the SLU109 lock-order verifier runtime), the
-call-graph/dataflow engine, suppressions, and the baseline workflow
-(`--update-baseline` prunes fixed entries).
+docs/ANALYSIS.md for the rule catalog (SLU101-SLU105 + SLU107-SLU110 +
+SLU113 static; SLU106, the SLU109 lock-order verifier and the
+SLU111/112/114 program auditor runtime), the call-graph/dataflow
+engine (incl. the v4 device taint), the content-hash scan cache
+(`--no-cache` bypasses), SARIF output (`--format sarif`),
+suppressions, and the baseline workflow (`--update-baseline` prunes
+fixed entries).
 """
 
 import os

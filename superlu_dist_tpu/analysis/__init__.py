@@ -7,13 +7,28 @@ Rules:
   SLU104 env-knob registry        (rules_env.py)
   SLU105 jit-cache-key hygiene    (rules_trace.py, call-graph-aware)
   SLU107 jit-key shape diversity  (rules_trace.py)
+  SLU108 shared-mutable access    (rules_shared.py)
+  SLU109 lock-order discipline    (rules_lockorder.py)
+  SLU110 thread lifecycle         (rules_lifecycle.py)
+  SLU113 dispatch-loop host sync  (rules_program.py, device lattice)
   SLU106 runtime lockstep verify  (parallel/treecomm.py +
                                    numeric/stream.py retrace sentinel,
                                    env SLU_TPU_VERIFY_COLLECTIVES=1)
+  SLU109 runtime lock verify      (utils/lockwatch.py,
+                                   env SLU_TPU_VERIFY_LOCKS=1)
+  SLU111/SLU112/SLU114 IR audit   (program.py + rules_program.py over
+                                   closed jaxprs; runtime twin
+                                   utils/programaudit.py under
+                                   SLU_TPU_VERIFY_PROGRAMS=1 — donation
+                                   coverage, baked-const blowup, SPMD
+                                   collective lockstep)
 
 Engine: every scan first builds a package-wide call graph
 (callgraph.py) and per-function dataflow summaries over the
-{i32, rank, env} taint lattice (dataflow.py); rules consume both.
+{i32, rank, env, device} taint lattice (dataflow.py); rules consume
+both.  Scan results are cached content-hash-keyed (cache.py,
+.slulint-cache.json) so an unchanged tree rescans sub-second; the CLI
+emits text, JSON or SARIF 2.1.0 (sarif.py).
 
 CLI: ``python -m superlu_dist_tpu.analysis`` (scripts/slulint.py is the
 same entry; scripts/ci_gates.sh is the consolidated CI entry point).

@@ -37,11 +37,14 @@ def _build_parser():
                     "jit-cache-key hygiene SLU105, jit-key shape "
                     "diversity SLU107, shared-mutable access SLU108, "
                     "lock-order/hold-discipline SLU109, thread "
-                    "lifecycle SLU110; the SLU106 runtime twin lives "
-                    "in parallel/treecomm.py under "
+                    "lifecycle SLU110, dispatch-loop host round-trips "
+                    "SLU113; the SLU106 runtime twin lives in "
+                    "parallel/treecomm.py under "
                     "SLU_TPU_VERIFY_COLLECTIVES=1, the SLU109 runtime "
                     "twin in utils/lockwatch.py under "
-                    "SLU_TPU_VERIFY_LOCKS=1)")
+                    "SLU_TPU_VERIFY_LOCKS=1, and the program-level IR "
+                    "rules SLU111/SLU112/SLU114 in utils/programaudit.py "
+                    "under SLU_TPU_VERIFY_PROGRAMS=1)")
     p.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
                    help="files/directories to scan (default: the package, "
                         "scripts/, bench.py, examples/)")
@@ -63,8 +66,18 @@ def _build_parser():
                    help="restore the PR-3 lexical-only behavior (no call "
                         "graph, no taint propagation) — for measuring "
                         "what the interprocedural tier adds")
+    p.add_argument("--format", default=None, dest="fmt",
+                   choices=("text", "json", "sarif"),
+                   help="output format (default text; sarif = SARIF "
+                        "2.1.0 for PR-annotation tooling)")
     p.add_argument("--json", action="store_true", dest="as_json",
-                   help="machine-readable output")
+                   help="machine-readable output (alias of --format json)")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the content-hash scan cache "
+                        "(.slulint-cache.json) — reads AND writes")
+    p.add_argument("--cache", default=None,
+                   help="cache file path (default: .slulint-cache.json "
+                        "next to the repo root)")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule catalog and exit")
     return p
@@ -123,13 +136,31 @@ def main(argv=None) -> int:
         return 2
 
     sources = read_sources(args.paths)
-    project = None
-    if not args.no_dataflow:
-        from superlu_dist_tpu.analysis.callgraph import build_project
-        project = build_project(sources)
-    findings = []
-    for path, source in sources.items():
-        findings.extend(analyze_source(source, path, rules, project))
+    # incremental scan: a warm content-hash cache skips parse, call
+    # graph, dataflow AND rules for the unchanged tree (analysis/
+    # cache.py); a filtered rule set or the lexical tier bypasses it
+    # (the cache stores full-default-scan results only)
+    from superlu_dist_tpu.analysis import cache as sc
+    cache_path = args.cache or os.path.join(_REPO_ROOT,
+                                            sc.DEFAULT_CACHE_NAME)
+    use_cache = not (args.no_cache or args.rules or args.no_dataflow)
+    cache_state = "off"
+    findings = None
+    if use_cache:
+        findings = sc.lookup(cache_path, sources, rules)
+        if findings is not None:
+            cache_state = "hit"
+    if findings is None:
+        project = None
+        if not args.no_dataflow:
+            from superlu_dist_tpu.analysis.callgraph import build_project
+            project = build_project(sources)
+        findings = []
+        for path, source in sources.items():
+            findings.extend(analyze_source(source, path, rules, project))
+        if use_cache:
+            sc.store(cache_path, sources, rules, findings)
+            cache_state = "miss"
 
     baseline_path = args.baseline or os.path.join(
         _REPO_ROOT, bl.DEFAULT_BASELINE_NAME)
@@ -148,16 +179,23 @@ def main(argv=None) -> int:
         findings, baselined = bl.filter_new(findings, sources, entries,
                                             root=_REPO_ROOT)
 
-    if args.as_json:
+    fmt = args.fmt or ("json" if args.as_json else "text")
+    if fmt == "json":
         print(json.dumps({
             "findings": [vars(f) for f in findings],
-            "baselined": len(baselined)}, indent=1))
+            "baselined": len(baselined),
+            "cache": cache_state}, indent=1))
+    elif fmt == "sarif":
+        from superlu_dist_tpu.analysis.sarif import to_sarif
+        print(json.dumps(to_sarif(findings, rules,
+                                  baselined=len(baselined)), indent=1))
     else:
         for f in findings:
             print(f.render())
         tail = f" ({len(baselined)} baselined)" if baselined else ""
+        cached = f" [cache {cache_state}]" if cache_state != "off" else ""
         print(f"slulint: {len(findings)} finding(s){tail} in "
-              f"{len(sources)} file(s)")
+              f"{len(sources)} file(s){cached}")
     return 1 if findings else 0
 
 

@@ -32,6 +32,11 @@ import dataclasses
 import os
 import re
 
+#: bumped whenever the rule set / engine semantics change — part of the
+#: result-cache key (analysis/cache.py), so a stale cache can never
+#: serve findings computed by an older rule set
+ANALYSIS_VERSION = "4"
+
 
 @dataclasses.dataclass
 class Finding:
@@ -185,9 +190,11 @@ def default_rules() -> list:
     from superlu_dist_tpu.analysis.rules_lockorder import LockOrderRule
     from superlu_dist_tpu.analysis.rules_lifecycle import \
         ThreadLifecycleRule
+    from superlu_dist_tpu.analysis.rules_program import HostRoundTripRule
     return [CollectiveRule(), TracePurityRule(), IndexWidthRule(),
             EnvKnobRule(), JitCacheKeyRule(), JitKeyShapeDiversityRule(),
-            SharedMutableRule(), LockOrderRule(), ThreadLifecycleRule()]
+            SharedMutableRule(), LockOrderRule(), ThreadLifecycleRule(),
+            HostRoundTripRule()]
 
 
 def analyze_source(source: str, path: str, rules, project=None) -> list:

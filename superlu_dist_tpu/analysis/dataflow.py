@@ -41,6 +41,17 @@ from superlu_dist_tpu.analysis.core import dotted_name, is_env_read
 TAINT_I32 = "i32"
 TAINT_RANK = "rank"
 TAINT_ENV = "env"
+# v4 (rules_program.SLU113): a value living on the accelerator — the
+# result of a jnp/jax.numpy op or of CALLING a jitted program (a name
+# bound from a jit-factory result carries TAINT_JITFN; calling it yields
+# TAINT_DEVICE).  jax.device_get / jax.block_until_ready are the
+# sanctioned EXPLICIT syncs: their results are host-side (taint cleared).
+TAINT_DEVICE = "device"
+TAINT_JITFN = "jitfn"
+
+#: explicit host-materialization calls — the fix SLU113's hint asks for,
+#: so their results must not keep the device taint
+SYNC_CLEARERS = frozenset({"jax.device_get", "jax.block_until_ready"})
 
 #: TreeComm collective surface (rules_collective re-exports this).
 COLLECTIVE_METHODS = frozenset({
@@ -118,6 +129,11 @@ class Summary:
     collective: str | None = None       # direct witness "op at path:line"
     env: str | None = None              # direct witness
     latched_env: bool = False           # zero-arg lru_cached env reader
+    # v4: the function returns a jitted callable — `return jax.jit(f)`
+    # directly, a name bound from one, or (fixpointed over call edges)
+    # the result of calling another jit factory.  Calling such a return
+    # value produces device-resident outputs (TAINT_DEVICE).
+    returns_jit: bool = False
     # transitive: (qname of the function owning the witness, witness)
     reaches_collective: tuple | None = None
     reaches_env: tuple | None = None
@@ -171,6 +187,39 @@ def _direct_env(proj, fi) -> str | None:
                 return (f"{target.rsplit('.', 1)[-1]}(...) at "
                         f"{_site(fi.path, node)}")
     return None
+
+
+def _is_jit_call(node: ast.AST) -> bool:
+    """``jax.jit(...)`` / ``jit(...)`` / ``partial(jax.jit, ...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if dotted_name(fn) in ("jit", "jax.jit"):
+        return True
+    if dotted_name(fn) in ("partial", "functools.partial") and node.args:
+        return dotted_name(node.args[0]) in ("jit", "jax.jit")
+    return False
+
+
+def _returns_jit_direct(fi) -> bool:
+    """The function returns a jit object built in its own body: a
+    ``return jax.jit(step)`` or a return of a name assigned from one
+    (the ``fn = jax.jit(run); ...; return fn`` idiom)."""
+    jit_names = set()
+    for node in _own_body_nodes(fi.node):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    jit_names.add(t.id)
+    for node in _own_body_nodes(fi.node):
+        if not isinstance(node, ast.Return) or node.value is None:
+            continue
+        for sub in ast.walk(node.value):
+            if _is_jit_call(sub):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in jit_names:
+                return True
+    return False
 
 
 def _is_lru_decorated(fn) -> bool:
@@ -265,6 +314,7 @@ def summarize(proj) -> None:
         s.collective = _direct_collective(fi)
         s.env = _direct_env(proj, fi)
         s.latched_env = _is_latched_const(fi, s.env)
+        s.returns_jit = _returns_jit_direct(fi)
         _concurrency_facts(fi, s)
         if s.collective:
             s.reaches_collective = (q, s.collective)
@@ -289,6 +339,29 @@ def summarize(proj) -> None:
                         and cs.reaches_env is not None:
                     s.reaches_env = cs.reaches_env
                     changed = True
+
+    # jit-factory fixpoint: returning the RESULT of a call to a jit
+    # factory (stream._get_kernel -> _kernel -> jax.jit) is itself a
+    # jit factory — calling the returned value yields device arrays
+    changed = True
+    while changed:
+        changed = False
+        for q, fi in proj.functions.items():
+            s = sums[q]
+            if s.returns_jit:
+                continue
+            for node in _own_body_nodes(fi.node):
+                if not isinstance(node, ast.Return) or node.value is None:
+                    continue
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Call):
+                        cs = sums.get(proj.call_target(fi.path, sub))
+                        if cs is not None and cs.returns_jit:
+                            s.returns_jit = True
+                            changed = True
+                            break
+                if s.returns_jit:
+                    break
 
     # return-taint fixpoint (i32/rank/env through returns and call edges)
     for _ in range(4):
@@ -318,6 +391,7 @@ class FnFlow:
         self.env: dict = {}             # var -> {kind: provenance}
         self.assigns: dict = {}         # (line, col) -> (names, node, taints)
         self.returns: dict = {}         # {kind: provenance}
+        self.loop_depth = 0             # lexical For/While nesting (SLU113)
 
     @classmethod
     def for_function(cls, proj, fi):
@@ -384,7 +458,7 @@ class FnFlow:
             elif TAINT_I32 in rt and _const_like(node.left):
                 out[TAINT_I32] = rt[TAINT_I32]
             for t in (lt, rt):
-                for k in (TAINT_RANK, TAINT_ENV):
+                for k in (TAINT_RANK, TAINT_ENV, TAINT_DEVICE):
                     if k in t:
                         out.setdefault(k, t[k])
             return out
@@ -413,6 +487,32 @@ class FnFlow:
         return {}
 
     def _call_taint(self, node: ast.Call) -> dict:
+        t = self._call_taint_base(node)
+        name = dotted_name(node.func)
+        # ---- device lattice (SLU113) --------------------------------------
+        if name in SYNC_CLEARERS:
+            # explicit, sanctioned materialization: result is host-side
+            return {k: p for k, p in t.items() if k != TAINT_DEVICE}
+        if name.startswith("jnp.") or name.startswith("jax.numpy."):
+            t = dict(t)
+            t.pop(TAINT_JITFN, None)
+            t.setdefault(TAINT_DEVICE, f"`{name}(...)` at line {node.lineno}")
+            return t
+        if isinstance(node.func, ast.Name):
+            ct = self.env.get(node.func.id)
+            if ct and TAINT_JITFN in ct:
+                return {TAINT_DEVICE:
+                        f"result of jitted `{node.func.id}(...)` "
+                        f"({ct[TAINT_JITFN]})"}
+        target = self.resolve(node)
+        s = self.summaries.get(target) if target else None
+        if s is not None and s.returns_jit:
+            t = dict(t)
+            t.setdefault(TAINT_JITFN,
+                         f"`{name}(...)` builds a jitted program")
+        return t
+
+    def _call_taint_base(self, node: ast.Call) -> dict:
         env = is_env_read(node)
         if env is not None:
             return {TAINT_ENV: f"os.environ[{env[0]!r}]"}
@@ -481,15 +581,36 @@ class FnFlow:
             names = sorted(set(prev[0]) | set(names))
         self.assigns[key] = (names, node, taints)
 
+    def visit_stmt(self, st) -> None:
+        """Hook for rule subclasses: called once per statement, in
+        execution order, with the taint environment up to date (loop
+        bodies re-run for loop-carried taints, so dedupe by position)."""
+
     def _exec(self, stmts):
         for st in stmts:
             if isinstance(st, (ast.FunctionDef, ast.AsyncFunctionDef,
                                ast.ClassDef)):
                 continue
+            self.visit_stmt(st)
             if isinstance(st, ast.Assign):
                 t = self.taint(st.value)
                 for target in st.targets:
-                    self._bind(target, t)
+                    # tuple-unpacking a summarized return smears one
+                    # element's device taint over host scalars in the
+                    # same tuple (start, fronts, pool = helper());
+                    # Summary.return_taints is per-function, not
+                    # per-element, so stay false-negative-leaning and
+                    # drop DEVICE across such unpacks.  Direct jit-call
+                    # results keep it: every output of a jitted program
+                    # is a device value.
+                    if isinstance(target, (ast.Tuple, ast.List)) \
+                            and TAINT_DEVICE in t \
+                            and t[TAINT_DEVICE].startswith("return of "):
+                        t2 = {k: p for k, p in t.items()
+                              if k != TAINT_DEVICE}
+                        self._bind(target, t2)
+                    else:
+                        self._bind(target, t)
                 self._record(st.targets, st.value, t)
             elif isinstance(st, ast.AnnAssign) and st.value is not None:
                 t = self.taint(st.value)
@@ -510,12 +631,16 @@ class FnFlow:
                 self._exec(st.orelse)
             elif isinstance(st, (ast.For, ast.AsyncFor)):
                 self._bind(st.target, self.taint(st.iter))
+                self.loop_depth += 1
                 self._exec(st.body)
                 self._exec(st.body)       # loop-carried taints
+                self.loop_depth -= 1
                 self._exec(st.orelse)
             elif isinstance(st, ast.While):
+                self.loop_depth += 1
                 self._exec(st.body)
                 self._exec(st.body)
+                self.loop_depth -= 1
                 self._exec(st.orelse)
             elif isinstance(st, (ast.With, ast.AsyncWith)):
                 for item in st.items:

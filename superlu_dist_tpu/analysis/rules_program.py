@@ -1,0 +1,282 @@
+"""slulint v4 program rules — SLU111-SLU114.
+
+Three rules run over TRACED PROGRAMS (closed jaxprs, via
+``analysis/program.py`` and the ``SLU_TPU_VERIFY_PROGRAMS=1`` runtime
+twin in ``utils/programaudit.py``):
+
+SLU111 — donation/aliasing audit.  A large array input that the call
+site treats as DEAD after the call but does not donate forces XLA to
+allocate a fresh output buffer next to the still-live input — the
+Schur-pool/panel-stack pattern that doubles peak device memory exactly
+where it hurts (the pool IS the memory wall, numeric/plan.pool_size).
+The submitter declares its dead argnums (liveness is a caller fact the
+jaxpr cannot know); donation flags come off the traced program.  Also
+reports donation coverage % per program (donated bytes over
+declared-dead bytes).
+
+SLU112 — baked-constant blowup.  Consts embedded in a program above a
+size threshold are the per-matrix-capture pattern: a closure-captured
+index map or panel stack makes the compiled program IDENTIFY the matrix,
+so the PR 11 bucket-set warm start can never hit across matrices (and
+the constant is duplicated into every executable that bakes it).  Big
+data belongs in ARGUMENTS; the capturing call site is named via the
+existing callgraph when the auditor can find it.
+
+SLU114 — SPMD collective lockstep.  For programs containing collectives:
+every collective's axis names must exist on the mesh (or be bound by a
+nested shard_map), and every branching primitive's branches must execute
+the IDENTICAL collective (op, axes) sequence — under shard_map a traced
+predicate can differ per shard, so branch-divergent collectives are the
+in-program analog of ranks entering different TreeComm collectives.
+This is the static complement of runtime SLU106, ahead of the ROADMAP
+item 1 shard_map rewrite.
+
+One rule runs over SOURCE (part of the slulint CLI rule set):
+
+SLU113 — host round-trip in the dispatch loop.  Extends SLU102 beyond
+jit bodies: ``float()``/``int()``/``bool()``/``.item()``/``np.asarray``
+on a DEVICE value — or an ``if``/``while`` test on one — inside a
+per-group dispatch loop blocks the async dispatch stream once per group
+(the silent serializer of the streamed executors).  Found via the v2
+dataflow lattice's new ``device`` taint: results of jnp ops and of
+calling jitted programs (jit-factory results tracked through the call
+graph).  ``jax.device_get`` / ``jax.block_until_ready`` are the
+sanctioned EXPLICIT syncs and clear the taint — making the transfer
+visible is exactly the fix.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from superlu_dist_tpu.analysis.core import Finding, Rule, dotted_name
+from superlu_dist_tpu.analysis.dataflow import TAINT_DEVICE, FnFlow
+from superlu_dist_tpu.analysis.program import (ProgramSpec, aval_bytes,
+                                               bound_axis_names,
+                                               branch_divergences,
+                                               collective_sequence,
+                                               const_bytes, eqn_axes,
+                                               iter_eqns, COLLECTIVE_PRIMS)
+
+RULE_DONATION = "SLU111"
+RULE_BAKED_CONST = "SLU112"
+RULE_HOST_ROUNDTRIP = "SLU113"
+RULE_COLLECTIVE_LOCKSTEP = "SLU114"
+
+
+def _program_finding(rule: str, spec: ProgramSpec, message: str,
+                     hint: str) -> Finding:
+    # program findings anchor at a pseudo-path: there is no source line
+    # for a jaxpr, but the (site, label) pair identifies the build site
+    return Finding(rule, f"<program:{spec.site}[{spec.label}]>", 0, 1,
+                   message, hint)
+
+
+# --------------------------------------------------------------------------
+# SLU111 — donation/aliasing
+# --------------------------------------------------------------------------
+
+def audit_donation(spec: ProgramSpec, min_bytes: int):
+    """Findings for declared-dead inputs >= min_bytes not donated, plus
+    {donated_bytes, dead_bytes, donation_coverage_pct}."""
+    avals = spec.in_avals
+    donated = set(spec.donated)
+    dead = set(spec.dead)
+    donated_bytes = sum(aval_bytes(avals[i]) for i in donated
+                        if i < len(avals))
+    dead_bytes = sum(aval_bytes(avals[i]) for i in dead if i < len(avals))
+    findings = []
+    for i in sorted(dead - donated):
+        if i >= len(avals):
+            continue
+        nb = aval_bytes(avals[i])
+        if nb < min_bytes:
+            continue
+        findings.append(_program_finding(
+            RULE_DONATION, spec,
+            f"argument {i} ({getattr(avals[i], 'str_short', lambda: avals[i])()}"
+            f", {nb} bytes) is dead after the call but NOT donated — XLA "
+            "must materialize the output beside the still-live input, "
+            "doubling this buffer's peak footprint",
+            "donate dead large inputs (jax.jit(..., donate_argnums=...)) "
+            "so XLA writes in place — the Schur pool discipline of "
+            "stream._kernel"))
+    denom = max(donated_bytes + sum(
+        aval_bytes(avals[i]) for i in sorted(dead - donated)
+        if i < len(avals)), 1)
+    coverage = 100.0 if not dead else round(100.0 * donated_bytes / denom, 2)
+    return findings, {"donated_bytes": int(donated_bytes),
+                      "dead_bytes": int(dead_bytes),
+                      "donation_coverage_pct": coverage}
+
+
+# --------------------------------------------------------------------------
+# SLU112 — baked constants
+# --------------------------------------------------------------------------
+
+def audit_baked_consts(spec: ProgramSpec, max_bytes: int):
+    """Findings for consts >= max_bytes, plus {baked_const_bytes,
+    n_consts}."""
+    consts = list(getattr(spec.jaxpr, "consts", ()))
+    total = sum(const_bytes(c) for c in consts)
+    findings = []
+    for c in consts:
+        nb = const_bytes(c)
+        if nb < max_bytes:
+            continue
+        shape = getattr(c, "shape", ())
+        dtype = getattr(c, "dtype", "?")
+        findings.append(_program_finding(
+            RULE_BAKED_CONST, spec,
+            f"constant {tuple(shape)}:{dtype} ({nb} bytes) is BAKED into "
+            "the program — a closure-captured per-matrix array makes the "
+            "compiled program identify the matrix, defeating the "
+            "bucket-set warm start (and duplicating the data into every "
+            "executable that bakes it)",
+            "pass large arrays as ARGUMENTS instead of closing over them "
+            "(the make_factor_fn/_level_fn fix): program shapes may "
+            "encode buckets, program CONSTANTS must not encode matrices"))
+    return findings, {"baked_const_bytes": int(total),
+                      "n_consts": len(consts)}
+
+
+# --------------------------------------------------------------------------
+# SLU114 — SPMD collective lockstep
+# --------------------------------------------------------------------------
+
+def audit_collective_lockstep(spec: ProgramSpec):
+    seq = collective_sequence(spec.jaxpr)
+    if not seq and not any(
+            getattr(e.primitive, "name", "") in COLLECTIVE_PRIMS
+            for e in iter_eqns(spec.jaxpr)):
+        return []
+    findings = []
+    # (a) axis-name consistency against the mesh (+ nested binders)
+    valid = set(spec.mesh_axes) | bound_axis_names(spec.jaxpr)
+    if valid:
+        for eqn in iter_eqns(spec.jaxpr):
+            name = getattr(eqn.primitive, "name", "")
+            if name not in COLLECTIVE_PRIMS:
+                continue
+            bad = [a for a in eqn_axes(eqn) if a not in valid]
+            if bad:
+                findings.append(_program_finding(
+                    RULE_COLLECTIVE_LOCKSTEP, spec,
+                    f"collective `{name}` reduces over axis "
+                    f"{','.join(map(repr, bad))} which is bound by "
+                    f"neither the mesh ({sorted(valid)}) nor a nested "
+                    "shard_map — the program cannot run lockstep on the "
+                    "mesh it was built for",
+                    "collectives must name axes of the mesh the program "
+                    "is mapped over"))
+    # (b) identical collective sequence on every branch of every
+    # branching primitive (the static shard-divergence witness)
+    for eqn, seqs in branch_divergences(spec.jaxpr):
+        name = getattr(eqn.primitive, "name", "cond")
+        rendered = "; ".join(
+            f"branch {i}: {[f'{p}@{list(a)}' for p, a in s] or 'none'}"
+            for i, s in enumerate(seqs))
+        findings.append(_program_finding(
+            RULE_COLLECTIVE_LOCKSTEP, spec,
+            f"`{name}` branches execute DIVERGENT collective sequences "
+            f"({rendered}) — under shard_map the predicate can differ "
+            "per shard, so some shards enter a collective their peers "
+            "never reach (the in-program SLU106 deadlock)",
+            "hoist collectives out of data-dependent branches, or make "
+            "every branch run the identical collective sequence"))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# SLU113 — host round-trips in dispatch loops (source rule)
+# --------------------------------------------------------------------------
+
+_COERCIONS = frozenset({"float", "int", "bool"})
+_NP_MATERIALIZERS = frozenset({
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "onp.asarray", "onp.array"})
+
+
+class _DispatchFlow(FnFlow):
+    """FnFlow with the SLU113 in-loop coercion scan attached."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.hits: dict = {}     # (line, col) -> (anchor node, message)
+
+    def _device(self, expr) -> str | None:
+        t = self.taint(expr)
+        return t.get(TAINT_DEVICE)
+
+    def _scan_expr(self, expr) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            prov = None
+            what = None
+            if name in _COERCIONS and node.args:
+                prov = self._device(node.args[0])
+                what = f"`{name}()`"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item":
+                prov = self._device(node.func.value)
+                what = "`.item()`"
+            elif name in _NP_MATERIALIZERS and node.args:
+                prov = self._device(node.args[0])
+                what = f"`{name}`"
+            if prov is not None:
+                self._hit(node, what, prov)
+
+    def _hit(self, node, what, prov) -> None:
+        key = (node.lineno, node.col_offset)
+        if key not in self.hits:
+            self.hits[key] = (node, f"{what} on a device value ({prov}) "
+                              "inside the dispatch loop — a blocking "
+                              "host round-trip once per group, "
+                              "serializing the async dispatch stream")
+
+    def visit_stmt(self, st) -> None:
+        if self.loop_depth == 0:
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            prov = self._device(st.test)
+            if prov is not None:
+                self._hit(st.test, "bool-coercion of the branch test",
+                          prov)
+            self._scan_expr(st.test)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._scan_expr(item.context_expr)
+            return
+        if isinstance(st, ast.Try):
+            return
+        self._scan_expr(st)
+
+
+class HostRoundTripRule(Rule):
+    rule_id = RULE_HOST_ROUNDTRIP
+    title = "host-round-trip-in-dispatch-loop"
+    hint = ("keep the dispatch loop async: batch the value with the "
+            "stream and materialize AFTER the loop, or make the sync "
+            "explicit with jax.device_get / jax.block_until_ready "
+            "(explicit syncs are exempt — visibility is the point)")
+    package_dirs = ("numeric", "solve")
+
+    def check(self, tree, source, path, project=None):
+        if project is None:
+            return []
+        out = []
+        for qname, fi in project.functions.items():
+            if fi.path != path:
+                continue
+            flow = _DispatchFlow.for_function(project, fi)
+            flow.run()
+            for key in sorted(flow.hits):
+                node, msg = flow.hits[key]
+                out.append(self.finding(path, node, msg))
+        return out

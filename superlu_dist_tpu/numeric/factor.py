@@ -187,8 +187,12 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
     """Build the whole numeric factorization as ONE jittable function.
 
     Returns fn(avals, thresh) -> (fronts_tuple, tiny_count).  The plan's
-    index maps are closed over as device constants (hoisted to args by
-    jit).  If `mesh` is a jax.sharding.Mesh with axes ("snode", "panel"),
+    index maps are passed as PROGRAM ARGUMENTS (latched on the returned
+    wrapper), not closed over: a closure-captured device array becomes a
+    CONSTANT of the jaxpr, so the compiled program identifies the matrix
+    — the per-matrix-capture pattern slulint SLU112 polices, which
+    defeats cross-matrix program reuse and duplicates the maps into the
+    executable.  If `mesh` is a jax.sharding.Mesh with axes ("snode", "panel"),
     the dense factor math is sharded batch-over-"snode" and
     columns-over-"panel" — the 2D block-cyclic layout analog (SURVEY.md
     §2.4) — while every irregular scatter/gather is pinned replicated
@@ -213,21 +217,39 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
         pool_sharding = pool_spec(mesh, pool_partition)
         replicated = NamedSharding(mesh, P(None, None))
     arrays = [_group_arrays(grp) for grp in plan.groups]
+    # flatten the index maps into one static-layout argument list: the
+    # per-group child counts (ubs) are program STRUCTURE, the arrays are
+    # program INPUTS — so the jaxpr carries no per-matrix constants
+    # (slulint SLU112) and dead-input/donation accounting sees them
+    flat_args = []
+    child_meta = []
+    for (a_slot, a_flat, a_src, ws, off, children) in arrays:
+        flat_args.extend((a_slot, a_flat, a_src, ws, off))
+        child_meta.append(tuple(ub for ub, _, _, _ in children))
+        for (_, child_off, child_slot, rel) in children:
+            flat_args.extend((child_off, child_slot, rel))
+    flat_args = tuple(flat_args)
     # SLU_TPU_PIVOT_KERNEL resolved HERE, in the uncached factory, and
     # closed over as a constant — get_executor keys the fused executor on
     # it, and the traced body must not read env (slulint SLU102/SLU105)
     from superlu_dist_tpu.ops.dense import pivot_kernel
     pivot = pivot_kernel()
 
-    def fn(avals, thresh):
+    def fn(avals, thresh, *flat):
         avals = avals.astype(dtype)
         pool = jnp.zeros(plan.pool_size, dtype=dtype)
         if mesh is not None:
             pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
         fronts = []
         tiny = jnp.zeros((), jnp.int32)
-        for grp, (a_slot, a_flat, a_src, ws, off, children) in zip(
-                plan.groups, arrays):
+        i = 0
+        for grp, ubs in zip(plan.groups, child_meta):
+            a_slot, a_flat, a_src, ws, off = flat[i:i + 5]
+            i += 5
+            children = []
+            for ub in ubs:
+                children.append((ub, flat[i], flat[i + 1], flat[i + 2]))
+                i += 3
             packed, pool, t = group_step(
                 (grp.batch, grp.m, grp.w, grp.u), avals, pool, thresh,
                 a_slot, a_flat, a_src, ws, off, children,
@@ -259,12 +281,23 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
         tracer = get_tracer()
         cold = not built
         if not (tracer.enabled or cold):
-            return jfn(avals, thresh)
+            return jfn(avals, thresh, *flat_args)
         import time
 
         from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+        if cold:
+            # program audit (SLU_TPU_VERIFY_PROGRAMS=1): one abstract
+            # trace before the program first runs — no dead args (the
+            # caller may retain avals; the maps live on the executor)
+            from superlu_dist_tpu.utils.programaudit import maybe_audit
+            maybe_audit(
+                "make_factor_fn",
+                f"fused g{len(plan.groups)} {str(dtype)}", jfn,
+                (avals, thresh, *flat_args),
+                mesh_axes=tuple(mesh.axis_names) if mesh is not None
+                else ())
         t0 = time.perf_counter()
-        out = jfn(avals, thresh)
+        out = jfn(avals, thresh, *flat_args)
         t_issue = time.perf_counter() - t0
         if cold:
             built.append(True)

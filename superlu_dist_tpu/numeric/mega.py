@@ -180,6 +180,10 @@ class MegaExecutor(StreamExecutor):
             return fn
         jfn = _mega_kernel(*key, pivot)
         sds = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in args)
+        # program audit at AOT-stage time: a finding raises BEFORE the
+        # XLA compile below ever runs (SLU_TPU_VERIFY_PROGRAMS=1)
+        self._audit_program(self._census_site, self._census_label(key),
+                            jfn, sds)
         t0 = time.perf_counter()
         try:
             traced = jfn.trace(*sds)          # jax >= 0.4.31

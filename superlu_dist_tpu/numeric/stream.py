@@ -342,6 +342,17 @@ class StreamExecutor:
         unused here: stream kernels compile inside their first call)."""
         return _kernel(*key, self.mesh, self.pool_partition, pivot)
 
+    def _audit_program(self, site, label, fn, args) -> None:
+        """Submit one program to the runtime IR auditor
+        (SLU_TPU_VERIFY_PROGRAMS=1; no-op allocating nothing when off).
+        Argnum 1 is the Schur pool — threaded linearly through the
+        stream, dead after each call and donated by every kernel, which
+        is exactly what SLU111 verifies."""
+        from superlu_dist_tpu.utils.programaudit import maybe_audit
+        maybe_audit(site, label, fn, args, dead=(1,),
+                    mesh_axes=(tuple(self.mesh.axis_names)
+                               if self.mesh is not None else ()))
+
     def _census_pending(self, key, pivot) -> bool:
         """True when this step's FIRST invocation will build (and should
         be timed into the census by the call loop)."""
@@ -379,9 +390,21 @@ class StreamExecutor:
         return float(sum(_bucket_len(g.batch, 1) * _front_flops(g.w, g.u)
                          for g in self.plan.groups))
 
+    @staticmethod
+    def _level_flat(entries) -> tuple:
+        """One level's index maps + child tables flattened into the
+        program-argument tuple ``_level_fn`` expects (5 assembly arrays
+        then 3 per child set, per entry — the layout is static program
+        STRUCTURE, the arrays are program INPUTS)."""
+        return tuple(x for _, a, child_arrs, _, _ in entries
+                     for x in (*a, *child_arrs))
+
     def _level_fn(self, level, entries):
-        """One jitted program running every group of `level` (index maps
-        are closed over — jit hoists them to constants)."""
+        """One jitted program running every group of `level`.  The index
+        maps are passed as program ARGUMENTS (see _level_flat), not
+        closed over: a captured device array becomes a jaxpr CONSTANT,
+        so the compiled program identifies the matrix — the per-matrix-
+        capture pattern slulint SLU112 polices."""
         from superlu_dist_tpu.ops.dense import pivot_kernel
         pivot = pivot_kernel()    # resolved OUTSIDE the traced body: the
         fn = self._level_fns.get((level, pivot))   # choice is the cache
@@ -400,16 +423,23 @@ class StreamExecutor:
                                            P("snode", None, None))
             replicated = NamedSharding(self.mesh, P(None, None))
 
-        def run(avals, pool, thresh):
+        metas = tuple((key, len(child_arrs))
+                      for key, _, child_arrs, _, _ in entries)
+
+        def run(avals, pool, thresh, *flat):
             outs = []
             tiny = jnp.zeros((), jnp.int32)
-            for key, a, child_arrs, nreal, _host in entries:
+            i = 0
+            for key, n_child in metas:
                 (dims, l_a, child_shapes, _, _) = key
+                a = flat[i:i + 5]
+                child_arrs = flat[i + 5:i + 5 + n_child]
+                i += 5 + n_child
                 if psh is not None:
                     pool = jax.lax.with_sharding_constraint(pool, psh)
-                children = [(ub, child_arrs[3 * i], child_arrs[3 * i + 1],
-                             child_arrs[3 * i + 2])
-                            for i, (ub, _) in enumerate(child_shapes)]
+                children = [(ub, child_arrs[3 * j], child_arrs[3 * j + 1],
+                             child_arrs[3 * j + 2])
+                            for j, (ub, _) in enumerate(child_shapes)]
                 out, pool, t = group_step(
                     dims, avals, pool, thresh, *a, children,
                     front_sharding=front_sharding,
@@ -503,6 +533,10 @@ class StreamExecutor:
             # subclass AOT-builds inside _get_kernel instead and reports
             # the exact trace/lower/compile split there.
             cold = self._census_pending(key, pivot)
+            if cold:
+                self._audit_program(self._census_site,
+                                    self._census_label(key), kern,
+                                    (avals, pool, thresh, *a, *child_arrs))
             if self._progress and gi % self._progress == 0:
                 print(f"[stream] issuing group {gi}/{len(self._steps)} "
                       f"(+{time.perf_counter() - t_issue0:.1f}s)",
@@ -746,10 +780,15 @@ class StreamExecutor:
                 on_host_now = False
             n_fns = len(self._level_fns)
             fn = self._level_fn(level, entries)
+            flat = self._level_flat(entries)
             # a fresh jitted program means the next call compiles it —
             # account the build in the compile census (sync compile
             # inside the dispatch, execution stays async)
             cold = len(self._level_fns) > n_fns
+            if cold:
+                self._audit_program(
+                    "stream._level_fn", f"level{level} g{len(entries)}",
+                    fn, (avals, pool, thresh, *flat))
             if self._progress:
                 print(f"[stream] issuing level {level} "
                       f"({len(entries)} groups)", file=sys.stderr,
@@ -757,7 +796,7 @@ class StreamExecutor:
             tracer = self._tracer
             if cold or profile or tracer.enabled:
                 t0 = time.perf_counter()
-            outs, pool, t = fn(avals, pool, thresh)
+            outs, pool, t = fn(avals, pool, thresh, *flat)
             if cold:
                 COMPILE_STATS.record(
                     "stream._level_fn", f"level{level} g{len(entries)}",

@@ -82,6 +82,10 @@ class CompileStats:
         # kernels" with no record of which were left)
         self._announced: set = set()
         self._built: set = set()
+        # program-audit notes (utils/programaudit.py, SLU_TPU_VERIFY_
+        # PROGRAMS=1): per-(site, label) donation-coverage and
+        # baked-const-bytes stats — empty dict when auditing never ran
+        self._audits: dict = {}
 
     # ---- persistent-cache boundary (utils/jaxcache.py) -----------------
     def note_cache_dir(self, path: str | None) -> None:
@@ -155,6 +159,33 @@ class CompileStats:
             return [{"site": s, "key": k}
                     for s, k in sorted(self._announced)]
 
+    # ---- program-audit notes (slulint v4 runtime twin) -----------------
+    def audit_note(self, site: str, key: str, stats: dict) -> None:
+        """The program auditor reports one audited program's stats
+        (donation coverage %, baked const bytes, finding count)."""
+        with self._lock:
+            self._audits[(site, key)] = dict(stats)
+
+    def audit_block(self) -> dict:
+        """Aggregate program-audit stats for the stats.compile block and
+        the bench row: program count, donated/dead byte totals, overall
+        donation coverage %, total baked-const bytes."""
+        with self._lock:
+            audits = [dict(v) for v in self._audits.values()]
+        donated = sum(a.get("donated_bytes", 0) for a in audits)
+        dead = sum(a.get("dead_bytes", 0) for a in audits)
+        return {
+            "programs": len(audits),
+            "findings": sum(a.get("findings", 0) for a in audits),
+            "donated_bytes": int(donated),
+            "dead_bytes": int(dead),
+            "donation_coverage_pct": (
+                100.0 if dead == 0
+                else round(100.0 * donated / dead, 2)),
+            "baked_const_bytes": sum(a.get("baked_const_bytes", 0)
+                                     for a in audits),
+        }
+
     # ---- querying ------------------------------------------------------
     # Export-path readers snapshot under the lock: a SolveServer
     # dispatcher (or scrubber postmortem) records builds concurrently
@@ -201,7 +232,9 @@ class CompileStats:
         a bucket-set-keyed warm start drives to ~0 (``seconds`` keeps
         the first-invocation total: trace + lower + cache load)."""
         recs = self._snap(since)
+        audit = self.audit_block()
         return {
+            "program_audit": audit if audit["programs"] else None,
             "builds": sum(r.builds for r in recs),
             "seconds": round(sum(r.seconds for r in recs), 4),
             "fresh_seconds": round(sum(r.seconds for r in recs
@@ -218,6 +251,7 @@ class CompileStats:
         with self._lock:
             self.records = []
             self._announced = set()
+            self._audits = {}
 
 
 COMPILE_STATS = CompileStats()

@@ -49,6 +49,15 @@ from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.solve.plan import SolvePlan, build_solve_plan, chunk_nrhs
 
 
+def _audit_sweep(label: str, kern, args, dead) -> None:
+    """Submit one sweep program to the runtime IR auditor
+    (SLU_TPU_VERIFY_PROGRAMS=1; allocates nothing when off).  ``dead``
+    names the RHS/lsum argnums each sweep consumes — they are donated
+    by every kernel factory above, which is what SLU111 verifies."""
+    from superlu_dist_tpu.utils.programaudit import maybe_audit
+    maybe_audit("solve.device", label, kern, args, dead=dead)
+
+
 def _sweep_kernel_builds() -> int:
     """Total jitted-closure builds across the solve kernel factories —
     the compile-census marker for one solve's sweeps (a fresh closure's
@@ -510,9 +519,14 @@ class DeviceSolver:
                     rep = NamedSharding(self.mesh, P(None, None))
                     if self._replicate is None:
                         # cached: a fresh lambda per solve would miss
-                        # jax's trace cache on every IR correction solve
+                        # jax's trace cache on every IR correction solve.
+                        # The input re-shard buffer is dead after the
+                        # call — donate it so the replication aliases
+                        # instead of doubling the (n+1, kb) footprint
+                        # per chunk (slulint SLU111)
                         self._replicate = jax.jit(lambda a: a,
-                                                  out_shardings=rep)
+                                                  out_shardings=rep,
+                                                  donate_argnums=(0,))
                     x = jax.device_put(pad, rep)
                     lsum = jax.device_put(np.zeros_like(pad), rep)
                     x = sweeps(x, lsum, kb)
@@ -578,19 +592,31 @@ class DeviceSolver:
                 fwd, bwd = self._fused_trans_fns(kb, conj)
                 idx = [(firsts, rows, ws)
                        for _, firsts, rows, ws in self._groups]
+                _audit_sweep(f"fusedT-fwd n{self.n} k{kb}", fwd,
+                             (x, lsum, self.fronts, idx), dead=(0, 1))
                 x, lsum = fwd(x, lsum, self.fronts, idx)
+                _audit_sweep(f"fusedT-bwd n{self.n} k{kb}", bwd,
+                             (x, self.fronts, idx), dead=(0,))
                 return bwd(x, self.fronts, idx)
             # Uᵀ forward, sweep batches ascending
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     self._groups, self.fronts):
                 kern = _fwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
                                          kb, n1, str(dt), conj, leaf)
+                _audit_sweep(
+                    f"fwdT b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
+                    f"k{kb} n{self.n}", kern,
+                    (lp, up, x, lsum, firsts, rows, ws), dead=(2, 3))
                 x, lsum = kern(lp, up, x, lsum, firsts, rows, ws)
             # Lᵀ backward, descending
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     reversed(self._groups), reversed(self.fronts)):
                 kern = _bwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
                                          kb, n1, str(dt), conj, leaf)
+                _audit_sweep(
+                    f"bwdT b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
+                    f"k{kb} n{self.n}", kern,
+                    (lp, x, firsts, rows, ws), dead=(1,))
                 x = kern(lp, x, firsts, rows, ws)
             return x
 
@@ -609,7 +635,12 @@ class DeviceSolver:
                 fwd, bwd = self._fused_fns(kb)
                 idx = [(firsts, rows, ws)
                        for _, firsts, rows, ws in self._groups]
+                _audit_sweep(f"fused-fwd n{self.n} k{kb}", fwd,
+                             (x, lsum, self.fronts, idx, self._invs),
+                             dead=(0, 1))
                 x, lsum = fwd(x, lsum, self.fronts, idx, self._invs)
+                _audit_sweep(f"fused-bwd n{self.n} k{kb}", bwd,
+                             (x, self.fronts, idx, self._invs), dead=(0,))
                 return bwd(x, self.fronts, idx, self._invs)
             # forward in dispatch order (topological: every descendant's
             # batch precedes its ancestors' under either scheduler)
@@ -617,15 +648,23 @@ class DeviceSolver:
                     self._groups, self.fronts, self._invs):
                 kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
                                    str(dt), use_inv, leaf)
-                x, lsum = (kern(lp, x, lsum, firsts, rows, ws, linv)
-                           if use_inv else
-                           kern(lp, x, lsum, firsts, rows, ws))
+                args = ((lp, x, lsum, firsts, rows, ws, linv) if use_inv
+                        else (lp, x, lsum, firsts, rows, ws))
+                _audit_sweep(
+                    f"fwd b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
+                    f"k{kb} n{self.n}", kern, args, dead=(1, 2))
+                x, lsum = kern(*args)
             # backward, descending
             for (grp, firsts, rows, ws), (lp, up), (_, uinv) in zip(
                     reversed(self._groups), reversed(self.fronts),
                     reversed(self._invs)):
                 kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
                                    str(dt), use_inv, leaf)
+                _audit_sweep(
+                    f"bwd b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
+                    f"k{kb} n{self.n}", kern,
+                    (lp, up, x, firsts, rows, ws, uinv) if use_inv
+                    else (lp, up, x, firsts, rows, ws), dead=(2,))
                 x = (kern(lp, up, x, firsts, rows, ws, uinv) if use_inv
                      else kern(lp, up, x, firsts, rows, ws))
             return x

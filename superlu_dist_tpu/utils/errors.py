@@ -307,6 +307,34 @@ class LockOrderError(SuperLUError):
         _flight_dump(self)
 
 
+class ProgramAuditError(SuperLUError):
+    """Program-audit mode (``SLU_TPU_VERIFY_PROGRAMS=1``, slulint's
+    v4 IR rules SLU111/SLU112/SLU114 — ``utils/programaudit.py``)
+    rejected a jitted program at construction/AOT-stage time: a
+    declared-dead large input is not donated (peak-memory doubling,
+    SLU111), a per-matrix constant is baked into the program
+    (warm-start defeat, SLU112), or an SPMD program's branches execute
+    divergent collective sequences / name axes off the mesh (in-program
+    deadlock, SLU114).  Raised BEFORE the program ever runs — the same
+    verify-before-it-OOMs/deadlocks conversion SLU106/SLU109 apply at
+    runtime, moved to program-construction time.  ``findings`` holds the
+    slulint Finding records (rule id + program label + offending
+    eqn/arg); dumps a flight-recorder postmortem at construction."""
+
+    def __init__(self, site: str, program: str, findings):
+        self.site = site
+        self.program = program
+        self.findings = list(findings)
+        self.rules = sorted({f.rule for f in self.findings})
+        lines = "; ".join(f"{f.rule}: {f.message}" for f in self.findings)
+        super().__init__(
+            f"program audit failed for {site}[{program}] "
+            f"({', '.join(self.rules)}): {lines} "
+            "(SLU_TPU_VERIFY_PROGRAMS=1 — docs/ANALYSIS.md catalogs the "
+            "program rules)")
+        _flight_dump(self)
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

@@ -181,6 +181,15 @@ def _register_all() -> None:
       "(call-site, op, shape/dtype, seq) digest across ranks and raise "
       "CollectiveMismatchError instead of deadlocking (runtime SLU106)",
       group="parallel")
+    r("SLU_TPU_VERIFY_PROGRAMS", "flag", False,
+      "program-audit mode (utils/programaudit.py): every jitted "
+      "program the executors build is traced once at construction/"
+      "AOT-stage time and walked against the slulint v4 IR rules — "
+      "SLU111 donation/aliasing, SLU112 baked-constant blowup, SLU114 "
+      "SPMD collective lockstep — raising ProgramAuditError before the "
+      "program runs; feeds slu_program_audit_total and the compile "
+      "census's donation-coverage / baked-const-bytes fields",
+      group="parallel")
     r("SLU_TPU_VERIFY_LOCKS", "flag", False,
       "lock-order verify mode (utils/lockwatch.py): instrument every "
       "make_lock/make_condition lock, record per-thread acquisition "

@@ -1,0 +1,131 @@
+"""Runtime program auditor — the SLU111/SLU112/SLU114 twin
+(``SLU_TPU_VERIFY_PROGRAMS=1``, registered knob).
+
+Executors submit every jitted program ONCE at construction/AOT-stage
+time (stream/mega factor kernels, the fused ``make_factor_fn`` program,
+the ``solve/device.py`` sweep kernels); the auditor traces it abstractly
+(ShapeDtypeStructs — no device work, no compile), walks the closed
+jaxpr against the program rules in ``analysis/rules_program.py``, and
+raises a structured :class:`ProgramAuditError` (flight-recorder
+postmortem at construction) BEFORE the program ever runs — the
+"verify before it deadlocks/OOMs" discipline of SLU106/SLU109, moved to
+program-construction time.  Clean programs feed their donation-coverage
+and baked-const-bytes stats into the compile census
+(``obs/compilestats.py`` — surfaced in the ``stats.compile`` block and
+the bench row) plus the ``slu_program_audit_total`` metric.
+
+Off path (knob unset): :func:`get_auditor` returns ``None`` without
+allocating ANY auditor state — one env read per build site, nothing
+else (asserted by ``scripts/check_verify_overhead.py``).
+"""
+
+from __future__ import annotations
+
+from superlu_dist_tpu.utils.options import env_flag
+
+#: SLU111 only flags dead-but-not-donated inputs at least this large —
+#: small scalars/index vectors are not the peak-memory axis
+DONATE_MIN_BYTES = 1 << 20
+#: SLU112 flags baked consts at least this large — trace-time scalars
+#: (thresholds, iota tables) are not the per-matrix-capture pattern
+CONST_MAX_BYTES = 1 << 18
+
+_AUDITOR = None
+
+
+def get_auditor():
+    """The process-wide auditor, or None (allocating nothing) when
+    ``SLU_TPU_VERIFY_PROGRAMS`` is off."""
+    global _AUDITOR
+    if not env_flag("SLU_TPU_VERIFY_PROGRAMS"):
+        return None
+    if _AUDITOR is None:
+        _AUDITOR = ProgramAuditor()
+    return _AUDITOR
+
+
+def _reset() -> None:
+    """Test hygiene: drop the singleton so a knob flip re-latches."""
+    global _AUDITOR
+    _AUDITOR = None
+
+
+def find_build_site(site: str) -> str | None:
+    """Best-effort source location of a build site like
+    ``stream._kernel`` via the existing slulint callgraph — used to name
+    the CAPTURING call site in SLU112 reports.  Only runs on the error
+    path (it parses the package), never on clean audits."""
+    import os
+    try:
+        from superlu_dist_tpu.analysis.callgraph import build_project
+        from superlu_dist_tpu.analysis.core import read_sources
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        fn_name = site.rsplit(".", 1)[-1]
+        proj = build_project(read_sources([pkg]))
+        for qname, fi in proj.functions.items():
+            if qname.rsplit(".", 1)[-1] == fn_name:
+                return f"{fi.path}:{fi.node.lineno}"
+    except Exception:
+        pass
+    return None
+
+
+class ProgramAuditor:
+    """Audits each (site, label) program once; results memoized so the
+    lazy build paths (stream kernels compile inside their first
+    dispatch) pay one trace per distinct program, ever."""
+
+    def __init__(self, donate_min_bytes: int = DONATE_MIN_BYTES,
+                 const_max_bytes: int = CONST_MAX_BYTES):
+        self.donate_min_bytes = int(donate_min_bytes)
+        self.const_max_bytes = int(const_max_bytes)
+        self.audited: dict = {}     # (site, label) -> stats dict
+        self.findings: list = []    # every finding ever raised (evidence)
+
+    def submit(self, site: str, label: str, fn, args, *, dead=(),
+               donated=None, mesh_axes=()) -> dict:
+        """Trace + audit one program; raises ProgramAuditError on any
+        finding, returns the stats dict when clean.  ``dead`` declares
+        the argnums the CALL SITE treats as dead after the call (the
+        liveness fact the jaxpr cannot know); ``donated`` overrides the
+        auto-detected donation flags (rarely needed)."""
+        key = (site, label)
+        hit = self.audited.get(key)
+        if hit is not None:
+            return hit
+        from superlu_dist_tpu.analysis.program import audit_spec, trace_spec
+        spec = trace_spec(fn, args, label=label, site=site, dead=dead,
+                          donated=donated, mesh_axes=mesh_axes)
+        findings, stats = audit_spec(spec, self.donate_min_bytes,
+                                     self.const_max_bytes)
+        from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+        COMPILE_STATS.audit_note(site, label, stats)
+        from superlu_dist_tpu.obs.metrics import get_metrics
+        m = get_metrics()
+        if m.enabled:
+            m.inc("slu_program_audit_total", 1.0, site=site,
+                  result="finding" if findings else "clean")
+        if findings:
+            self.findings.extend(findings)
+            if any(f.rule == "SLU112" for f in findings):
+                src = find_build_site(site)
+                if src:
+                    for f in findings:
+                        if f.rule == "SLU112":
+                            f.message += (f" (capturing build site: "
+                                          f"{src})")
+            from superlu_dist_tpu.utils.errors import ProgramAuditError
+            raise ProgramAuditError(site=site, program=label,
+                                    findings=findings)
+        self.audited[key] = stats
+        return stats
+
+
+def maybe_audit(site: str, label: str, fn, args, *, dead=(),
+                donated=None, mesh_axes=()) -> dict | None:
+    """One-line build-site hook: no-op (no state) when the knob is off."""
+    aud = get_auditor()
+    if aud is None:
+        return None
+    return aud.submit(site, label, fn, args, dead=dead, donated=donated,
+                      mesh_axes=mesh_axes)

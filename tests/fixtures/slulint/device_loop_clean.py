@@ -1,0 +1,33 @@
+"""slulint fixture: the SLU113-clean twin of host_roundtrip_loop.py.
+
+Same dispatch-loop shape, but the loop stays async: device results are
+accumulated on the device, explicit syncs go through jax.device_get /
+jax.block_until_ready (the sanctioned idiom — visibility is the point),
+and all host coercions happen AFTER the loop.
+"""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel(w):
+    def step(x):
+        return x * 2.0
+
+    return jax.jit(step)
+
+
+def dispatch(xs):
+    ys = []
+    for x in xs:
+        kern = _kernel(8)
+        y = kern(x)
+        ys.append(y)                          # stays async
+        probe = jax.device_get(y)             # explicit sync: exempt
+        if probe[0] > 0:                      # host value: clean
+            ys[-1] = y
+    total = float(np.asarray(jax.block_until_ready(ys[-1]))[0])
+    return ys, total

@@ -1,0 +1,35 @@
+"""slulint fixture: SLU113 host round-trips inside a dispatch loop.
+
+The dispatch loop calls a jitted kernel per group and then coerces the
+device result on the host EVERY iteration — a blocking D2H round-trip
+per group that serializes the async dispatch stream.  slulint v4's
+device taint (dataflow lattice) must flag all three round-trip shapes:
+float() coercion, np.asarray materialization, and the bool-coercion of
+an `if` test on a device value.
+"""
+
+import functools
+
+import jax
+import numpy as np
+
+
+@functools.lru_cache(maxsize=None)
+def _kernel(w):
+    def step(x):
+        return x * 2.0
+
+    return jax.jit(step)
+
+
+def dispatch(xs):
+    out = []
+    total = 0.0
+    for x in xs:
+        kern = _kernel(8)
+        y = kern(x)
+        total += float(y[0])          # flagged: float() on device value
+        host = np.asarray(y)          # flagged: implicit D2H per group
+        if y[0] > 0:                  # flagged: bool-coercion of device test
+            out.append(host)
+    return out, total
