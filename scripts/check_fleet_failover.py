@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Fleet-failover gate: the serving fleet's headline robustness
+guarantee, proven end to end on process replicas (the real kill -9
+failure domain).
+
+Three phases over a gallery of ≥8 distinct matrices persisted as
+sha256-manifested bundles (CPU, tens of seconds):
+
+1. **Undisturbed baseline** — 3 process replicas serve a deterministic
+   mixed stream; every ticket's X is recorded.
+
+2. **kill -9 mid-stream, zero loss** — the same fleet and stream with
+   ``SLU_TPU_CHAOS=kill_replica=1@batch=2`` arming a REAL SIGKILL of
+   replica 1's process before its 3rd accepted batch: the failover
+   must re-route every accepted-but-undelivered ticket (failovers ≥ 1,
+   reroutes ≥ 1), ZERO tickets may be lost or errored, and every
+   delivered X must be **bitwise identical** to the undisturbed run —
+   the idempotent-retry-token contract.
+
+3. **Rolling deploy, zero dropped + poisoned rollback** — under live
+   traffic, ``fleet.deploy`` rolls a fresh (identical) factorization
+   across every replica with zero dropped/errored tickets and
+   bitwise-unchanged answers; then a POISONED bundle (NaN front) must
+   be rejected with ``DeployRollbackError`` — via the preflight canary
+   with zero replica exposure, and via the per-replica canary (
+   ``preflight=False``) with every already-swapped replica restored —
+   after which the fleet still serves the original X bitwise.
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
+entry point).  Gate contract (shared with the other gates): any
+regression — a lost ticket, a drifted X, a hang, a deploy dropping
+work, a poisoned bundle surviving its canary — raises/asserts, which
+exits non-zero with the diagnostic on stderr.
+"""
+
+import os
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+N_MATRICES = 8
+N_TICKETS = 32
+N_REPLICAS = 3
+
+
+def _bundles(tmp):
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.persist.serial import save_lu
+    from superlu_dist_tpu.utils.options import IterRefine, Options
+
+    paths, mats, lus = {}, {}, {}
+    for i in range(N_MATRICES):
+        a = poisson2d(5 + i)            # 8 distinct systems
+        x, lu, stats, info = gssvx(
+            Options(iter_refine=IterRefine.NOREFINE), a,
+            np.ones(a.n_rows))
+        assert info == 0, f"factorization {i} failed: info={info}"
+        d = os.path.join(tmp, f"m{i}")
+        save_lu(lu, d)
+        paths[f"m{i}"] = d
+        mats[f"m{i}"] = a
+        lus[f"m{i}"] = lu
+    return paths, mats, lus
+
+
+def _stream(fleet, mats, keys):
+    rng = np.random.default_rng(7)
+    tickets = []
+    for j in range(N_TICKETS):
+        key = keys[j % len(keys)]
+        a = mats[key]
+        b = a.matvec(rng.standard_normal(a.n_rows))
+        tickets.append(fleet.submit(key, b))
+    return [t.result(300) for t in tickets]
+
+
+def _run(paths, mats, chaos=None):
+    from superlu_dist_tpu.serve import FleetRouter
+
+    if chaos:
+        os.environ["SLU_TPU_CHAOS"] = chaos
+    else:
+        os.environ.pop("SLU_TPU_CHAOS", None)
+    fleet = FleetRouter(paths, n_replicas=N_REPLICAS, kind="process")
+    try:
+        xs = _stream(fleet, mats, sorted(paths))
+        return xs, fleet.stats()
+    finally:
+        fleet.close()
+        os.environ.pop("SLU_TPU_CHAOS", None)
+
+
+def check_kill9_zero_loss(paths, mats):
+    ref, st0 = _run(paths, mats)
+    assert st0["errors"] == 0 and st0["delivered"] == N_TICKETS, st0
+    assert st0["failovers"] == 0, "baseline run lost a replica"
+    got, st1 = _run(paths, mats, chaos="kill_replica=1@batch=2")
+    assert st1["failovers"] >= 1, (
+        "the kill -9 injection never fired — the gate is not "
+        f"exercising failover (stats: {st1})")
+    assert 1 in st1["replicas_failed"], st1["replicas_failed"]
+    assert st1["errors"] == 0, (
+        f"{st1['errors']} ticket(s) errored across the failover — the "
+        "zero-loss contract is broken")
+    assert st1["delivered"] == N_TICKETS, (
+        f"only {st1['delivered']}/{N_TICKETS} tickets delivered — "
+        "accepted work was LOST")
+    drift = [i for i, (r, g) in enumerate(zip(ref, got))
+             if not np.array_equal(r, g)]
+    assert not drift, (
+        f"ticket(s) {drift} are not bitwise identical to the "
+        "undisturbed run — re-routing changed the arithmetic")
+    print(f"  kill -9 of replica 1 mid-stream: {N_TICKETS}/{N_TICKETS} "
+          f"delivered, {st1['reroutes']} re-routed, all bitwise "
+          "identical to the undisturbed run")
+
+
+def check_rolling_deploy(paths, mats, lus, tmp):
+    import threading
+
+    from superlu_dist_tpu.persist.serial import save_lu
+    from superlu_dist_tpu.serve import DeployRollbackError, FleetRouter
+    from superlu_dist_tpu.utils.errors import SuperLUError
+
+    key = "m0"
+    a = mats[key]
+    good2 = os.path.join(tmp, "m0_v2")
+    save_lu(lus[key], good2)            # identical refresh bundle
+    lu_bad = lus[key]
+    lp, up = lu_bad.numeric.fronts[0]
+    lu_bad.numeric.fronts[0] = (np.asarray(lp) * np.nan, up)
+    bad = os.path.join(tmp, "m0_bad")
+    save_lu(lu_bad, bad)
+
+    os.environ.pop("SLU_TPU_CHAOS", None)
+    fleet = FleetRouter({key: paths[key]}, n_replicas=N_REPLICAS,
+                        kind="process")
+    try:
+        b = a.matvec(np.ones(a.n_rows))
+        ref = fleet.solve(key, b, timeout=300)
+        stop = threading.Event()
+        outcomes = []
+        lock = threading.Lock()
+
+        def client():
+            while not stop.is_set():
+                try:
+                    x = fleet.solve(key, b, timeout=300)
+                    tag = ("ok" if np.array_equal(x, ref)
+                           else "DRIFT")
+                except Exception as e:  # noqa: BLE001 — tallied
+                    tag = type(e).__name__
+                with lock:
+                    outcomes.append(tag)
+
+        th = threading.Thread(target=client)
+        th.start()
+        try:
+            out = fleet.deploy(good2)
+        finally:
+            stop.set()
+            th.join(60)
+        assert not th.is_alive(), "deploy-window client hung"
+        assert len(out["replicas_swapped"]) == N_REPLICAS, out
+        assert outcomes and set(outcomes) == {"ok"}, (
+            f"tickets dropped/errored/drifted during the rolling "
+            f"deploy: {outcomes}")
+        st = fleet.stats()
+        assert st["deploys"] == 1 and st["errors"] == 0, st
+        print(f"  rolling deploy over {N_REPLICAS} replicas: "
+              f"{len(outcomes)} tickets served during the roll, zero "
+              "dropped, zero drifted")
+
+        # poisoned bundle, preflight gate: zero replica exposure
+        try:
+            fleet.deploy(bad)
+            raise AssertionError(
+                "poisoned bundle survived the preflight canary")
+        except DeployRollbackError as e:
+            assert e.stage == "canary" and e.rolled_back == [], e
+        # poisoned bundle, per-replica gate: swapped replicas restored
+        try:
+            fleet.deploy(bad, preflight=False)
+            raise AssertionError(
+                "poisoned bundle survived the per-replica canary")
+        except DeployRollbackError as e:
+            assert e.stage == "canary" and e.rolled_back == [0], e
+        except SuperLUError as e:       # pragma: no cover — diagnostics
+            raise AssertionError(
+                f"unexpected deploy failure shape: {e}")
+        assert fleet.stats()["rollbacks"] == 2
+        got = fleet.solve(key, b, timeout=300)
+        assert np.array_equal(ref, got), (
+            "the fleet does not serve the original factors bitwise "
+            "after the rollback")
+        print("  poisoned bundle: preflight rejected with zero "
+              "exposure; per-replica canary rolled replica 0 back; "
+              "original X still served bitwise")
+    finally:
+        fleet.close()
+
+
+def main():
+    with tempfile.TemporaryDirectory() as tmp:
+        print(f"fleet-failover gate: building {N_MATRICES} bundles")
+        paths, mats, lus = _bundles(tmp)
+        print(f"fleet-failover gate: kill -9 zero-loss "
+              f"({N_REPLICAS} process replicas, {N_TICKETS} tickets, "
+              f"{N_MATRICES} matrices)")
+        check_kill9_zero_loss(paths, mats)
+        print("fleet-failover gate: rolling deploy + poisoned rollback")
+        check_rolling_deploy(paths, mats, lus, tmp)
+    print("fleet-failover gate: OK")
+
+
+if __name__ == "__main__":
+    main()

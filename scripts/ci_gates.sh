@@ -59,6 +59,14 @@
 #                   SLU114 collective-lockstep audits under
 #                   SLU_TPU_VERIFY_PROGRAMS=1; donation coverage 100%,
 #                   baked const bytes 0
+#   fleet-failover  scripts/check_fleet_failover.py   serving fleet:
+#                   3 process replicas serving a mixed ≥8-matrix
+#                   stream, kill -9 of one replica mid-stream loses
+#                   zero accepted tickets with every delivered X
+#                   bitwise vs an undisturbed run; a rolling deploy
+#                   completes under traffic with zero dropped tickets
+#                   and a poisoned bundle rolls back (preflight +
+#                   per-replica canary)
 #
 # Scan sharing: the slulint gate (and any other in-tree slulint
 # invocation) reads/writes the content-hash scan cache
@@ -93,10 +101,11 @@ declare -A GATES=(
   [compile-budget]="python scripts/compile_census.py --buckets 16 32 48 --stage"
   [tsan-native]="scripts/check_tsan_native.sh"
   [program-audit]="python scripts/check_program_audit.py"
+  [fleet-failover]="python scripts/check_fleet_failover.py"
 )
 ORDER=(slulint program-audit verify-overhead schedule-equiv solve-equiv
-       serve-robust crash-resume rank-failure compile-budget tsan-native
-       trace-overhead nan-guards perf-regress)
+       serve-robust fleet-failover crash-resume rank-failure
+       compile-budget tsan-native trace-overhead nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

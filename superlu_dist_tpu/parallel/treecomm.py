@@ -222,6 +222,26 @@ def _is_zombie(pid: int) -> bool:
         return False
 
 
+def pid_alive(pid: int) -> bool:
+    """The process-liveness verdict of the failure detector, factored
+    out so the serving fleet (serve/fleet.py) judges replica processes
+    by the SAME discipline it judges ranks: ``kill(pid, 0)`` raising
+    ``ProcessLookupError`` is death, EPERM is alive, and an unreaped
+    ZOMBIE (dead child the detecting parent has not waited on) counts
+    as dead for every communication purpose.  A pid that is merely
+    slow ALWAYS answers alive — slow-not-dead is decided here, nowhere
+    else."""
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    return not _is_zombie(pid)
+
+
 class FailureDetector:
     """Per-rank heartbeat + pid liveness + the ``.ftx`` agreement board.
 
@@ -323,14 +343,7 @@ class FailureDetector:
             p = self.pid(r)
             if p <= 0:
                 continue
-            try:
-                os.kill(p, 0)
-            except ProcessLookupError:
-                out.add(r)
-                continue
-            except PermissionError:
-                continue
-            if _is_zombie(p):
+            if not pid_alive(p):
                 out.add(r)
         return out
 

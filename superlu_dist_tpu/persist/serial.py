@@ -349,8 +349,15 @@ def lu_meta(dirpath: str) -> dict:
     """Manifest meta block of a persisted LU handle — a cheap peek (no
     array reads, no digest work) so a serving process can size queues
     and validate n/dtype before paying the full load (serve/server.py's
-    from_bundle path)."""
-    return dict(read_manifest(dirpath, kind="lu_handle")["meta"])
+    from_bundle path).  Adds a computed ``nbytes`` key (the sum of
+    every artifact's manifest byte length) so the fleet's handle cache
+    (serve/handlecache.py) can budget residency BEFORE paying the
+    load."""
+    doc = read_manifest(dirpath, kind="lu_handle")
+    meta = dict(doc["meta"])
+    meta["nbytes"] = sum(int(e.get("bytes", 0))
+                         for e in doc["arrays"].values())
+    return meta
 
 
 def load_lu(dirpath: str):

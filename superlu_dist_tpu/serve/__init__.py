@@ -1,5 +1,9 @@
 from superlu_dist_tpu.serve.server import (   # noqa: F401
     SolveServer, SolveTicket)
+from superlu_dist_tpu.serve.handlecache import HandleCache  # noqa: F401
+from superlu_dist_tpu.serve.fleet import (    # noqa: F401
+    FleetRouter, FleetTicket, ProcessReplica, ThreadReplica)
 from superlu_dist_tpu.utils.errors import (   # noqa: F401
-    FactorCorruptError, ServeDeadlineError, ServeOverloadError,
-    ServePoisonedError, ServerClosedError)
+    DeployRollbackError, FactorCorruptError, ReplicaFailureError,
+    ServeDeadlineError, ServeOverloadError, ServePoisonedError,
+    ServerClosedError)

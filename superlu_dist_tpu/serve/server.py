@@ -460,7 +460,13 @@ class SolveServer:
         module teardown, so a wedged dispatcher is abandoned after
         ``timeout`` (its queued tickets still get their structured
         error) instead of hanging ``close()`` forever.  Pass
-        ``timeout=None`` to wait indefinitely."""
+        ``timeout=None`` to wait indefinitely.
+
+        Racing an in-flight :meth:`swap`: close WINS — a swap that has
+        not installed its target by the time close takes the lock
+        raises ``ServerClosedError`` and releases the target (see the
+        swap docstring; tests/test_serve_robust.py pins the
+        ordering)."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
@@ -492,7 +498,16 @@ class SolveServer:
         ``LUFactorization`` or a persist-bundle path.  Queued and future
         requests are served by the new handle; the in-flight batch (if
         any) finishes on the old one — zero tickets dropped.  Clears a
-        scrub quarantine and re-bases the scrub digests."""
+        scrub quarantine and re-bases the scrub digests.
+
+        Ordering contract vs :meth:`close` (the two linearize on the
+        server lock): **close wins**.  A ``close()`` that takes the
+        lock before the swap installs makes this call raise
+        :class:`ServerClosedError` — the swap target is released, never
+        installed, and every undelivered ticket gets its deterministic
+        ``ServerClosedError`` from ``close()``'s purge.  A swap that
+        installs first completes normally and the close then shuts the
+        swapped server down the ordinary way."""
         from superlu_dist_tpu.persist.serial import (bundle_front_digests,
                                                      load_lu)
         source = None
@@ -522,6 +537,15 @@ class SolveServer:
         if self._berr_max > 0 and lu.a is not None:
             berr_op = lu.a.transpose() if self.trans else lu.a
         with self._cond:
+            if self._closed:
+                # the close()/swap() ordering contract: close WINS.  The
+                # freshly loaded/validated target is released (never
+                # installed), and close()'s purge has already delivered
+                # ServerClosedError to every undelivered ticket.
+                raise ServerClosedError(
+                    "swap() aborted: the server closed during the swap "
+                    "(close wins — the swap target was released and all "
+                    "queued tickets received ServerClosedError)")
             self.lu = lu
             self._solve = solve
             self._handle_epoch += 1
@@ -606,6 +630,14 @@ class SolveServer:
                 pass
             except Exception:
                 pass    # the scrubber must never kill the process
+
+    # ------------------------------------------------------------------
+    def idle(self) -> bool:
+        """True when nothing is queued or in flight — the fleet handle
+        cache's eviction predicate (serve/handlecache.py): only an idle
+        server may be evicted, so eviction can never drop a ticket."""
+        with self._lock:
+            return not self._queue and not self._inflight
 
     # ------------------------------------------------------------------
     def stats(self) -> dict:

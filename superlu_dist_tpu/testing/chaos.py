@@ -34,6 +34,19 @@ Enable in a victim process via the registered env knob::
     SLU_TPU_CHAOS='corrupt_panel=0'         # flip a byte in front group
                                               # 0's resident panel stack —
                                               # the scrubber must catch it
+    SLU_TPU_CHAOS='kill_replica=1@batch=3'  # fleet replica 1 dies before
+                                              # serving its 4th accepted
+                                              # batch (a REAL SIGKILL in a
+                                              # process replica, a simulated
+                                              # crash in a thread replica) —
+                                              # the zero-loss failover domain
+    SLU_TPU_CHAOS='quarantine_replica=1'    # replica 1 quarantines before
+                                              # its next batch — the router
+                                              # must re-route, never error
+    SLU_TPU_CHAOS='slow_replica=0,secs=1'   # replica 0 stalls 1 s before a
+                                              # batch: slow, NOT dead — the
+                                              # fleet health monitor must
+                                              # yield ZERO false failovers
 
 The factor path consults :func:`get_chaos` once per factorization
 (numeric/factor.py) and the streamed executor calls
@@ -99,11 +112,23 @@ class ChaosPlan:
                               # collects (result() stalls `secs` first)
     corrupt_panel: int = -1   # flip one byte of front group F's
                               # resident L stack before the next scrub
+    # ---- fleet domain (ISSUE 14) --------------------------------------
+    kill_replica: int = -1    # this fleet replica dies (SIGKILL in a
+                              # process replica, simulated crash in a
+                              # thread replica)...
+    batch: int = -1           # ...before serving its Kth accepted
+                              # batch (0-based per-replica count)
+    quarantine_replica: int = -1  # this replica quarantines before its
+                              # next batch (unroutable, NOT dead)
+    slow_replica: int = -1    # this replica stalls `secs` once before
+                              # a batch — slow, NOT dead: the health
+                              # monitor must not fail it over
 
     @property
     def armed(self) -> bool:
         return (self.kill_group >= 0 or self.nan_supernode >= 0
-                or self.comm_armed or self.serve_armed)
+                or self.comm_armed or self.serve_armed
+                or self.fleet_armed)
 
     @property
     def comm_armed(self) -> bool:
@@ -113,6 +138,11 @@ class ChaosPlan:
     def serve_armed(self) -> bool:
         return (self.poison_rhs >= 0 or self.slow_client >= 0
                 or self.corrupt_panel >= 0)
+
+    @property
+    def fleet_armed(self) -> bool:
+        return (self.kill_replica >= 0 or self.quarantine_replica >= 0
+                or self.slow_replica >= 0)
 
 
 def parse_chaos_spec(spec: str) -> ChaosPlan:
@@ -133,9 +163,14 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
             plan.kill_rank = int(rank)
             if at:
                 plan.kill_group = int(group)
+        elif key == "kill_replica":
+            rid, at, batch = val.partition("@batch=")
+            plan.kill_replica = int(rid)
+            plan.batch = int(batch) if at else 0
         elif key in ("kill_group", "nan_supernode", "kill_op",
                      "stall_rank", "stall_op", "epoch", "poison_rhs",
-                     "slow_client", "corrupt_panel"):
+                     "slow_client", "corrupt_panel", "batch",
+                     "quarantine_replica", "slow_replica"):
             setattr(plan, key, int(val))
         elif key == "secs":
             plan.secs = float(val)
@@ -272,6 +307,42 @@ class ChaosMonkey:
         self._panel_corrupted = True
         return f
 
+    # ---- fleet domain (FleetRouter replica hooks) ------------------------
+    def replica_kill_due(self, rid: int, batch_index: int) -> bool:
+        """``kill_replica=R@batch=K``: True when replica ``rid`` must
+        die before serving its ``batch_index``-th accepted batch
+        (0-based per-replica count).  The caller decides how to die: a
+        process replica SIGKILLs itself (the real kill -9 domain), a
+        thread replica simulates the crash (stops serving with its
+        accepted tickets undelivered) — either way the router must
+        re-route every undelivered ticket with zero client-visible
+        loss.  Epoch-scoped like every serve injection."""
+        p = self.plan
+        return (p.kill_replica == rid and p.batch >= 0
+                and batch_index >= p.batch and self._serve_epoch_ok())
+
+    def replica_quarantined(self, rid: int) -> bool:
+        """``quarantine_replica=R``: replica ``rid`` flips to
+        quarantined before its next batch — unroutable but ALIVE, the
+        degraded-not-dead domain the router must route around (and
+        re-route the replica's queued tickets) without erroring any
+        client."""
+        return (self.plan.quarantine_replica == rid
+                and self._serve_epoch_ok())
+
+    def replica_stall_s(self, rid: int) -> float:
+        """``slow_replica=R,secs=S``: replica ``rid`` stalls S seconds
+        ONCE before a batch.  Slow is NOT dead: the health monitor's
+        liveness verdict (pid/thread, never latency) must produce zero
+        false-positive failovers.  Returns the stall (0.0 after the
+        first fire / for other replicas)."""
+        p = self.plan
+        if (p.slow_replica != rid or self._stalled
+                or p.secs <= 0 or not self._serve_epoch_ok()):
+            return 0.0
+        self._stalled = True
+        return p.secs
+
     # ---- numeric-poison domain -----------------------------------------
     def poke_nan(self, plan, pattern_values: np.ndarray) -> np.ndarray:
         """Poison supernode ``nan_supernode``: NaN one A-entry that
@@ -323,6 +394,19 @@ def get_serve_chaos() -> ChaosMonkey | None:
     armed, so submit/scrub hooks stay one ``is None`` test."""
     monkey = get_chaos()
     if monkey is None or not monkey.plan.serve_armed:
+        return None
+    return monkey
+
+
+def get_fleet_chaos() -> ChaosMonkey | None:
+    """Fleet-domain injector for FleetRouter replicas (kill_replica /
+    quarantine_replica / slow_replica specs).  Consulted once per
+    replica at construction — each replica gets its OWN monkey so the
+    fire-once stall/kill flags are per-replica state — and None unless
+    a fleet injection is armed, so the replica serve loop stays one
+    ``is None`` test."""
+    monkey = get_chaos()
+    if monkey is None or not monkey.plan.fleet_armed:
         return None
     return monkey
 

@@ -279,6 +279,72 @@ class FactorCorruptError(SuperLUError):
             self.flightrec_dump = None
 
 
+class ReplicaFailureError(SuperLUError):
+    """The fleet router (serve/fleet.py) declared a serving replica
+    FAILED: its process died (``pid_alive`` — the same kill(pid,0) +
+    zombie verdict the PR 8 rank failure detector uses, generalized to
+    replica processes), its worker crashed, or a factor-integrity
+    quarantine made it unroutable.  Every ticket the replica had
+    accepted but not yet delivered is RE-ROUTED to a healthy replica
+    under the same idempotent retry token, so a client observes
+    bitwise-identical X, never this error — unless zero healthy
+    replicas remain, in which case the undelivered tickets are handed
+    this error instead of a hang (the zero-loss failover contract,
+    docs/SERVING.md fleet chapter).  Dumps a flight-recorder postmortem
+    at construction naming the dead replica and the re-routed ticket
+    set."""
+
+    def __init__(self, replica: int, tickets, cause: str = "",
+                 pid: int = -1, kind: str = "replica"):
+        self.replica = int(replica)
+        self.tickets = sorted(int(t) for t in tickets)
+        self.cause = cause
+        self.pid = int(pid)
+        self.kind = kind
+        why = f" ({cause})" if cause else ""
+        who = f" pid {pid}" if pid > 0 else ""
+        super().__init__(
+            f"fleet {kind} {replica}{who} declared failed{why}; "
+            f"{len(self.tickets)} undelivered ticket(s) "
+            f"{self.tickets} re-routed to healthy replicas under their "
+            "idempotent retry tokens (zero-loss failover — clients see "
+            "identical X, not this error, while healthy replicas "
+            "remain)")
+        _flight_dump(self)
+
+
+class DeployRollbackError(SuperLUError):
+    """A rolling deploy (``FleetRouter.deploy``) was ROLLED BACK: the
+    new bundle failed its load/scrub integrity verification or a canary
+    batch's quality gate (non-finite X, or componentwise berr past the
+    gate) on some replica, so every replica already swapped was
+    restored to the previous bundle and the fleet keeps serving the old
+    factors.  ``stage`` names the failing check (``load`` / ``scrub`` /
+    ``canary``), ``replica`` the replica it failed on, ``rolled_back``
+    the replicas that were restored.  Dumps a flight-recorder
+    postmortem at construction."""
+
+    def __init__(self, key, bundle: str, stage: str, replica: int = -1,
+                 rolled_back=(), cause: str = ""):
+        self.key = key
+        self.bundle = str(bundle)
+        self.stage = stage
+        self.replica = int(replica)
+        self.rolled_back = sorted(int(r) for r in rolled_back)
+        self.cause = cause
+        at = f" on replica {replica}" if replica >= 0 else ""
+        why = f": {cause}" if cause else ""
+        back = (f"; replica(s) {self.rolled_back} restored to the "
+                "previous bundle" if self.rolled_back else
+                "; no replica had swapped yet")
+        super().__init__(
+            f"rolling deploy of bundle {self.bundle!r} for handle "
+            f"{key!r} rolled back at the {stage} check{at}{why}{back} "
+            "— the fleet keeps serving the previous factors "
+            "(docs/SERVING.md fleet chapter)")
+        _flight_dump(self)
+
+
 class LockOrderError(SuperLUError):
     """Lock-verify mode (``SLU_TPU_VERIFY_LOCKS=1``, slulint's runtime
     rule SLU109 twin — ``utils/lockwatch.py``) detected a lock-order

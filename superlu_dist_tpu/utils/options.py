@@ -167,6 +167,35 @@ def _register_all() -> None:
       "the handle's panel stacks against their persist-bundle sha256 "
       "digests every this-many seconds, quarantining the handle with "
       "FactorCorruptError on mismatch (0 = off)", group="serve")
+    # --- serving fleet -----------------------------------------------------
+    r("SLU_TPU_FLEET_REPLICAS", "int", 2,
+      "FleetRouter default replica count (serve/fleet.py): how many "
+      "SolveServer replicas the routing front fans submits across",
+      group="fleet")
+    r("SLU_TPU_FLEET_KIND", "str", "thread",
+      "fleet replica isolation: in-process worker threads or spawned "
+      "worker processes behind the same interface", group="fleet",
+      choices=("thread", "process"))
+    r("SLU_TPU_FLEET_HANDLE_BYTES", "int", 0,
+      "per-replica resident-handle byte budget for the multi-handle "
+      "LRU cache (serve/handlecache.py, sized via the persist lu_meta "
+      "cheap peek): least-recently-used idle handles are evicted and "
+      "scrub-verified on reload (0 = unbounded)", group="fleet")
+    r("SLU_TPU_FLEET_QUEUE_MAX", "int", 0,
+      "fleet-level admission cap in undelivered COLUMNS across all "
+      "replicas: a submit past it is shed with ServeOverloadError "
+      "(reason fleet_queue_full) at the router, before any replica "
+      "queues it (0 = unbounded)", group="fleet")
+    r("SLU_TPU_FLEET_DEADLINE_MS", "float", 0.0,
+      "end-to-end per-ticket fleet deadline: a ticket undelivered past "
+      "it — queued, in flight, or mid-failover — is expired with "
+      "ServeDeadlineError by the health monitor or the waiting ticket "
+      "itself (0 = off)", group="fleet")
+    r("SLU_TPU_FLEET_HEALTH_S", "float", 0.05,
+      "fleet health-monitor poll period: replica process/thread "
+      "liveness (pid_alive — the PR 8 detector verdict), failover "
+      "re-routing of undelivered tickets, and deadline sweeps run on "
+      "this cadence", group="fleet")
     r("SLU_TPU_POOL_PARTITION", "flag", False,
       "shard the Schur update pool across all mesh devices", group="numeric")
     # --- distributed tier --------------------------------------------------

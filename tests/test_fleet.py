@@ -1,0 +1,522 @@
+"""Serving fleet (serve/fleet.py + serve/handlecache.py): multi-handle
+replicas over an LRU handle cache, health-checked routing, zero-loss
+failover with bitwise-identical re-routed results, fleet backpressure,
+and rolling deploy with canary-gated rollback — the chaos specs
+``kill_replica`` / ``quarantine_replica`` / ``slow_replica`` driving
+the failure domains deterministically."""
+
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import gssvx
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.persist.serial import lu_meta, save_lu
+from superlu_dist_tpu.serve import (DeployRollbackError, FleetRouter,
+                                    HandleCache, ReplicaFailureError,
+                                    ServeDeadlineError,
+                                    ServeOverloadError,
+                                    ServerClosedError, SolveServer)
+from superlu_dist_tpu.serve.fleet import FLEET_SERVER_KW
+from superlu_dist_tpu.utils.errors import SuperLUError
+from superlu_dist_tpu.utils.options import IterRefine, Options
+
+pytestmark = pytest.mark.fleet
+
+KEYS = ("m0", "m1", "m2")
+_NX = {"m0": 6, "m1": 7, "m2": 8}
+
+
+def _factor(a):
+    x, lu, stats, info = gssvx(
+        Options(iter_refine=IterRefine.NOREFINE), a, np.ones(a.n_rows))
+    assert info == 0
+    return lu
+
+
+@pytest.fixture(scope="module")
+def bundles(tmp_path_factory):
+    """Three distinct matrices persisted as bundles + their operators."""
+    root = tmp_path_factory.mktemp("fleet_bundles")
+    paths, mats = {}, {}
+    for key in KEYS:
+        a = poisson2d(_NX[key])
+        d = str(root / key)
+        save_lu(_factor(a), d)
+        paths[key] = d
+        mats[key] = a
+    return paths, mats
+
+
+def _mixed_stream(fleet, mats, n_tickets=18, seed=0, keys=KEYS):
+    """Submit a deterministic mixed stream over ``keys``; returns the
+    tickets in submission order."""
+    rng = np.random.default_rng(seed)
+    tickets = []
+    for j in range(n_tickets):
+        key = keys[j % len(keys)]
+        a = mats[key]
+        b = a.matvec(rng.standard_normal(a.n_rows))
+        tickets.append(fleet.submit(key, b))
+    return tickets
+
+
+# ---------------------------------------------------------------------------
+# routing + multi-handle basics
+# ---------------------------------------------------------------------------
+
+def test_mixed_stream_round_trip(bundles):
+    """One fleet serves a mixed stream of three distinct matrices, each
+    request solved against the right handle."""
+    paths, mats = bundles
+    fleet = FleetRouter(paths, n_replicas=2, kind="thread")
+    rng = np.random.default_rng(1)
+    recs = []
+    for j in range(12):
+        key = KEYS[j % 3]
+        a = mats[key]
+        xt = rng.standard_normal(a.n_rows)
+        recs.append((key, xt, fleet.submit(key, a.matvec(xt))))
+    for key, xt, t in recs:
+        got = t.result(120)
+        res = np.linalg.norm(got - xt) / np.linalg.norm(xt)
+        assert res < 1e-4, (key, res)    # f32 factors
+        assert t.attempts == 1
+    st = fleet.stats()
+    fleet.close()
+    assert st["requests"] == 12 and st["delivered"] == 12
+    assert st["errors"] == 0 and st["failovers"] == 0
+    assert st["replicas_healthy"] == 2
+
+
+def test_unknown_key_and_closed_fleet(bundles):
+    paths, mats = bundles
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=1, kind="thread")
+    with pytest.raises(SuperLUError):
+        fleet.submit("nope", np.ones(mats["m0"].n_rows))
+    fleet.close()
+    with pytest.raises(ServerClosedError):
+        fleet.submit("m0", np.ones(mats["m0"].n_rows))
+
+
+# ---------------------------------------------------------------------------
+# handle cache: LRU eviction + scrub-verified reload
+# ---------------------------------------------------------------------------
+
+def test_handle_cache_lru_eviction_and_scrub_reload(bundles):
+    """Under a byte budget sized for two of three bundles, loading the
+    third evicts the least-recently-used idle handle; reloading it
+    round-trips BITWISE (digest-verified load + scrub pass against the
+    manifest)."""
+    paths, mats = bundles
+    nb = {k: lu_meta(p)["nbytes"] for k, p in paths.items()}
+    budget = nb["m0"] + nb["m1"] + 100
+    cache = HandleCache(budget, FLEET_SERVER_KW)
+    for k, p in paths.items():
+        cache.register(k, p)
+    b0 = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+    srv0 = cache.get("m0")
+    ref = srv0.solve(b0, 120)
+    assert cache.get("m0") is srv0        # resident hit
+    cache.get("m1")
+    cache.get("m2")                       # must push past the budget
+    st = cache.stats()
+    assert st["evictions"] >= 1
+    assert "m0" not in cache.resident()   # LRU victim
+    again = cache.get("m0").solve(b0, 120)
+    np.testing.assert_array_equal(ref, again)
+    st = cache.stats()
+    assert st["loads"] == 4 and st["hits"] == 1
+    cache.close()
+
+
+def test_handle_cache_busy_entries_survive_eviction(bundles):
+    """A resident handle with queued/in-flight work is never evicted —
+    the cache runs over budget instead of dropping tickets (the
+    ``SolveServer.idle()`` eviction predicate)."""
+    paths, mats = bundles
+    cache = HandleCache(1, FLEET_SERVER_KW)   # absurdly tight budget
+    cache.register("m0", paths["m0"])
+    cache.register("m1", paths["m1"])
+    srv = cache.get("m0")
+    srv.idle = lambda: False              # pin it busy
+    cache.get("m1")                       # would evict m0 if it could
+    assert "m0" in cache.resident()       # busy handles survive
+    assert cache.stats()["resident_bytes"] > cache.budget_bytes
+    cache.close()
+
+
+def test_handle_cache_unknown_key(bundles):
+    cache = HandleCache(0, FLEET_SERVER_KW)
+    with pytest.raises(SuperLUError):
+        cache.get("never-registered")
+    cache.close()
+
+
+# ---------------------------------------------------------------------------
+# zero-loss failover
+# ---------------------------------------------------------------------------
+
+def _run_stream(paths, mats, chaos=None, n_replicas=3, n_tickets=18,
+                monkeypatch=None, **kw):
+    if chaos is not None:
+        monkeypatch.setenv("SLU_TPU_CHAOS", chaos)
+    else:
+        os.environ.pop("SLU_TPU_CHAOS", None)
+    fleet = FleetRouter(paths, n_replicas=n_replicas, kind="thread",
+                        **kw)
+    try:
+        tickets = _mixed_stream(fleet, mats, n_tickets=n_tickets,
+                                keys=tuple(paths))
+        xs = [t.result(180) for t in tickets]
+        return xs, fleet.stats()
+    finally:
+        fleet.close()
+        if chaos is not None:
+            monkeypatch.delenv("SLU_TPU_CHAOS", raising=False)
+
+
+def test_replica_kill_mid_stream_zero_loss_bitwise(bundles,
+                                                   monkeypatch):
+    """THE headline guarantee: a replica killed mid-stream loses zero
+    accepted tickets, and every delivered X is bitwise identical to an
+    undisturbed run of the same stream."""
+    paths, mats = bundles
+    ref, st0 = _run_stream(paths, mats, monkeypatch=monkeypatch)
+    assert st0["failovers"] == 0
+    got, st1 = _run_stream(paths, mats, chaos="kill_replica=1@batch=2",
+                           monkeypatch=monkeypatch)
+    assert st1["failovers"] >= 1, "the kill never fired"
+    assert st1["replicas_failed"] == [1]
+    assert st1["errors"] == 0 and st1["delivered"] == len(ref)
+    assert st1["reroutes"] >= 1
+    drift = [i for i, (r, g) in enumerate(zip(ref, got))
+             if not np.array_equal(r, g)]
+    assert not drift, (
+        f"re-routed ticket(s) {drift} are not bitwise identical to the "
+        "undisturbed run")
+
+
+def test_quarantine_replica_reroutes_without_client_errors(bundles,
+                                                           monkeypatch):
+    paths, mats = bundles
+    got, st = _run_stream(paths, mats, chaos="quarantine_replica=0",
+                          n_replicas=2, n_tickets=9,
+                          monkeypatch=monkeypatch)
+    assert st["errors"] == 0 and st["delivered"] == 9
+    assert st["failovers"] >= 1 and st["reroutes"] >= 1
+    assert st["replicas_failed"] == []   # quarantined, not dead
+
+
+def test_slow_replica_zero_false_positive_failovers(bundles,
+                                                    monkeypatch):
+    """Liveness is judged on the process/thread, never on latency: a
+    stalled replica is waited out, not failed over."""
+    paths, mats = bundles
+    got, st = _run_stream(paths, mats, chaos="slow_replica=0,secs=0.6",
+                          n_replicas=2, n_tickets=8, health_s=0.02,
+                          monkeypatch=monkeypatch)
+    assert st["failovers"] == 0 and st["reroutes"] == 0
+    assert st["errors"] == 0 and st["delivered"] == 8
+
+
+def test_no_healthy_replica_left_structured_error(bundles, monkeypatch):
+    """When the LAST replica dies, undelivered tickets get a structured
+    ReplicaFailureError — never a hang."""
+    paths, mats = bundles
+    monkeypatch.setenv("SLU_TPU_CHAOS", "kill_replica=0@batch=1")
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=1, kind="thread")
+    try:
+        b = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+        tickets = [fleet.submit("m0", b) for _ in range(4)]
+        outcomes = []
+        for t in tickets:
+            try:
+                t.result(60)
+                outcomes.append("ok")
+            except ReplicaFailureError:
+                outcomes.append("rfail")
+        assert "rfail" in outcomes
+        assert outcomes.count("ok") >= 1      # batch 0 was served
+    finally:
+        fleet.close()
+
+
+# ---------------------------------------------------------------------------
+# backpressure + deadlines
+# ---------------------------------------------------------------------------
+
+def test_fleet_shed_at_cap(bundles, monkeypatch):
+    paths, mats = bundles
+    # stall the only replica so the backlog provably exceeds the cap
+    monkeypatch.setenv("SLU_TPU_CHAOS", "slow_replica=0,secs=0.3")
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=1,
+                        kind="thread", queue_max=4)
+    try:
+        b = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+        wide = np.stack([b] * 3, axis=1)
+        ok, shed = [], 0
+        for _ in range(5):
+            try:
+                ok.append(fleet.submit("m0", wide))
+            except ServeOverloadError as e:
+                assert e.reason == "fleet_queue_full"
+                shed += 1
+        assert shed > 0, "the fleet cap never engaged"
+        for t in ok:
+            t.result(120)
+        assert fleet.stats()["shed"] == shed
+    finally:
+        fleet.close()
+
+
+def test_fleet_drain_sheds_and_finishes(bundles, monkeypatch):
+    paths, mats = bundles
+    monkeypatch.setenv("SLU_TPU_CHAOS", "slow_replica=0,secs=0.2")
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=1, kind="thread")
+    try:
+        b = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+        t = fleet.submit("m0", b)
+        done = fleet.drain(timeout=60)
+        assert done and t.done()
+        with pytest.raises(ServeOverloadError) as ei:
+            fleet.submit("m0", b)
+        assert ei.value.reason == "draining"
+        fleet.resume()
+        fleet.solve("m0", b, timeout=60)
+    finally:
+        fleet.close()
+
+
+def test_fleet_deadline_end_to_end(bundles, monkeypatch):
+    """A ticket undelivered past SLU_TPU_FLEET_DEADLINE_MS expires with
+    ServeDeadlineError even while a replica is stalled."""
+    paths, mats = bundles
+    monkeypatch.setenv("SLU_TPU_CHAOS", "slow_replica=0,secs=1.0")
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=1,
+                        kind="thread", deadline_s=0.1, health_s=0.02)
+    try:
+        b = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+        tickets = [fleet.submit("m0", b) for _ in range(3)]
+        verdicts = []
+        for t in tickets:
+            try:
+                t.result(30)
+                verdicts.append("ok")
+            except ServeDeadlineError:
+                verdicts.append("deadline")
+        assert "deadline" in verdicts, verdicts
+        assert fleet.stats()["deadline_miss"] >= 1
+        assert fleet.stats()["failovers"] == 0   # slow, not dead
+    finally:
+        fleet.close()
+
+
+def test_close_delivers_structured_error(bundles, monkeypatch):
+    paths, mats = bundles
+    monkeypatch.setenv("SLU_TPU_CHAOS", "slow_replica=0,secs=0.5")
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=1, kind="thread")
+    b = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+    tickets = [fleet.submit("m0", b) for _ in range(4)]
+    fleet.close()
+    for t in tickets:
+        try:
+            t.result(10)      # served before close: fine
+        except (ServerClosedError, ReplicaFailureError):
+            pass              # undelivered at close: structured, no hang
+
+
+# ---------------------------------------------------------------------------
+# rolling deploy
+# ---------------------------------------------------------------------------
+
+def _poisoned_bundle(mats, tmp_path, name="poisoned"):
+    lu = _factor(mats["m0"])
+    lp, up = lu.numeric.fronts[0]
+    lu.numeric.fronts[0] = (np.asarray(lp) * np.nan, up)
+    d = str(tmp_path / name)
+    save_lu(lu, d)
+    return d
+
+
+def test_rolling_deploy_and_poisoned_rollback(bundles, tmp_path):
+    paths, mats = bundles
+    a = mats["m0"]
+    good2 = str(tmp_path / "m0_v2")
+    save_lu(_factor(a), good2)
+    bad = _poisoned_bundle(mats, tmp_path)
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=2, kind="thread")
+    try:
+        b = a.matvec(np.ones(a.n_rows))
+        ref = fleet.solve("m0", b, timeout=120)
+        out = fleet.deploy(good2, a=a, berr_max=1e-4)
+        assert out["replicas_swapped"] == [0, 1]
+        assert fleet.stats()["deploys"] == 1
+        # same matrix, fresh identical factorization → bitwise X
+        np.testing.assert_array_equal(ref,
+                                      fleet.solve("m0", b, timeout=120))
+        # poisoned bundle: the preflight canary rejects it with ZERO
+        # replica exposure
+        with pytest.raises(DeployRollbackError) as ei:
+            fleet.deploy(bad)
+        assert ei.value.stage == "canary"
+        assert ei.value.rolled_back == []
+        assert fleet.stats()["rollbacks"] == 1
+        np.testing.assert_array_equal(ref,
+                                      fleet.solve("m0", b, timeout=120))
+    finally:
+        fleet.close()
+
+
+def test_rolling_deploy_mid_replica_rollback_restores(bundles,
+                                                      tmp_path):
+    """With the preflight gate off, the poisoned bundle reaches replica
+    0, its canary fails, and the rollback RESTORES the already-swapped
+    replica — the fleet keeps serving the old factors bitwise."""
+    paths, mats = bundles
+    a = mats["m0"]
+    bad = _poisoned_bundle(mats, tmp_path, "poisoned2")
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=2, kind="thread")
+    try:
+        b = a.matvec(np.ones(a.n_rows))
+        ref = fleet.solve("m0", b, timeout=120)
+        with pytest.raises(DeployRollbackError) as ei:
+            fleet.deploy(bad, preflight=False)
+        assert ei.value.stage == "canary" and ei.value.replica == 0
+        assert ei.value.rolled_back == [0]
+        np.testing.assert_array_equal(ref,
+                                      fleet.solve("m0", b, timeout=120))
+        # traffic still flows after the rollback on every replica
+        for _ in range(4):
+            np.testing.assert_array_equal(
+                ref, fleet.solve("m0", b, timeout=120))
+    finally:
+        fleet.close()
+
+
+def test_deploy_during_traffic_drops_nothing(bundles, tmp_path):
+    paths, mats = bundles
+    a = mats["m0"]
+    good2 = str(tmp_path / "m0_v3")
+    save_lu(_factor(a), good2)
+    fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=2, kind="thread")
+    stop = threading.Event()
+    outcomes = []
+    lock = threading.Lock()
+    b = a.matvec(np.ones(a.n_rows))
+
+    def client():
+        while not stop.is_set():
+            try:
+                fleet.solve("m0", b, timeout=120)
+                tag = "ok"
+            except Exception as e:        # noqa: BLE001 — tallied
+                tag = type(e).__name__
+            with lock:
+                outcomes.append(tag)
+
+    th = threading.Thread(target=client)
+    th.start()
+    try:
+        time.sleep(0.05)
+        fleet.deploy(good2)
+        time.sleep(0.05)
+    finally:
+        stop.set()
+        th.join(30)
+        fleet.close()
+    assert outcomes and set(outcomes) == {"ok"}, outcomes
+
+
+# ---------------------------------------------------------------------------
+# process replicas (the real kill -9 domain)
+# ---------------------------------------------------------------------------
+
+def test_process_replicas_kill9_zero_loss(bundles, monkeypatch):
+    """Subprocess replicas behind the same interface: chaos SIGKILLs
+    one replica process mid-stream (a REAL kill -9) and every accepted
+    ticket is still delivered, bitwise-identical to the thread fleet's
+    answers for the same stream."""
+    paths, mats = bundles
+    two = {k: paths[k] for k in ("m0", "m1")}
+    ref, st0 = _run_stream(two, mats, n_replicas=2, n_tickets=8,
+                           monkeypatch=monkeypatch)
+
+    monkeypatch.setenv("SLU_TPU_CHAOS", "kill_replica=1@batch=1")
+    fleet = FleetRouter(two, n_replicas=2, kind="process")
+    try:
+        tickets = _mixed_stream(fleet, mats, n_tickets=8,
+                                keys=("m0", "m1"))
+        got = [t.result(300) for t in tickets]
+        st = fleet.stats()
+        assert st["failovers"] >= 1 and st["errors"] == 0
+        assert st["delivered"] == 8
+        assert 1 in st["replicas_failed"]
+        for i, (r, g) in enumerate(zip(ref, got)):
+            assert np.array_equal(r, g), f"ticket {i} drifted"
+    finally:
+        fleet.close()
+        monkeypatch.delenv("SLU_TPU_CHAOS", raising=False)
+
+
+# ---------------------------------------------------------------------------
+# evidence: metrics + postmortem
+# ---------------------------------------------------------------------------
+
+def test_fleet_metrics_series(bundles, monkeypatch):
+    from superlu_dist_tpu.obs import metrics as metrics_mod
+    paths, mats = bundles
+    m = metrics_mod.Metrics()
+    prev = metrics_mod.install(m)
+    monkeypatch.setenv("SLU_TPU_CHAOS", "kill_replica=0@batch=1")
+    try:
+        fleet = FleetRouter({"m0": paths["m0"]}, n_replicas=2,
+                            kind="thread")
+        b = mats["m0"].matvec(np.ones(mats["m0"].n_rows))
+        tickets = [fleet.submit("m0", b) for _ in range(6)]
+        for t in tickets:
+            t.result(120)
+        fleet.close()
+        snap = m.snapshot()
+        c, g, h = (snap["counters"], snap["gauges"],
+                   snap["histograms"])
+        assert c["slu_fleet_requests_total"] == 6.0
+        assert c["slu_fleet_columns_total"] == 6.0
+        assert c["slu_fleet_failovers_total"] >= 1.0
+        assert c["slu_fleet_reroutes_total"] >= 1.0
+        assert "slu_fleet_replicas_healthy" in g
+        assert "slu_fleet_route_seconds" in h
+    finally:
+        metrics_mod.install(prev)
+
+
+def test_replica_failure_postmortem(bundles, monkeypatch, tmp_path):
+    """The failover's ReplicaFailureError dumps a flight-recorder
+    postmortem naming the dead replica and the re-routed ticket set."""
+    from superlu_dist_tpu.obs import flightrec
+    monkeypatch.setenv("SLU_TPU_FLIGHTREC",
+                       str(tmp_path / "fleet-%p.json"))
+    flightrec._reset()
+    try:
+        err = ReplicaFailureError(3, [7, 9], cause="unit", pid=123,
+                                  kind="process")
+        assert err.replica == 3 and err.tickets == [7, 9]
+        assert "3" in str(err) and "[7, 9]" in str(err)
+        assert err.flightrec_dump and os.path.exists(err.flightrec_dump)
+        import json
+        doc = json.load(open(err.flightrec_dump))
+        assert doc["reason"] == "ReplicaFailureError"
+        assert "[7, 9]" in doc["detail"]
+    finally:
+        monkeypatch.delenv("SLU_TPU_FLIGHTREC")
+        flightrec._reset()
+
+
+def test_deploy_rollback_error_fields():
+    err = DeployRollbackError("k", "/tmp/bundle", "canary", replica=1,
+                              rolled_back=[0, 1], cause="berr gate")
+    assert err.stage == "canary" and err.rolled_back == [0, 1]
+    assert "rolled back" in str(err) and "berr gate" in str(err)

@@ -527,6 +527,61 @@ def test_submit_close_storm_never_hangs(factored):
         assert "HANG" not in outcomes and "WRONG" not in outcomes, outcomes
 
 
+def test_close_wins_over_inflight_swap(factored):
+    """The close()/swap() ordering contract (ISSUE 14 satellite): a
+    close() that takes the server lock while a swap is still preparing
+    its target makes the swap raise ServerClosedError — the target is
+    RELEASED (never installed), and every queued ticket got its
+    deterministic ServerClosedError from close()'s purge."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(_refactor(a), start=False)
+    srv.scrub_now()                     # digest baseline → swap rebases
+    in_swap = threading.Event()
+    release = threading.Event()
+    orig = srv._compute_digests
+
+    def stalled_digests(lu_arg=None):
+        in_swap.set()                   # swap is mid-flight, target not
+        release.wait(10)                # yet installed
+        return orig(lu_arg)
+
+    srv._compute_digests = stalled_digests
+    old_lu = srv.lu
+    ticket = srv.submit(bs[:, 0])
+    result = {}
+
+    def do_swap():
+        try:
+            srv.swap(_refactor(a))
+            result["r"] = "installed"
+        except Exception as e:          # noqa: BLE001 — asserted below
+            result["r"] = e
+
+    th = threading.Thread(target=do_swap)
+    th.start()
+    assert in_swap.wait(10)
+    srv.close(timeout=5)                # close wins: linearizes first
+    release.set()
+    th.join(10)
+    assert not th.is_alive()
+    assert isinstance(result["r"], ServerClosedError), result
+    assert srv.lu is old_lu             # swap target released
+    assert srv.stats()["swaps"] == 0
+    with pytest.raises(ServerClosedError):
+        ticket.result(5)                # delivered deterministically
+
+
+def test_swap_after_close_raises(factored):
+    """The degenerate ordering: a swap that starts after close() raises
+    the same ServerClosedError (and a swap that installs BEFORE close
+    simply completes — covered by test_hot_swap_* above)."""
+    a, lu, bs, xs = factored
+    srv = SolveServer(_refactor(a), start=False)
+    srv.close()
+    with pytest.raises(ServerClosedError):
+        srv.swap(_refactor(a))
+
+
 def test_chaos_slow_client_spec(factored, monkeypatch):
     """SLU_TPU_CHAOS=slow_client=T: the Tth ticket's client stalls
     before collecting — the server must close without waiting on it and
