@@ -308,7 +308,8 @@ def main():
     _KNOBS = ("BENCH_NX", "BENCH_DTYPE", "BENCH_GRANULARITY",
               "BENCH_MAXSUPER", "BENCH_RELAX", "BENCH_MINBUCKET",
               "BENCH_GROWTH", "BENCH_AMALG", "BENCH_MATRIX",
-              "SLU_TPU_PRECISION", "SLU_TPU_PIVOT_KERNEL",
+              "SLU_TPU_PRECISION", "SLU_TPU_GEMM_PREC", "SLU_TPU_PALLAS",
+              "SLU_TPU_PIVOT_KERNEL",
               "SLU_TPU_HOST_FLOPS", "SLU_TPU_DIAG_INV",
               "SLU_TPU_SCHEDULE", "SLU_TPU_SCHED_WINDOW",
               "SLU_TPU_SCHED_ALIGN", "SLU_TPU_BUCKET_BASE",
@@ -349,9 +350,22 @@ def main():
     # rate); IR still recovers f64 residuals on well-conditioned systems
     # (more steps).  f32 is the safe default.
     DTYPE = os.environ.get("BENCH_DTYPE", "float32")
-    # v5e peak ~197 TFLOP/s bf16; f32 via HIGHEST-precision MXU passes
-    # ~1/4 of that.  MFU is reported against the f32 figure.
-    PEAK_F32 = float(os.environ.get("BENCH_PEAK_F32_TFLOPS", "49")) * 1e12
+    # MFU denominator (utils/peaks.py): per-backend/per-GEMM-tier peak —
+    # TPU kinds tabulated, CPU calibrated with a micro-GEMM — so a CPU
+    # row never divides by a TPU constant and prints mfu_pct 0.0 (the
+    # historical honesty bug).  SLU_TPU_PEAK_GFLOPS overrides; the
+    # legacy BENCH_PEAK_F32_TFLOPS knob still wins when explicitly set.
+    from superlu_dist_tpu.ops.dense import gemm_precision
+    from superlu_dist_tpu.utils.peaks import detect_peak_gflops
+    GEMM_PREC = gemm_precision(None)
+    RESULT["gemm_precision"] = GEMM_PREC
+    _legacy_peak = env_float("BENCH_PEAK_F32_TFLOPS", default=0.0)
+    if _legacy_peak > 0:
+        PEAK_GF, PEAK_SRC = _legacy_peak * 1e3, "env:BENCH_PEAK_F32_TFLOPS"
+    else:
+        PEAK_GF, PEAK_SRC = detect_peak_gflops(GEMM_PREC)
+    RESULT["peak_gflops"] = round(PEAK_GF, 1)
+    RESULT["peak_source"] = PEAK_SRC
     # Blocking defaults are backend-specific.  TPU: wide supernodes feed
     # the MXU (SURVEY.md §7 step 10 — the reference's NSUP=128 is
     # CPU-cache-sized) and keep the streamed executor's kernel count
@@ -437,12 +451,16 @@ def main():
     # dispatch-schedule telemetry (numeric/plan.py): scheduler name,
     # group count before/after dataflow aggregation, mean fronts per
     # dispatch and the dependent-group critical path
-    sched = plan.schedule_stats()
+    sched = plan.schedule_stats(itemsize=host_dt.itemsize)
     RESULT["schedule"] = sched["schedule"]
     RESULT["n_groups"] = sched["n_groups"]
     RESULT["n_level_groups"] = sched["n_level_groups"]
     RESULT["occupancy"] = sched["occupancy"]
     RESULT["critical_path"] = sched["critical_path"]
+    # irregular gather/scatter traffic (the number the Pallas fused
+    # path exists to shrink — data-movement honesty next to the flop
+    # padding factor)
+    RESULT["bytes_moved"] = sched["bytes_moved"]
     _log(f"prepared n={n} schedule={sched['schedule']} "
          f"groups={sched['n_groups']} (level {sched['n_level_groups']}) "
          f"occupancy={sched['occupancy']} flops={plan.flops / 1e9:.0f} GF")
@@ -514,7 +532,8 @@ def main():
             try:
                 st = load_checkpoint(_ck_dir, plan=plan,
                                      pattern_values=avals_np,
-                                     thresh=thresh_np, dtype=DTYPE)
+                                     thresh=thresh_np, dtype=DTYPE,
+                                     gemm_prec=GEMM_PREC)
                 ex.resume = st
                 RESULT["resumed_from_groups"] = st.k
                 _log(f"resuming factorization from checkpoint frontier "
@@ -523,7 +542,8 @@ def main():
                 pass            # no / incompatible checkpoint: fresh run
             _ckpt = FactorCheckpointer(
                 _ck_dir, plan, avals_np, thresh_np, DTYPE,
-                every=env_int("SLU_TPU_CKPT_EVERY") or 8)
+                every=env_int("SLU_TPU_CKPT_EVERY") or 8,
+                gemm_prec=GEMM_PREC)
             ex.checkpoint = _ckpt
         except Exception as e:                      # pragma: no cover
             _log(f"checkpoint arming failed: {type(e).__name__}: {e}")
@@ -600,6 +620,7 @@ def main():
 
     _set_phase("factor-time")
     times = []
+    mfu_reps = []
     for rep in range(REPS):
         t0 = time.perf_counter()
         out = ex(avals, thresh)
@@ -608,11 +629,18 @@ def main():
         tracer.complete("FACT", "phase", t0, dt, rep=rep)
         times.append(dt)
         # progressive: every rep updates the reported number, so a
-        # watchdog fire mid-loop still carries a real measurement
+        # watchdog fire mid-loop still carries a real measurement; mfu
+        # is recorded PER REP (and rounded to 4 decimals — small-but-
+        # real CPU utilizations must not print as 0.0) so the perf-
+        # regress gate sees precision-tagged per-rep baselines
+        mfu_reps.append(round(100.0 * plan.flops / dt / (PEAK_GF * 1e9),
+                              4))
         t_dev = min(times)
         RESULT["value"] = round(plan.flops / t_dev / 1e9, 2)
         RESULT["factor_seconds"] = t_dev
-        RESULT["mfu_pct"] = round(100.0 * plan.flops / t_dev / PEAK_F32, 2)
+        RESULT["mfu_pct"] = round(
+            100.0 * plan.flops / t_dev / (PEAK_GF * 1e9), 4)
+        RESULT["mfu_pct_reps"] = list(mfu_reps)
         if ex.last_dispatch_seconds is not None:
             RESULT["dispatch_seconds"] = round(ex.last_dispatch_seconds, 4)
         if getattr(ex, "last_offload_wait_seconds", None) is not None:

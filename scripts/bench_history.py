@@ -38,13 +38,16 @@ def history_path() -> str:
 
 def row_key(row: dict) -> str:
     """The comparability key: rows are baselined only against rows of
-    the same metric + backend + executor configuration."""
+    the same metric + backend + executor + GEMM-precision configuration
+    (a bf16-ladder row must never be the baseline a highest-tier run is
+    judged against, and vice versa — no cross-precision comparisons)."""
     blocking = row.get("blocking")
     return "|".join(str(x) for x in (
         row.get("metric", "?"),
         row.get("backend", "?"),
         row.get("granularity", "?"),
         row.get("schedule", "?"),
+        row.get("gemm_precision", "?"),
         ",".join(str(b) for b in blocking) if blocking else "?",
     ))
 

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Precision-safety CI gate: the throughput ladder never delivers a
+failing X, and the Pallas fused path is bitwise-equal to ``.at[]``.
+
+Phase A — BERR gate / escalation (docs/PERFORMANCE.md throughput
+ladder): the bf16 GEMM tier on an ill-conditioned gallery matrix
+(hilbert) must either pass the componentwise-BERR gate outright or
+ESCALATE through the gemm-precision rung — the solve must come back
+``converged`` with berr <= target and the ladder actions recorded in
+the SolveReport.  Run twice: with iterative refinement (the default
+path) and with IterRefine.NOREFINE (opting out of IR must not opt out
+of the gate).
+
+Phase B — Pallas equivalence: a full factorization of the bench-class
+matrix under ``SLU_TPU_PALLAS=interpret`` must be BITWISE-identical to
+the ``.at[]`` lowering on the same plan, per executor — the contract
+that lets every older equivalence gate (schedule-equiv, solve-equiv,
+compile-budget) carry over to the fused path unchanged.
+
+Gate contract (scripts/ci_gates.sh): exit 0 = pass, exit 1 = any
+violation, diagnostics on stdout/stderr, runs under the shared
+per-gate timeout.
+"""
+
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def fail(msg: str):
+    print(f"FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def phase_a() -> None:
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import hilbert
+    from superlu_dist_tpu.utils.options import IterRefine, Options
+
+    a = hilbert(8)
+    b = a.matvec(np.ones(a.n_rows))
+    for label, opts in (
+            ("refine", Options(gemm_prec="bf16", factor_dtype="float32")),
+            ("norefine", Options(gemm_prec="bf16", factor_dtype="float32",
+                                 iter_refine=IterRefine.NOREFINE))):
+        x, lu, stats, info = gssvx(opts, a, b)
+        rep = stats.solve_report
+        if info != 0:
+            fail(f"phase A [{label}]: info={info}")
+        if not np.all(np.isfinite(np.asarray(x))):
+            fail(f"phase A [{label}]: non-finite X delivered")
+        if rep.berr is None or rep.target is None:
+            fail(f"phase A [{label}]: no BERR gate was applied "
+                 f"({rep.summary()})")
+        if not rep.converged or rep.berr > rep.target:
+            fail(f"phase A [{label}]: delivered berr {rep.berr:.3e} "
+                 f"misses the gate {rep.target:.3e} and was still "
+                 f"reported — {rep.summary()}")
+        if not rep.rungs:
+            fail(f"phase A [{label}]: bf16 on hilbert(8) met the f64 "
+                 "gate without any ladder action — the gate matrix is "
+                 "no longer exercising escalation; pick a harder one")
+        print(f"  phase A [{label}]: berr {rep.berr:.3e} <= "
+              f"{rep.target:.3e} via "
+              f"{[f'{r.name}[{r.detail}]' for r in rep.rungs]} "
+              f"(tier {rep.gemm_precision}, dtype {rep.factor_dtype})")
+
+
+def phase_b() -> None:
+    from superlu_dist_tpu.drivers.gssvx import analyze
+    from superlu_dist_tpu.models.gallery import poisson3d
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.utils.options import Options
+
+    a = poisson3d(10)
+    lu, bvals, _ = analyze(Options(), a)
+    plan, anorm = lu.plan, lu.anorm
+
+    def run(executor):
+        num = numeric_factorize(plan, bvals, anorm, dtype="float32",
+                                executor=executor)
+        return [(np.asarray(lp), np.asarray(up)) for lp, up in num.fronts]
+
+    for executor in ("fused", "stream", "mega"):
+        os.environ.pop("SLU_TPU_PALLAS", None)
+        base = run(executor)
+        os.environ["SLU_TPU_PALLAS"] = "interpret"
+        try:
+            pal = run(executor)
+        finally:
+            os.environ.pop("SLU_TPU_PALLAS", None)
+        for g, ((bl, bu), (ql, qu)) in enumerate(zip(base, pal)):
+            if not ((bl == ql).all() and (bu == qu).all()):
+                fail(f"phase B: executor {executor} group {g} differs "
+                     "between SLU_TPU_PALLAS=interpret and the .at[] "
+                     "lowering — the bitwise contract is broken")
+        print(f"  phase B: {executor} Pallas==.at[] bitwise over "
+              f"{len(base)} groups")
+
+
+def main() -> int:
+    print("== precision-safety gate ==")
+    phase_a()
+    phase_b()
+    print("precision-safety: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -59,6 +59,13 @@
 #                   SLU114 collective-lockstep audits under
 #                   SLU_TPU_VERIFY_PROGRAMS=1; donation coverage 100%,
 #                   baked const bytes 0
+#   precision-safety scripts/check_precision_safety.py  throughput
+#                   ladder: the bf16 GEMM tier on an ill-conditioned
+#                   gallery matrix passes the componentwise-BERR gate
+#                   or escalates (never delivers a failing X, with and
+#                   without iterative refinement), and the Pallas
+#                   interpret-mode extend-add/assembly path is bitwise
+#                   vs the .at[] lowering per executor
 #   fleet-failover  scripts/check_fleet_failover.py   serving fleet:
 #                   3 process replicas serving a mixed ≥8-matrix
 #                   stream, kill -9 of one replica mid-stream loses
@@ -102,10 +109,12 @@ declare -A GATES=(
   [tsan-native]="scripts/check_tsan_native.sh"
   [program-audit]="python scripts/check_program_audit.py"
   [fleet-failover]="python scripts/check_fleet_failover.py"
+  [precision-safety]="python scripts/check_precision_safety.py"
 )
 ORDER=(slulint program-audit verify-overhead schedule-equiv solve-equiv
-       serve-robust fleet-failover crash-resume rank-failure
-       compile-budget tsan-native trace-overhead nan-guards perf-regress)
+       precision-safety serve-robust fleet-failover crash-resume
+       rank-failure compile-budget tsan-native trace-overhead nan-guards
+       perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

@@ -31,6 +31,31 @@ import os
 import re
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from superlu_dist_tpu.utils.options import env_float  # noqa: E402
+from superlu_dist_tpu.utils.peaks import table_peak_gflops  # noqa: E402
+
+
+def _row_mfu(row: dict) -> float:
+    """A row's MFU — recomputed against the per-backend/per-precision
+    peak table (utils/peaks.py; SLU_TPU_PEAK_GFLOPS overrides) whenever
+    the row itself carries none, so legacy rows stop printing the
+    constant-denominator 0.0.  Rows measured on another machine's CPU
+    stay at their recorded value (that machine's peak is unknowable
+    here)."""
+    mfu = row.get("mfu_pct") or 0.0
+    if mfu:
+        return float(mfu)
+    value = row.get("value")
+    if not value:
+        return 0.0
+    peak = env_float("SLU_TPU_PEAK_GFLOPS")
+    if peak <= 0 and row.get("backend") not in (None, "cpu"):
+        peak = table_peak_gflops(row.get("backend", "tpu"),
+                                 row.get("gemm_precision", "highest")) or 0.0
+    return round(100.0 * float(value) / peak, 4) if peak > 0 else 0.0
+
 
 def _iter_trace_events(text: str):
     """Yield event dicts from a Chrome trace JSON or a JSONL sidecar;
@@ -146,7 +171,8 @@ def main():
         fs = r.get("factor_seconds", 0.0) or 0.0
         dshare = (f" dispatch {100 * disp / fs:4.0f}%"
                   if disp is not None and fs else "")
-        print(f"{r['value']:8.1f} GF/s  mfu {r.get('mfu_pct', 0):5.2f}%  "
+        print(f"{r['value']:8.1f} GF/s  mfu {_row_mfu(r):7.4f}%  "
+              f"gemm {r.get('gemm_precision', '?'):<7s} "
               f"pad {r.get('padding_factor', '?'):>4}  "
               f"{r.get('granularity', '?'):<6} "
               f"kern {r.get('n_kernels', '?'):>3}{dshare}  "

@@ -173,7 +173,9 @@ class LUFactorization:
                         fused=False if multiproc else "auto",
                         schedule=self.options.solve_schedule,
                         window=self.options.solve_window,
-                        align=self.options.solve_align)
+                        align=self.options.solve_align,
+                        gemm_prec=getattr(self.options, "gemm_prec",
+                                          None))
                 return device_call(self.dev_solver)
             except Exception as e:
                 if self.solve_path != "auto" or multiproc:
@@ -403,7 +405,8 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
                 ckpt_dir=(options.ckpt_dir or None) if want_ckpt else None,
                 ckpt_every=options.ckpt_every if want_ckpt else 0,
                 resume_from=resume_from,
-                deadline=deadline)
+                deadline=deadline,
+                gemm_prec=getattr(options, "gemm_prec", None))
         for lp, up in numeric.fronts:
             if hasattr(lp, "block_until_ready"):
                 lp.block_until_ready()
@@ -411,8 +414,13 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
     stats.ops["FACT"] += plan.flops
     stats.tiny_pivots += numeric.tiny_pivots
     # dispatch-schedule telemetry (numeric/plan.py): surfaced on the
-    # same Stats the PStatPrint-analog report prints
-    stats.sched = plan.schedule_stats()
+    # same Stats the PStatPrint-analog report prints; bytes_moved uses
+    # the factor dtype's real itemsize (df64 = paired f64 components)
+    try:
+        _isz = np.dtype(dtype).itemsize
+    except TypeError:
+        _isz = 16
+    stats.sched = plan.schedule_stats(itemsize=_isz)
     # retrace sentinel (runtime SLU106): unexpected recompiles during
     # THIS factorization, surfaced on the same Stats the report prints
     stats.retraces += RETRACE_SENTINEL.total - retr0
@@ -425,6 +433,10 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
         sched = stats.sched
         m.inc("slu_factorizations_total", 1.0,
               schedule=sched.get("schedule", "?"))
+        # throughput-ladder telemetry: which GEMM tier the factors ran
+        # at (the escalation rung increments this again per refactor)
+        m.inc("slu_gemm_precision_total", 1.0,
+              tier=getattr(numeric, "gemm_prec", "highest"))
         m.set("slu_schedule_groups", sched.get("n_groups", 0))
         m.set("slu_schedule_occupancy", sched.get("occupancy", 0.0))
         m.set("slu_schedule_critical_path", sched.get("critical_path", 0))
@@ -666,16 +678,65 @@ def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
         done = attempt("residual-precision", "float64 residual",
                        solve_fn, np.float64, cur_x)
 
+    # ---- rung 1.5: gemm-precision ladder ------------------------------------
+    # The throughput-ladder safety net (docs/PERFORMANCE.md): a reduced
+    # GEMM tier (bf16 / the tensorfloat-analog default) that missed the
+    # BERR gate refactors the SAME skeleton — same dtype, same scalings,
+    # same plan — one tier up per rung until the gate passes or the
+    # ladder tops out at "highest".  This is what makes the fast tier
+    # safe to run default-on: delivered accuracy is gated, never assumed.
+    from superlu_dist_tpu.ops.dense import next_gemm_precision
+    tier = getattr(lu.numeric, "gemm_prec", "highest")
+    while not done and len(report.rungs) < recovery.max_rungs:
+        nxt = next_gemm_precision(tier)
+        if nxt is None:
+            break
+        bvals = _permuted_values(lu)
+        if bvals is None:
+            break
+        t0 = time.perf_counter()
+        lu_prec = dataclasses.replace(
+            lu, numeric=None, dev_solver=None, dev_spmv=None, berrs=None,
+            options=dataclasses.replace(options, gemm_prec=nxt))
+        try:
+            info_p = factorize_numeric(lu_prec, bvals, stats)
+        except SuperLUError as e:
+            report.rungs.append(RungRecord(
+                name="gemm-precision", detail=f"{nxt}: {type(e).__name__}",
+                berr_before=cur_berr,
+                seconds=time.perf_counter() - t0))
+            break
+        if info_p != 0:
+            report.rungs.append(RungRecord(
+                name="gemm-precision", detail=f"{nxt}: info={info_p}",
+                berr_before=cur_berr,
+                seconds=time.perf_counter() - t0))
+            break
+        solve_p = _trans_solver(lu_prec, trans, a_dtype)
+        done = attempt("gemm-precision", nxt, solve_p, np.float64, cur_x)
+        adopted = solve_fn is solve_p
+        if adopted:                   # adopted: the answer now rests on
+            lu_eff = lu_prec          # the higher-tier factors
+        tier = nxt
+        if not done and not adopted:
+            # the tier step bought nothing: the GEMM precision is not
+            # the binding error source (factor DTYPE usually is) —
+            # leave the remaining rung budget to the dtype escalation
+            break
+
     # ---- rung 2: higher-precision correction factors ------------------------
     esc = _escalation_dtype(lu.numeric.dtype)
     if (not done and esc is not None
             and len(report.rungs) < recovery.max_rungs):
         bvals = _permuted_values(lu)
         if bvals is not None:
+            # dtype escalation subsumes the gemm ladder: the hiprec
+            # refactor always runs at the top GEMM tier
             lu_esc = dataclasses.replace(
                 lu, numeric=None, dev_solver=None, dev_spmv=None,
                 berrs=None,
-                options=dataclasses.replace(options, factor_dtype=esc))
+                options=dataclasses.replace(options, factor_dtype=esc,
+                                            gemm_prec="highest"))
             try:
                 info2 = factorize_numeric(lu_esc, bvals, stats)
             except SuperLUError:
@@ -699,6 +760,7 @@ def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
             options, fact=Fact.DOFACT, equil=True,
             row_perm=RowPerm.LargeDiag_MC64, replace_tiny_pivot=True,
             factor_dtype=esc if esc is not None else options.factor_dtype,
+            gemm_prec="highest",        # the last rung gambles nothing
             iter_refine=IterRefine.SLU_DOUBLE, print_stat=False,
             user_perm_r=None,
             # no recursion, no mid-ladder raises: the ladder itself is
@@ -736,6 +798,13 @@ def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
                 berr_before=cur_berr,
                 seconds=time.perf_counter() - t0))
 
+    # the tier/dtype the delivered answer actually rests on (lu_eff may
+    # be an escalated handle from any rung above)
+    if lu_eff.numeric is not None:
+        report.gemm_precision = getattr(lu_eff.numeric, "gemm_prec",
+                                        report.gemm_precision)
+        report.factor_dtype = str(lu_eff.numeric.dtype)
+
     # serving metrics: one rung-transition counter per ladder action
     # this solve took (labeled by rung and whether it was adopted)
     from superlu_dist_tpu.obs.metrics import get_metrics
@@ -772,7 +841,9 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
 
     info = 0
     report = SolveReport(factor_dtype=str(lu.numeric.dtype),
-                         tiny_pivots=lu.numeric.tiny_pivots)
+                         tiny_pivots=lu.numeric.tiny_pivots,
+                         gemm_precision=getattr(lu.numeric, "gemm_prec",
+                                                "highest"))
     if stats.resume:
         # a factorization resumed from a durable checkpoint is a ladder
         # action in its own right: the report must show the answer rests
@@ -847,6 +918,37 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                             and report.berr <= target)
     else:
         lu_final = lu
+        # NOREFINE + a reduced GEMM tier: the throughput ladder still
+        # owes the caller a gated answer — one componentwise-BERR probe
+        # (refine/ir.request_berrs, a single SpMV pair) stands in for
+        # the refinement loop's measurement, and a miss runs the same
+        # escalation ladder (which refines internally; opting out of IR
+        # is not opting out of "never deliver a failing X")
+        tier0 = getattr(lu.numeric, "gemm_prec", "highest")
+        from superlu_dist_tpu.ops.dense import next_gemm_precision
+        # armed only when the tier is a REAL gamble on this backend
+        # (next_gemm_precision is None when the remaining rungs are
+        # arithmetic no-ops — CPU's default tier IS the exact baseline,
+        # and gating it would escalate answers the caller's NOREFINE +
+        # factor_dtype choice deliberately left at factor precision)
+        if recovery.enabled and next_gemm_precision(tier0) is not None:
+            from superlu_dist_tpu.refine.ir import request_berrs
+            eps_w = float(np.finfo(np.float64).eps)
+            target = (recovery.berr_target if recovery.berr_target
+                      else 10.0 * eps_w)
+            report.target = target
+            try:
+                report.berr = float(request_berrs(op, b, x).max())
+            except Exception:
+                report.berr = None       # probe must never kill a solve
+            bad = (report.berr is None or report.berr > target
+                   or not np.all(np.isfinite(np.asarray(x))))
+            if bad:
+                x, lu_final, solve_fn, _ = _escalate(
+                    options, a, op, b, lu, stats, trans, solve_fn, x,
+                    np.float64, report, target)
+            report.converged = (report.berr is not None
+                                and report.berr <= target)
 
     # rcond/ferr (the pdgscon + dgsrfs-FERR reporting): "always", or on
     # "auto" only when the answer needs defending — the ladder fired,

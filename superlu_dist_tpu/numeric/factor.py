@@ -59,7 +59,8 @@ def extend_add_set(f, pool, m, ub, child_off, child_slot, rel):
 
 def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
                children, front_sharding=None, pivot_sharding=None,
-               replicated=None, pivot="blocked"):
+               replicated=None, pivot="blocked", gemm_prec="highest",
+               pallas="off"):
     """One (level, bucket) group: assemble + factor + write back.
 
     dims = (batch, m, w, u) static; `children` is either a list of
@@ -72,10 +73,18 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     slots == batch and gather sources past the array end are
     dropped/filled — all index arithmetic keeps OOB entries OOB (rel
     sentinel == m maps past m*m).
+
+    ``gemm_prec`` is the caller-resolved GEMM-precision ladder tier and
+    ``pallas`` the resolved fused-kernel mode (numeric/pallas_kernels):
+    both are baked into the cached jitted factories' keys, never read
+    from env here (slulint SLU102/SLU105).  The Pallas path is bitwise-
+    identical to the ``.at[]`` lowering, so every executor-equivalence
+    contract is mode-independent; sharded runs arrive with pallas="off".
     """
     batch, m, w, u = dims
     dt = pool.dtype
     wsc = jax.lax.with_sharding_constraint
+    use_pallas = pallas in ("on", "interpret")
 
     f = jnp.zeros((batch, m * m), dtype=dt)
     if replicated is not None:
@@ -86,12 +95,23 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     diag_mask = (k[None, :] >= ws[:, None]) & (k[None, :] < w)
     f = f.at[:, k * m + k].add(diag_mask.astype(dt))
     if a_src.shape[0]:
-        vals = avals.at[a_src].get(mode="fill", fill_value=0)
-        f = f.at[(a_slot, a_flat)].add(vals, mode="drop")
+        f2 = None
+        if use_pallas:
+            from superlu_dist_tpu.numeric.pallas_kernels import (
+                assemble_avals_pallas)
+            f2 = assemble_avals_pallas(f, avals, a_slot, a_flat, a_src,
+                                       mode=pallas)
+        if f2 is not None:
+            f = f2
+        else:
+            vals = avals.at[a_src].get(mode="fill", fill_value=0)
+            f = f.at[(a_slot, a_flat)].add(vals, mode="drop")
     if isinstance(children, tuple):
         # stacked child tables (mega executor): scan the shared per-set
         # extend-add — the sets fold into f in the same sequence the
         # Python loop below runs them, so the factors stay bitwise equal
+        # (the per-set ub is TRACED here, so this branch keeps the .at[]
+        # lowering under every pallas mode)
         c_off, c_slot, c_ub, c_rel = children
         if c_off.shape[0]:
             def body(fc, xs):
@@ -100,13 +120,23 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
             f, _ = jax.lax.scan(body, f, (c_off, c_slot, c_ub, c_rel))
     else:
         for (ub, child_off, child_slot, rel) in children:
-            f = extend_add_set(f, pool, m, ub, child_off, child_slot, rel)
+            f2 = None
+            if use_pallas:
+                from superlu_dist_tpu.numeric.pallas_kernels import (
+                    extend_add_set_pallas)
+                f2 = extend_add_set_pallas(f, pool, m, ub, child_off,
+                                           child_slot, rel, mode=pallas)
+            if f2 is not None:
+                f = f2
+            else:
+                f = extend_add_set(f, pool, m, ub, child_off, child_slot,
+                                   rel)
     f = f.reshape(batch, m, m)
     if front_sharding is not None:
         f = wsc(f, front_sharding)
     lpanel, upanel, schur, counts = group_partial_factor(
         f, thresh, w, front_sharding=front_sharding,
-        pivot_sharding=pivot_sharding, pivot=pivot)
+        pivot_sharding=pivot_sharding, pivot=pivot, gemm_prec=gemm_prec)
     # counts is (batch, w) per-column tiny flags; identity-padding columns
     # (col >= ws, incl. whole padded batch slots with ws == 0) are unit
     # pivots — don't let a thresh > 1 count them as tiny
@@ -162,6 +192,11 @@ class NumericFactorization:
     resumed_groups: int = 0   # dispatch groups restored from a durable
                               # checkpoint frontier instead of recomputed
                               # (persist/checkpoint.py; 0 = fresh run)
+    gemm_prec: str = "highest"  # GEMM-precision ladder tier the Schur
+                              # updates ran at (ops/dense.gemm_precision)
+                              # — recorded so the BERR gate / escalation
+                              # rung and the SolveReport can name the
+                              # tier the delivered answer rests on
 
     @property
     def on_host(self) -> bool:
@@ -183,7 +218,8 @@ class NumericFactorization:
 
 
 def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
-                   pool_partition: bool = False):
+                   pool_partition: bool = False, gemm_prec=None,
+                   pallas=None):
     """Build the whole numeric factorization as ONE jittable function.
 
     Returns fn(avals, thresh) -> (fronts_tuple, tiny_count).  The plan's
@@ -229,11 +265,16 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
         for (_, child_off, child_slot, rel) in children:
             flat_args.extend((child_off, child_slot, rel))
     flat_args = tuple(flat_args)
-    # SLU_TPU_PIVOT_KERNEL resolved HERE, in the uncached factory, and
-    # closed over as a constant — get_executor keys the fused executor on
-    # it, and the traced body must not read env (slulint SLU102/SLU105)
-    from superlu_dist_tpu.ops.dense import pivot_kernel
+    # SLU_TPU_PIVOT_KERNEL / SLU_TPU_GEMM_PREC / SLU_TPU_PALLAS resolved
+    # HERE, in the uncached factory, and closed over as constants —
+    # get_executor keys the fused executor on them, and the traced body
+    # must not read env (slulint SLU102/SLU105).  Sharded runs pin the
+    # Pallas path off (the SPMD partitioner owns the layout).
+    from superlu_dist_tpu.numeric.pallas_kernels import pallas_mode
+    from superlu_dist_tpu.ops.dense import gemm_precision, pivot_kernel
     pivot = pivot_kernel()
+    gemm_prec = gemm_precision(gemm_prec)
+    pallas = "off" if mesh is not None else pallas_mode(pallas)
 
     def fn(avals, thresh, *flat):
         avals = avals.astype(dtype)
@@ -254,7 +295,8 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
                 (grp.batch, grp.m, grp.w, grp.u), avals, pool, thresh,
                 a_slot, a_flat, a_src, ws, off, children,
                 front_sharding=sharding, pivot_sharding=pivot_sharding,
-                replicated=replicated, pivot=pivot)
+                replicated=replicated, pivot=pivot, gemm_prec=gemm_prec,
+                pallas=pallas)
             if mesh is not None:
                 pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
             fronts.append(packed)
@@ -324,7 +366,7 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
 
 
 def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
-                 mesh=None, pool_partition: bool = False):
+                 mesh=None, pool_partition: bool = False, gemm_prec=None):
     """Executor for a plan, cached on the plan (SamePattern reuse tier).
 
     executor: "fused" (one XLA program — fast dispatch, compile grows with
@@ -355,12 +397,19 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
         cache = plan._factor_fns = {}
-    from superlu_dist_tpu.ops.dense import pivot_kernel
+    from superlu_dist_tpu.numeric.pallas_kernels import pallas_mode
+    from superlu_dist_tpu.ops.dense import gemm_precision, pivot_kernel
     from superlu_dist_tpu.utils.options import env_float
-    # the fused executor bakes the pivot-kernel choice into its one traced
-    # program, so the choice must be part of its identity; StreamExecutor
-    # re-reads it per call (stream._kernel / _level_fns key on it)
+    # every executor bakes the GEMM-precision tier and the Pallas mode
+    # into its compiled programs, so both are part of its identity (the
+    # escalation rung's refactor-at-the-next-tier relies on getting a
+    # FRESH executor); the fused executor additionally bakes the
+    # pivot-kernel choice, which StreamExecutor re-reads per call
+    # (stream._kernel / _level_fns key on it)
+    gemm_prec = gemm_precision(gemm_prec)
+    pallas = "off" if mesh is not None else pallas_mode()
     key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition),
+           gemm_prec, pallas,
            pivot_kernel() if executor == "fused" else None,
            # StreamExecutor latches the host-share threshold at
            # construction — a changed SLU_TPU_HOST_FLOPS needs a new one
@@ -371,13 +420,16 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
         if executor == "stream":
             from superlu_dist_tpu.numeric.stream import StreamExecutor
             fn = StreamExecutor(plan, dtype, mesh=mesh,
-                                pool_partition=pool_partition)
+                                pool_partition=pool_partition,
+                                gemm_prec=gemm_prec, pallas=pallas)
         elif executor == "mega":
             from superlu_dist_tpu.numeric.mega import MegaExecutor
-            fn = MegaExecutor(plan, dtype)
+            fn = MegaExecutor(plan, dtype, gemm_prec=gemm_prec,
+                              pallas=pallas)
         else:
             fn = make_factor_fn(plan, dtype, mesh=mesh,
-                                pool_partition=pool_partition)
+                                pool_partition=pool_partition,
+                                gemm_prec=gemm_prec, pallas=pallas)
         cache[key] = fn
     return fn
 
@@ -392,7 +444,8 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                       ckpt_dir: str | None = None,
                       ckpt_every: int = 0,
                       resume_from: str | None = None,
-                      deadline=None) -> NumericFactorization:
+                      deadline=None,
+                      gemm_prec: str | None = None) -> NumericFactorization:
     """Factor with values aligned to plan.pattern_indices.
 
     anorm: ‖A‖ for the GESP tiny-pivot threshold sqrt(eps)·‖A‖
@@ -422,6 +475,11 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     dtype = jnp.dtype(dtype)
     real_dtype = jnp.dtype(dtype).type(0).real.dtype
     eps = jnp.finfo(real_dtype).eps
+    # GEMM-precision ladder tier (ops/dense.gemm_precision): resolved
+    # ONCE here so the executor, the checkpoint identity and the result
+    # record all agree on the arithmetic this factorization ran
+    from superlu_dist_tpu.ops.dense import gemm_precision
+    gemm_prec = gemm_precision(gemm_prec)
     tracer = get_tracer()
     if tracer.enabled:
         # schedule telemetry span: what the dispatch stream below is
@@ -429,7 +487,7 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
         # padding, critical path) — the same block Stats.report prints
         import time
         tracer.complete("schedule", "phase", time.perf_counter(), 0.0,
-                        **plan.schedule_stats())
+                        **plan.schedule_stats(itemsize=dtype.itemsize))
     thresh = jnp.asarray(
         np.sqrt(float(eps)) * max(anorm, 1e-300) if replace_tiny else 0.0,
         dtype=real_dtype)
@@ -450,18 +508,23 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
             executor = "stream"
     if want_ckpt:
         from superlu_dist_tpu.persist.checkpoint import FactorCheckpointer
+        # the GEMM tier is part of the frontier's numeric identity: a
+        # bf16 frontier spliced under highest arithmetic would silently
+        # break the bitwise-resume guarantee
         ckpt = FactorCheckpointer(ckpt_dir or ".slu_ckpt", plan,
                                   pattern_values, thresh, dtype,
-                                  every=int(ckpt_every))
+                                  every=int(ckpt_every),
+                                  gemm_prec=gemm_prec)
     resume = None
     if resume_from:
         from superlu_dist_tpu.persist.checkpoint import load_checkpoint
         resume = load_checkpoint(resume_from, plan=plan,
                                  pattern_values=pattern_values,
-                                 thresh=thresh, dtype=dtype)
+                                 thresh=thresh, dtype=dtype,
+                                 gemm_prec=gemm_prec)
     avals = jnp.asarray(pattern_values, dtype=dtype)
     fn = get_executor(plan, dtype, executor, mesh=mesh,
-                      pool_partition=pool_partition)
+                      pool_partition=pool_partition, gemm_prec=gemm_prec)
     if hasattr(fn, "check_finite"):
         # streamed executor: also sentinel each offloaded group as it
         # lands on the host (early abort — see stream._emit_front),
@@ -512,7 +575,8 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
                                 tiny_pivots=int(tiny_total), dtype=dtype,
                                 finite=finite, info_col=info_col,
                                 resumed_groups=(resume.k if resume is not None
-                                                else 0))
+                                                else 0),
+                                gemm_prec=gemm_prec)
 
 
 def fronts_finite(fronts) -> bool:

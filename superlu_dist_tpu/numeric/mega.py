@@ -63,14 +63,18 @@ _STORE_GROWTH = 1.25
 
 
 @functools.lru_cache(maxsize=None)
-def _mega_kernel(dims, la, child_dims, pool_len, avals_len, dtype, pivot):
+def _mega_kernel(dims, la, child_dims, pool_len, avals_len, dtype, pivot,
+                 gemm_prec="highest", pallas="off"):
     """ONE jitted program for a closed shape bucket.
 
     Everything per-group — which fronts, which A entries, which children
     — arrives as device-array arguments at canonical shapes; the program
-    itself is pure dataflow.  `pivot` is the caller-resolved
-    SLU_TPU_PIVOT_KERNEL choice (part of this cache key — slulint
-    SLU105)."""
+    itself is pure dataflow.  `pivot`/`gemm_prec`/`pallas` are the
+    caller-resolved SLU_TPU_PIVOT_KERNEL / SLU_TPU_GEMM_PREC /
+    SLU_TPU_PALLAS choices (part of this cache key — slulint SLU105).
+    The stacked-children extend-add keeps the .at[] scan under every
+    pallas mode (its per-set ub is traced); the A-assembly takes the
+    fused path — bitwise-identical either way."""
     batch, m, w, u = dims
 
     def step(avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
@@ -78,7 +82,7 @@ def _mega_kernel(dims, la, child_dims, pool_len, avals_len, dtype, pivot):
         return group_step((batch, m, w, u), avals, pool, thresh,
                           a_slot, a_flat, a_src, ws, off,
                           (child_off, child_slot, child_ub, rel),
-                          pivot=pivot)
+                          pivot=pivot, gemm_prec=gemm_prec, pallas=pallas)
 
     # pool donated exactly like the streamed kernels: XLA scatters the
     # Schur write-back in place instead of copying pool_len entries
@@ -97,7 +101,7 @@ class MegaExecutor(StreamExecutor):
 
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
                  offload: str = "auto", pool_partition: bool = False,
-                 host_flops=None):
+                 host_flops=None, gemm_prec=None, pallas=None):
         if mesh is not None or pool_partition:
             raise ValueError(
                 "MegaExecutor is single-device (its metadata-as-data "
@@ -110,7 +114,8 @@ class MegaExecutor(StreamExecutor):
         # placement of the packed metadata
         super().__init__(plan, dtype, mesh=None, offload=offload,
                          pool_partition=False, granularity="group",
-                         host_flops=0.0)
+                         host_flops=0.0, gemm_prec=gemm_prec,
+                         pallas=pallas)
         self.granularity = "mega"
 
     # ---- canonical metadata packing -------------------------------------
@@ -178,7 +183,7 @@ class MegaExecutor(StreamExecutor):
         fn = self._mega_fns.get((key, pivot))
         if fn is not None:
             return fn
-        jfn = _mega_kernel(*key, pivot)
+        jfn = _mega_kernel(*key, pivot, self.gemm_prec, self.pallas)
         sds = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in args)
         # program audit at AOT-stage time: a finding raises BEFORE the
         # XLA compile below ever runs (SLU_TPU_VERIFY_PROGRAMS=1)

@@ -1,0 +1,229 @@
+"""Pallas fused gather/scatter kernels for the irregular factor hot spots.
+
+The two memory-bound seams around the Schur-update GEMMs are irregular
+gather/scatter round trips XLA lowers to serial scatter loops on TPU:
+
+* the extend-add (``factor.extend_add_set``): per child-set, a
+  ``pool.at[src].get`` of every child's padded ub×ub Schur block followed
+  by an ``f.at[...].add`` scatter into the parent fronts — the
+  multifrontal assembly traffic that bounds how wide the dataflow
+  scheduler's look-ahead (``SLU_TPU_SCHED_WINDOW``) can open;
+* the A-entry panel assembly (``group_step``): an ``avals`` gather and a
+  front scatter-add over the host-built (slot, flat, src) index triples.
+
+This module provides both as Pallas kernels in the spirit of
+medium-granularity dataflow sparse engines (arXiv:2406.10511): the
+gather, the position expansion and the accumulate run fused in one
+kernel per dispatch group, with the front batch resident block-by-block
+in VMEM instead of round-tripping through HBM per index triple.
+
+Equivalence contract (tests/test_precision_ladder.py pins it): both
+kernels are BITWISE-identical to the ``.at[]`` lowering —
+
+* the extend-add accumulates child contributions in ascending child
+  order via exact one-hot position matmuls (``Precision.HIGHEST`` keeps
+  v·1.0 exact on the MXU) and touches only targeted positions (the
+  masked ``where`` preserves untargeted bits, including -0.0), matching
+  XLA's in-order scatter-add application;
+* the assembly scatter targets are unique per (slot, flat) — the
+  host-built maps assign every A entry its own front position — so the
+  slot-sorted accumulation order cannot change the sum.
+
+Because the two paths are bitwise-equal, every existing equivalence
+gate (level↔dataflow, mega≡stream≡fused, checkpoint resume) carries
+over unchanged whichever path a run takes.
+
+Gating: ``SLU_TPU_PALLAS`` = auto (on when a TPU backend is present),
+1/on, interpret (forced interpreter mode — what CI exercises on CPU),
+or 0/off.  The mode is resolved in the UNCACHED executor factories and
+threaded into every kernel cache key like the pivot-kernel choice
+(slulint SLU102/SLU104/SLU105); mesh-sharded runs pin it off (the SPMD
+partitioner owns the layout there).  Index maps are cast to int32 for
+the kernels — plans past the int32 pool range fall back to ``.at[]``
+(``plan.check_index_width`` governs those anyway).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from superlu_dist_tpu.utils.options import env_str
+
+#: modes the resolver returns; "on" compiles (TPU), "interpret" runs the
+#: Pallas interpreter (bitwise-identical semantics, any backend)
+PALLAS_MODES = ("off", "on", "interpret")
+
+_INT32_MAX = 2 ** 31 - 1
+
+
+def pallas_mode(name: str | None = None) -> str:
+    """Resolve SLU_TPU_PALLAS to one of ``PALLAS_MODES``.
+
+    auto = on iff the default backend is TPU; an explicit 1/on on a
+    non-TPU backend degrades to interpret (there is no Mosaic lowering
+    to run, but the fused path stays exercisable).  Resolved in the
+    uncached executor factories only — the mode is part of every kernel
+    cache key, never read at trace time."""
+    raw = (env_str("SLU_TPU_PALLAS") if name is None or not str(name).strip()
+           else str(name)).strip().lower()
+    if raw in ("", "auto"):
+        return "on" if jax.default_backend() == "tpu" else "off"
+    if raw in ("0", "off", "false", "no"):
+        return "off"
+    if raw == "interpret":
+        return "interpret"
+    if raw in ("1", "on", "true", "yes"):
+        return "on" if jax.default_backend() == "tpu" else "interpret"
+    raise ValueError(f"SLU_TPU_PALLAS={raw!r} — expected auto|0|1|on|off|"
+                     "interpret")
+
+
+def _i32(x):
+    return jnp.asarray(x).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# extend-add: pool gather -> one-hot position expansion -> front accumulate
+# ---------------------------------------------------------------------------
+
+def _extend_add_kernel(off_ref, slot_ref,          # SMEM (C,) scalars
+                       rel_ref, pool_ref, f_ref,   # ANY
+                       out_ref,                    # ANY, aliased with f
+                       child_vmem, sem,            # scratch
+                       *, m, ub, nc, pool_len):
+    """One parent slot's extend-add: walk the child set in ascending
+    child order, DMA each matching child's contiguous ub² pool slab into
+    VMEM, expand it to front positions with exact one-hot matmuls, and
+    accumulate — touching ONLY targeted positions (mask), which is what
+    keeps the result bitwise-equal to XLA's scatter-add."""
+    s = pl.program_id(0)
+    out_ref[...] = f_ref[...]
+
+    def body(c, carry):
+        @pl.when((slot_ref[c] == s) & (off_ref[c] < pool_len))
+        def _():
+            dma = pltpu.make_async_copy(
+                pool_ref.at[pl.ds(off_ref[c], ub * ub)], child_vmem, sem)
+            dma.start()
+            dma.wait()
+            child = child_vmem[...].reshape(ub, ub)
+            r = rel_ref[c]                                  # (ub,) int32
+            pos = lax.broadcasted_iota(jnp.int32, (ub, m), 1)
+            hit = r[:, None] == pos                         # (ub, m)
+            oh = hit.astype(child.dtype)
+            member = hit.any(axis=0)                        # (m,) targeted
+            # rel positions are distinct (or the OOB sentinel), so every
+            # one-hot contraction has at most ONE nonzero term — exact
+            # at HIGHEST precision (v·1.0 reconstructs v on the MXU)
+            upd = jnp.matmul(
+                oh.T, jnp.matmul(child, oh,
+                                 precision=lax.Precision.HIGHEST),
+                precision=lax.Precision.HIGHEST)
+            mask = member[:, None] & member[None, :]
+            cur = out_ref[...].reshape(m, m)
+            out_ref[...] = jnp.where(mask, cur + upd,
+                                     cur).reshape(1, m * m)
+        return carry
+
+    lax.fori_loop(0, nc, body, 0)
+
+
+def extend_add_set_pallas(f, pool, m, ub, child_off, child_slot, rel,
+                          mode: str = "interpret"):
+    """Pallas twin of ``factor.extend_add_set`` — same signature
+    semantics, bitwise-identical result.  Returns None when this
+    child-set cannot take the fused path (int32 index overflow) so the
+    caller falls back to the ``.at[]`` lowering."""
+    if int(pool.shape[0]) > _INT32_MAX or m * m > _INT32_MAX:
+        return None
+    batch = f.shape[0]
+    nc = rel.shape[0]
+    kern = functools.partial(_extend_add_kernel, m=int(m), ub=int(ub),
+                             nc=int(nc), pool_len=int(pool.shape[0]))
+    return pl.pallas_call(
+        kern,
+        grid=(batch,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # child_off
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # child_slot
+            pl.BlockSpec(memory_space=pltpu.ANY),           # rel
+            pl.BlockSpec(memory_space=pltpu.ANY),           # pool
+            pl.BlockSpec((1, m * m), lambda s: (s, 0),
+                         memory_space=pltpu.ANY),           # f block
+        ],
+        out_specs=pl.BlockSpec((1, m * m), lambda s: (s, 0),
+                               memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(f.shape, f.dtype),
+        scratch_shapes=[pltpu.VMEM((ub * ub,), f.dtype),
+                        pltpu.SemaphoreType.DMA],
+        input_output_aliases={4: 0},
+        interpret=(mode == "interpret"),
+    )(_i32(child_off), _i32(child_slot), _i32(rel), pool, f)
+
+
+# ---------------------------------------------------------------------------
+# A-entry panel assembly: avals gather -> slot-sorted front scatter-add
+# ---------------------------------------------------------------------------
+
+def _assemble_kernel(bounds_ref,                   # SMEM (batch+1,)
+                     flat_ref, src_ref, avals_ref, f_ref,   # ANY
+                     out_ref,                      # ANY, aliased with f
+                     *, m2):
+    """One slot's A-entry assembly: its contiguous slot-sorted entry run
+    [bounds[s], bounds[s+1]) gathers from avals and accumulates into the
+    resident front block.  Targets are unique per entry (the host-built
+    maps give every A entry its own front position), so the sorted order
+    cannot change any floating-point sum."""
+    s = pl.program_id(0)
+    out_ref[...] = f_ref[...]
+
+    def body(e, carry):
+        fl = flat_ref[e]
+        out_ref[0, fl] = out_ref[0, fl] + avals_ref[src_ref[e]]
+        return carry
+
+    lax.fori_loop(bounds_ref[s], bounds_ref[s + 1], body, 0)
+
+
+def assemble_avals_pallas(f, avals, a_slot, a_flat, a_src,
+                          mode: str = "interpret"):
+    """Pallas twin of the ``group_step`` A-assembly round trip
+    (``avals.at[a_src].get`` → ``f.at[(a_slot, a_flat)].add``): entries
+    are slot-sorted on device (stable argsort — pure data movement, no
+    arithmetic) so each grid step owns one front block's contiguous run.
+    Padded entries carry the slot sentinel ``batch`` and sort past the
+    last bound — the ``mode='drop'`` analog.  Returns None on int32
+    overflow (caller falls back)."""
+    batch, m2 = f.shape
+    if m2 > _INT32_MAX or int(avals.shape[0]) > _INT32_MAX:
+        return None
+    order = jnp.argsort(_i32(a_slot), stable=True)
+    slot_s = _i32(a_slot)[order]
+    flat_s = _i32(a_flat)[order]
+    src_s = _i32(a_src)[order]
+    bounds = jnp.searchsorted(
+        slot_s, jnp.arange(batch + 1, dtype=jnp.int32)).astype(jnp.int32)
+    kern = functools.partial(_assemble_kernel, m2=int(m2))
+    return pl.pallas_call(
+        kern,
+        grid=(batch,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # bounds
+            pl.BlockSpec(memory_space=pltpu.ANY),           # flat sorted
+            pl.BlockSpec(memory_space=pltpu.ANY),           # src sorted
+            pl.BlockSpec(memory_space=pltpu.ANY),           # avals
+            pl.BlockSpec((1, m2), lambda s: (s, 0),
+                         memory_space=pltpu.ANY),           # f block
+        ],
+        out_specs=pl.BlockSpec((1, m2), lambda s: (s, 0),
+                               memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(f.shape, f.dtype),
+        input_output_aliases={4: 0},
+        interpret=(mode == "interpret"),
+    )(bounds, flat_s, src_s, avals, f)

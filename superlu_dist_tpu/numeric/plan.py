@@ -124,12 +124,39 @@ class FactorPlan:
         return (self.sf.n_supernodes / len(self.groups)
                 if self.groups else 0.0)
 
-    def schedule_stats(self) -> dict:
+    def bytes_moved(self, itemsize: int = 8) -> int:
+        """Irregular gather/scatter traffic of one factorization at this
+        plan, in bytes — the data-movement honesty twin of the flop
+        padding factor (and the number the Pallas fused kernels exist to
+        shrink: they keep the front batch VMEM-resident instead of
+        round-tripping HBM per index).  Counted per moved element as its
+        accesses on the ``.at[]`` path:
+
+        * A-entry assembly: one avals read + a front read-modify-write
+          per structural entry (3 accesses);
+        * extend-add: one pool read + a front read-modify-write per
+          child Schur element (3 accesses, real child count × ub²);
+        * Schur write-back: one front read + one pool write per u²
+          element of every real front (2 accesses).
+
+        ``itemsize`` defaults to 8 (f64); callers that know the factor
+        dtype pass its itemsize for exact bytes.
+        """
+        elems = 0
+        for g in self.groups:
+            elems += 3 * len(g.a_src)
+            elems += 3 * sum(len(cs.child_off) * cs.ub * cs.ub
+                             for cs in g.children)
+            elems += 2 * g.batch * g.u * g.u
+        return int(elems) * int(itemsize)
+
+    def schedule_stats(self, itemsize: int = 8) -> dict:
         """Schedule telemetry block shared by Stats.report, the trace
         span (numeric.factor.numeric_factorize) and the bench JSON row:
         dispatch-group count before/after aggregation, mean batch
         occupancy, shape-padding factor (executed/structural flops, batch
-        padding excluded) and the dependent-group critical-path length."""
+        padding excluded), the dependent-group critical-path length and
+        the irregular gather/scatter traffic (``bytes_moved``)."""
         from superlu_dist_tpu.symbolic.symbfact import _front_flops
         executed = float(sum(g.batch * _front_flops(g.w, g.u)
                              for g in self.groups))
@@ -140,6 +167,7 @@ class FactorPlan:
             "occupancy": round(self.mean_occupancy, 2),
             "padding_factor": round(executed / max(self.flops, 1.0), 4),
             "critical_path": self.critical_path,
+            "bytes_moved": self.bytes_moved(itemsize),
         }
 
     def __getstate__(self):

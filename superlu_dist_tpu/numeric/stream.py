@@ -114,7 +114,7 @@ def _pad_to(arr: np.ndarray, length: int, fill) -> np.ndarray:
 
 @functools.lru_cache(maxsize=None)
 def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh,
-            pool_partition, pivot):
+            pool_partition, pivot, gemm_prec="highest", pallas="off"):
     """Jitted group step for one shape key (optionally mesh-sharded).
 
     With a mesh, the dense factor math shards batch-over-"snode" and
@@ -145,7 +145,8 @@ def _kernel(dims, l_a, child_shapes, pool_size, dtype, mesh,
                                      a_slot, a_flat, a_src, ws, off, children,
                                      front_sharding=front_sharding,
                                      pivot_sharding=pivot_sharding,
-                                     replicated=replicated, pivot=pivot)
+                                     replicated=replicated, pivot=pivot,
+                                     gemm_prec=gemm_prec, pallas=pallas)
         if pool_sharding is not None:
             pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
         return out, pool, tiny
@@ -163,7 +164,8 @@ class StreamExecutor:
 
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
                  offload: str = "auto", pool_partition: bool = False,
-                 granularity: str = "group", host_flops=None):
+                 granularity: str = "group", host_flops=None,
+                 gemm_prec=None, pallas=None):
         """offload: "none" keeps every factored panel on the device;
         "host" streams each group's (lpanel, upanel) to host memory as
         soon as it is produced (copy_to_host_async overlaps the next
@@ -181,6 +183,14 @@ class StreamExecutor:
         self.dtype = str(jnp.dtype(dtype))
         self.mesh = mesh
         self.pool_partition = bool(pool_partition and mesh is not None)
+        # GEMM-precision tier + Pallas gather/scatter mode, resolved in
+        # THIS uncached constructor and latched for the executor's
+        # lifetime (they are part of get_executor's cache key, so a
+        # changed knob yields a fresh executor — slulint SLU105)
+        from superlu_dist_tpu.numeric.pallas_kernels import pallas_mode
+        from superlu_dist_tpu.ops.dense import gemm_precision
+        self.gemm_prec = gemm_precision(gemm_prec)
+        self.pallas = "off" if mesh is not None else pallas_mode(pallas)
         # granularity="level" traces all bucket groups sharing one
         # schedule wave (Group.level: the elimination level under
         # SLU_TPU_SCHEDULE=level, the monotone dispatch wave under the
@@ -340,7 +350,8 @@ class StreamExecutor:
         """The jitted program for one step key.  ``args`` is the exact
         call tuple (for AOT shape derivation in the mega subclass —
         unused here: stream kernels compile inside their first call)."""
-        return _kernel(*key, self.mesh, self.pool_partition, pivot)
+        return _kernel(*key, self.mesh, self.pool_partition, pivot,
+                       self.gemm_prec, self.pallas)
 
     def _audit_program(self, site, label, fn, args) -> None:
         """Submit one program to the runtime IR auditor
@@ -356,12 +367,13 @@ class StreamExecutor:
     def _census_pending(self, key, pivot) -> bool:
         """True when this step's FIRST invocation will build (and should
         be timed into the census by the call loop)."""
-        ck = ("group", key, self.mesh, self.pool_partition, pivot)
+        ck = ("group", key, self.mesh, self.pool_partition, pivot,
+              self.gemm_prec, self.pallas)
         return ck not in _CENSUSED_KEYS
 
     def _census_record(self, key, pivot, t0, n_args) -> None:
         _CENSUSED_KEYS.add(("group", key, self.mesh, self.pool_partition,
-                            pivot))
+                            pivot, self.gemm_prec, self.pallas))
         COMPILE_STATS.record(self._census_site, self._census_label(key),
                              t0, time.perf_counter() - t0, n_args=n_args)
 
@@ -407,8 +419,12 @@ class StreamExecutor:
         capture pattern slulint SLU112 polices."""
         from superlu_dist_tpu.ops.dense import pivot_kernel
         pivot = pivot_kernel()    # resolved OUTSIDE the traced body: the
-        fn = self._level_fns.get((level, pivot))   # choice is the cache
-        if fn is not None:                         # key (slulint SLU105)
+        # choice is the cache key (slulint SLU105); the gemm tier and
+        # pallas mode are executor-lifetime constants (latched in the
+        # constructor), so (level, pivot) stays a sufficient key here
+        gemm_prec, pallas = self.gemm_prec, self.pallas
+        fn = self._level_fns.get((level, pivot))
+        if fn is not None:
             return fn
         from superlu_dist_tpu.numeric.factor import pool_spec
         psh = (pool_spec(self.mesh, self.pool_partition)
@@ -444,7 +460,7 @@ class StreamExecutor:
                     dims, avals, pool, thresh, *a, children,
                     front_sharding=front_sharding,
                     pivot_sharding=pivot_sharding, replicated=replicated,
-                    pivot=pivot)
+                    pivot=pivot, gemm_prec=gemm_prec, pallas=pallas)
                 outs.append(out)
                 tiny = tiny + t
             if psh is not None:

@@ -35,27 +35,108 @@ from superlu_dist_tpu.utils.options import env_str
 
 _UNROLL = 16   # panel width factored by the unrolled column loop
 
-# MXU pass count for the f32 Schur GEMMs: HIGHEST = 6-pass bf16 (full f32
-# products, ~1/6 of bf16 peak), HIGH = 3-pass (~f32-mantissa-19), DEFAULT =
-# single-pass bf16.  f32 factors feed f64 iterative refinement, which
-# tolerates reduced factor precision at the cost of extra IR sweeps — the
-# HIGH tier doubles the MXU flop ceiling and is worth sweeping on hardware
-# (SLU_TPU_PRECISION=high bench run).
-_PRECISION_TIERS = {"default": lax.Precision.DEFAULT,
-                    "high": lax.Precision.HIGH,
-                    "highest": lax.Precision.HIGHEST}
+# ---------------------------------------------------------------------------
+# The GEMM precision ladder (docs/PERFORMANCE.md, throughput ladder).
+#
+# Every Schur-update GEMM in the factor hot path runs at one named tier,
+# ordered fastest/least-accurate first:
+#
+#   bf16     inputs cast to bfloat16, products accumulated in f32
+#            (preferred_element_type pins the accumulator) — the MXU's
+#            native rate (~6x the HIGHEST baseline on v5e)
+#   default  native inputs, lax.Precision.DEFAULT — single-pass bf16 on
+#            TPU (the tensorfloat analog: reduced-mantissa inputs, f32
+#            accumulate); identical math to f32 on the CPU backend
+#   f32      lax.Precision.HIGH — 3-pass bf16, ~full f32-mantissa products
+#   highest  lax.Precision.HIGHEST — 6-pass, the exact-f32 baseline
+#
+# Reduced tiers are made safe to gamble by the gemm-precision escalation
+# rung (drivers/gssvx._escalate): a delivered componentwise BERR above
+# the gate refactors the SAME skeleton at the next-higher tier, so the
+# fast path is default-on without ever degrading delivered accuracy.
+# The resolved tier is threaded as an explicit parameter (like the
+# pivot-kernel choice) — cached jitted factories key on it and the env
+# read stays in the uncached wrappers (slulint SLU102/SLU104/SLU105).
+# ---------------------------------------------------------------------------
+
+GEMM_PREC_LADDER = ("bf16", "default", "f32", "highest")
+
+_TIER_LAX = {"default": lax.Precision.DEFAULT,
+             "f32": lax.Precision.HIGH,
+             "highest": lax.Precision.HIGHEST}
+
+#: legacy SLU_TPU_PRECISION pass-count names -> ladder tiers (an
+#: explicitly-set legacy knob keeps meaning what it always meant)
+_LEGACY_TIER_MAP = {"default": "default", "high": "f32",
+                    "highest": "highest"}
 
 
-@functools.lru_cache(maxsize=None)
-def _precision():
-    """Resolved lazily at first kernel build (not import) so a typo'd env
-    var fails the matmul path with a pointed error instead of making the
-    whole package unimportable for host-only work."""
-    name = env_str("SLU_TPU_PRECISION").strip().lower()
-    if name not in _PRECISION_TIERS:
-        raise ValueError(f"SLU_TPU_PRECISION={name!r} — expected one of "
-                         f"{sorted(_PRECISION_TIERS)}")
-    return _PRECISION_TIERS[name]
+def gemm_precision(name: str | None = None) -> str:
+    """Resolve the Schur-GEMM precision tier.
+
+    ``name`` (an Options.gemm_prec value) wins when given; otherwise the
+    registered ``SLU_TPU_GEMM_PREC`` knob, then an explicitly-set legacy
+    ``SLU_TPU_PRECISION``, then the ladder default ``"default"`` (the
+    tensorfloat-analog fast path — identical math to f32 on CPU).  Read
+    only from uncached factory wrappers; the result is part of every
+    kernel cache key (slulint SLU105 discipline)."""
+    if name is None or not str(name).strip():
+        name = env_str("SLU_TPU_GEMM_PREC").strip().lower()
+        if not name:
+            legacy = env_str("SLU_TPU_PRECISION", default="").strip().lower()
+            name = _LEGACY_TIER_MAP.get(legacy, "default")
+    name = str(name).strip().lower()
+    if name not in GEMM_PREC_LADDER:
+        raise ValueError(f"SLU_TPU_GEMM_PREC={name!r} — expected one of "
+                         f"{list(GEMM_PREC_LADDER)}")
+    return name
+
+
+def next_gemm_precision(tier: str, backend: str | None = None) -> str | None:
+    """The next-higher ladder tier that actually CHANGES the arithmetic
+    on ``backend``, or None at the top — the escalation rung's step
+    function (drivers/gssvx._escalate).
+
+    XLA:CPU executes every ``lax.Precision`` identically (full f32/f64
+    products), so there the only real boundary is the bf16 input cast:
+    escalating default→f32→highest on CPU would refactor three times
+    for bitwise-identical factors, burning the ladder's rung budget on
+    no-ops before the dtype escalation gets its turn."""
+    if backend is None:
+        backend = jax.default_backend()
+    i = GEMM_PREC_LADDER.index(tier)
+    if i + 1 >= len(GEMM_PREC_LADDER):
+        return None
+    if backend == "cpu" and tier != "bf16":
+        return None          # default/f32/highest coincide on CPU
+    return GEMM_PREC_LADDER[i + 1]
+
+
+def gemm(a, b, prec: str = "highest"):
+    """One ladder-tier batched matmul: the single matmul wrapper every
+    Schur-update GEMM in the factor path (and the blocked-TRSM
+    off-diagonal GEMMs, solve/device._trsm) routes through.
+
+    ``preferred_element_type`` is pinned to the accumulator dtype on
+    every tier, so reduced-INPUT GEMMs still accumulate at f32 (or the
+    operands' own width) — the mixed-precision contract the BERR gate
+    assumes.  The bf16 tier casts real inputs to bfloat16 and casts the
+    f32-accumulated product back; complex operands have no bf16 carrier
+    and degrade to the ``default`` tier instead of silently dropping
+    imaginary precision."""
+    out_dt = jnp.result_type(a.dtype, b.dtype)
+    # 16-bit-float factor dtypes still accumulate at f32 — pinning the
+    # accumulator to bf16 would be a silent accuracy regression
+    acc_dt = (jnp.float32 if out_dt in (jnp.bfloat16, jnp.float16)
+              else out_dt)
+    if prec == "bf16" and not jnp.issubdtype(out_dt, jnp.complexfloating):
+        r = jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                       precision=lax.Precision.DEFAULT,
+                       preferred_element_type=jnp.float32)
+        return r.astype(out_dt)
+    p = _TIER_LAX["default" if prec == "bf16" else prec]
+    r = jnp.matmul(a, b, precision=p, preferred_element_type=acc_dt)
+    return r.astype(out_dt) if acc_dt != out_dt else r
 
 
 def _fix_pivot(piv, thresh):
@@ -111,11 +192,13 @@ def _lu_masked(a, thresh):
     return jax.lax.fori_loop(0, k, step, (a, jnp.zeros(k, jnp.int32)))
 
 
-def lu_nopivot(a, thresh):
+def lu_nopivot(a, thresh, gemm_prec: str = "highest"):
     """Blocked-recursive unpivoted LU with tiny-pivot replacement.
 
     Static shapes throughout; the trailing update is a single GEMM per
     recursion level, which is where XLA maps onto the MXU.
+    ``gemm_prec`` is the caller-resolved ladder tier (gemm_precision) —
+    threaded, never read from env here (slulint SLU102).
 
     Returns (packed LU, tiny: (n,) int32 per-column tiny-pivot flags).
     """
@@ -126,11 +209,11 @@ def lu_nopivot(a, thresh):
     h = min(h, n - 1)
     a11, a12 = a[:h, :h], a[:h, h:]
     a21, a22 = a[h:, :h], a[h:, h:]
-    f11, c1 = lu_nopivot(a11, thresh)
+    f11, c1 = lu_nopivot(a11, thresh, gemm_prec)
     u12 = solve_triangular(f11, a12, lower=True, unit_diagonal=True)
     l21 = solve_triangular(f11, a21.T, trans=1, lower=False).T
-    s = a22 - jnp.matmul(l21, u12, precision=_precision())
-    f22, c2 = lu_nopivot(s, thresh)
+    s = a22 - gemm(l21, u12, gemm_prec)
+    f22, c2 = lu_nopivot(s, thresh, gemm_prec)
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, f22], axis=1)
     return jnp.concatenate([top, bot], axis=0), jnp.concatenate([c1, c2])
@@ -151,7 +234,7 @@ def pivot_kernel() -> str:
     return name
 
 
-def _blocked_partial_factor(f, thresh, w):
+def _blocked_partial_factor(f, thresh, w, gemm_prec: str = "highest"):
     """Right-looking blocked partial LU of one front — compile-bounded.
 
     The recursive formulation (lu_nopivot) emits O(w/16) distinct
@@ -244,7 +327,7 @@ def _blocked_partial_factor(f, thresh, w):
         # against all columns to the right
         lpan = jnp.where(((rows >= j0 + pb) | (rows >= w))[:, None],
                          panel, zero)
-        a = a - jnp.matmul(lpan, u12, precision=_precision())
+        a = a - gemm(lpan, u12, gemm_prec)
         return a, flags
 
     a, flags = lax.fori_loop(0, nsteps, outer,
@@ -252,22 +335,23 @@ def _blocked_partial_factor(f, thresh, w):
     return a[:m, :m], flags
 
 
-def partial_front_factor(f, thresh, w):
+def partial_front_factor(f, thresh, w, gemm_prec: str = "highest"):
     """Factor the leading w columns of one front; see module docstring."""
     m = f.shape[0]
-    f11, count = lu_nopivot(f[:w, :w], thresh)
+    f11, count = lu_nopivot(f[:w, :w], thresh, gemm_prec)
     if w == m:
         return f11, count
     u12 = solve_triangular(f11, f[:w, w:], lower=True, unit_diagonal=True)
     l21 = solve_triangular(f11, f[w:, :w].T, trans=1, lower=False).T
-    s = f[w:, w:] - jnp.matmul(l21, u12, precision=_precision())
+    s = f[w:, w:] - gemm(l21, u12, gemm_prec)
     top = jnp.concatenate([f11, u12], axis=1)
     bot = jnp.concatenate([l21, s], axis=1)
     return jnp.concatenate([top, bot], axis=0), count
 
 
 def group_partial_factor(fronts, thresh, w, front_sharding=None,
-                         pivot_sharding=None, pivot="blocked"):
+                         pivot_sharding=None, pivot="blocked",
+                         gemm_prec="highest"):
     """Partial factorization of a batch of fronts with explicit shardings.
 
     Group-level formulation of partial_front_factor: the pivot-block LU is
@@ -292,10 +376,11 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
     from jax.lax import with_sharding_constraint as wsc
     m = fronts.shape[-1]
     b = fronts.shape[0]
-    # `pivot` is the caller-resolved SLU_TPU_PIVOT_KERNEL choice: this
-    # function runs inside cached jitted factories, so the env read must
-    # happen in the (uncached) factory wrapper that puts the choice in
-    # its cache key — never here at trace time (slulint SLU105)
+    # `pivot`/`gemm_prec` are the caller-resolved SLU_TPU_PIVOT_KERNEL /
+    # SLU_TPU_GEMM_PREC choices: this function runs inside cached jitted
+    # factories, so the env reads must happen in the (uncached) factory
+    # wrappers that put both in their cache keys — never here at trace
+    # time (slulint SLU105)
     if (front_sharding is None and pivot_sharding is None
             and pivot == "blocked"):
         # unsharded: the compile-bounded blocked kernel (see
@@ -303,13 +388,14 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
         # path — its scatter-free masked core is what the SPMD
         # partitioner handles.
         packed, tiny = jax.vmap(
-            lambda x: _blocked_partial_factor(x, thresh, w))(fronts)
+            lambda x: _blocked_partial_factor(x, thresh, w,
+                                              gemm_prec))(fronts)
         return (packed[:, :, :w], packed[:, :w, w:],
                 packed[:, w:, w:], tiny)
     f11_in = fronts[:, :w, :w]
     if pivot_sharding is not None:
         f11_in = wsc(f11_in, pivot_sharding)
-    f11, tiny = jax.vmap(lambda x: lu_nopivot(x, thresh))(f11_in)
+    f11, tiny = jax.vmap(lambda x: lu_nopivot(x, thresh, gemm_prec))(f11_in)
     if w == m:
         if pivot_sharding is not None:
             f11 = wsc(f11, pivot_sharding)
@@ -323,7 +409,7 @@ def group_partial_factor(fronts, thresh, w, front_sharding=None,
                                                   unit_diagonal=True))(f11, a12)
     l21 = jax.vmap(lambda u_, b_: solve_triangular(u_, b_.T, trans=1,
                                                    lower=False).T)(f11, a21)
-    s = a22 - jnp.matmul(l21, u12, precision=_precision())
+    s = a22 - gemm(l21, u12, gemm_prec)
     if front_sharding is not None:
         s = wsc(s, front_sharding)
     lpanel = jnp.concatenate([f11, l21], axis=1)
@@ -336,23 +422,27 @@ def make_front_kernel(m: int, w: int, dtype: str):
     """Jitted batched front factorization for bucket shape (M=m, W=w).
 
     Returns fn(F: (B, m, m), thresh) -> (F_packed: (B, m, m), tiny: int32).
-    Cached per (m, w, dtype, pivot kernel); batch size participates in
-    jit's own cache.  Honors SLU_TPU_PIVOT_KERNEL like the executors.
+    Cached per (m, w, dtype, pivot kernel, gemm tier); batch size
+    participates in jit's own cache.  Honors SLU_TPU_PIVOT_KERNEL and
+    SLU_TPU_GEMM_PREC like the executors.
     """
-    return _make_front_kernel(m, w, dtype, pivot_kernel())
+    return _make_front_kernel(m, w, dtype, pivot_kernel(), gemm_precision())
 
 
 @functools.lru_cache(maxsize=None)
-def _make_front_kernel(m: int, w: int, dtype: str, pivot: str):
+def _make_front_kernel(m: int, w: int, dtype: str, pivot: str,
+                       gemm_prec: str = "highest"):
     if pivot == "blocked":
         def kernel(fronts, thresh):
             outs, flags = jax.vmap(
-                lambda f: _blocked_partial_factor(f, thresh, w))(fronts)
+                lambda f: _blocked_partial_factor(f, thresh, w,
+                                                  gemm_prec))(fronts)
             return outs, jnp.sum(flags)
     else:
         def kernel(fronts, thresh):
             outs, counts = jax.vmap(
-                lambda f: partial_front_factor(f, thresh, w))(fronts)
+                lambda f: partial_front_factor(f, thresh, w,
+                                               gemm_prec))(fronts)
             return outs, jnp.sum(counts)
 
     return jax.jit(kernel)

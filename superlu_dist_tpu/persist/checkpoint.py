@@ -75,14 +75,19 @@ class FactorCheckpointer:
     """
 
     def __init__(self, dirpath: str, plan, pattern_values, thresh, dtype,
-                 every: int = 0):
+                 every: int = 0, gemm_prec: str = ""):
         self.dirpath = os.path.abspath(dirpath)
         os.makedirs(self.dirpath, exist_ok=True)
         self.every = int(every)
         self.plan = plan
         self.n_groups = len(plan.groups)
         self.plan_fp = serial.plan_fingerprint(plan)
-        self.values_fp = serial.values_digest(pattern_values, dtype, thresh)
+        # gemm_prec joins the numeric identity: a frontier computed at
+        # one GEMM tier must not be spliced under another tier's
+        # arithmetic (numeric_factorize passes the resolved tier on
+        # both the save and the resume side)
+        self.values_fp = serial.values_digest(pattern_values, dtype, thresh,
+                                              gemm_prec=gemm_prec)
         self.dtype = serial.dtype_str(dtype)
         self._entries: dict = {}      # manifest entries carried across
                                       # flushes (front files are immutable)
@@ -248,10 +253,12 @@ def peek(dirpath: str) -> dict:
 
 
 def load_checkpoint(dirpath: str, plan=None, pattern_values=None,
-                    thresh=None, dtype=None) -> ResumeState:
+                    thresh=None, dtype=None,
+                    gemm_prec: str = "") -> ResumeState:
     """Load and verify a factor checkpoint.
 
-    With ``plan``/``pattern_values``/``thresh``/``dtype`` given, the
+    With ``plan``/``pattern_values``/``thresh``/``dtype`` (and, on the
+    driver path, the resolved ``gemm_prec`` tier) given, the
     checkpoint's identity fingerprints must match — a frontier computed
     from a different schedule or different values must never be spliced
     into this run (:class:`CheckpointMismatchError`).  Every artifact is
@@ -277,12 +284,14 @@ def load_checkpoint(dirpath: str, plan=None, pattern_values=None,
             raise CheckpointError(
                 "value verification needs dtype and thresh alongside "
                 "pattern_values")
-        vd = serial.values_digest(pattern_values, dtype, thresh)
+        vd = serial.values_digest(pattern_values, dtype, thresh,
+                                  gemm_prec=gemm_prec)
         if vd != meta["values_digest"]:
             raise CheckpointMismatchError(
                 f"checkpoint at {dirpath!r} was computed from different "
-                "numeric values (or dtype/threshold) — resuming would "
-                "splice stale panels; refactor instead")
+                "numeric values (or dtype/threshold/GEMM-precision "
+                "tier) — resuming would splice stale panels; refactor "
+                "instead")
     fronts = [(serial.read_array(dirpath, f"front_{g:05d}_l", doc),
                serial.read_array(dirpath, f"front_{g:05d}_u", doc))
               for g in range(k)]

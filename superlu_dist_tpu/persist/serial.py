@@ -236,17 +236,24 @@ def dtype_str(dtype) -> str:
         return str(dtype)
 
 
-def values_digest(pattern_values, dtype, thresh) -> str:
+def values_digest(pattern_values, dtype, thresh, gemm_prec: str = "") -> str:
     """Identity of the NUMERIC inputs a frontier was computed from: the
-    structurally-permuted value array, factor dtype, and GESP threshold.
-    A resume against different values would splice stale panels under
-    fresh arithmetic — refused via CheckpointMismatchError."""
+    structurally-permuted value array, factor dtype, GESP threshold and
+    the GEMM-precision ladder tier (``gemm_prec``; "" = unspecified —
+    callers on the driver path pass the resolved tier, since a bf16
+    frontier spliced under highest arithmetic is exactly the stale-
+    arithmetic splice this digest exists to refuse).  A resume against
+    different values is refused via CheckpointMismatchError."""
     h = hashlib.sha256()
     v = np.ascontiguousarray(np.asarray(pattern_values))
     h.update(str(v.dtype).encode())
     h.update(v.tobytes())
     h.update(dtype_str(dtype).encode())
     h.update(np.float64(float(np.real(thresh))).tobytes())
+    if gemm_prec:
+        # appended only when specified, so tier-less callers (tests,
+        # tooling) keep their historical digests
+        h.update(f";gemm={gemm_prec}".encode())
     return h.hexdigest()
 
 
@@ -341,6 +348,10 @@ def save_lu(lu, dirpath: str) -> str:
         "plan_fingerprint": plan_fingerprint(lu.plan),
         "has_col_order": lu.col_order is not None,
         "has_sym_pattern": lu.a_sym_indptr is not None,
+        # which GEMM-precision ladder tier the persisted factors were
+        # computed at — a reloaded handle must not claim a higher tier
+        # than it ran (the escalation rung and SolveReport read this)
+        "gemm_precision": getattr(numeric, "gemm_prec", "highest"),
     }
     return write_manifest(dirpath, "lu_handle", meta, entries)
 
@@ -395,7 +406,8 @@ def load_lu(dirpath: str):
     numeric = NumericFactorization(
         plan=plan, fronts=fronts, tiny_pivots=int(meta["tiny_pivots"]),
         dtype=np.dtype(dtype), finite=bool(meta["finite"]),
-        info_col=int(meta["info_col"]))
+        info_col=int(meta["info_col"]),
+        gemm_prec=str(meta.get("gemm_precision", "highest")))
     arr = lambda name: read_array(dirpath, name, doc)   # noqa: E731
     return LUFactorization(
         n=int(meta["n"]), options=Options(), equed=meta["equed"],

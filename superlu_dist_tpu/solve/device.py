@@ -69,7 +69,7 @@ def _sweep_kernel_builds() -> int:
             + _diag_inv_kernel.cache_info().misses)
 
 
-def _trsm(a, b, lower, unit, trans, leaf):
+def _trsm(a, b, lower, unit, trans, leaf, prec="highest"):
     """Batched triangular solve op(a)·x = b with recursive blocking.
 
     a is (B, w, w), b is (B, w, k).  At or below ``leaf`` the vmapped
@@ -77,8 +77,13 @@ def _trsm(a, b, lower, unit, trans, leaf):
     half and the off-diagonal block becomes one batched GEMM — the
     recursive blocked TRSM that keeps large diagonal blocks on the MXU
     instead of in a length-w dependent chain (leaf <= 0 disables
-    blocking entirely).  Conjugation is the caller's job (conj the
-    triangle before calling, as the trans sweeps already do)."""
+    blocking entirely).  ``prec`` is the caller-resolved GEMM-precision
+    ladder tier (ops/dense.gemm_precision) the off-diagonal GEMMs run at
+    — the solve-side half of the throughput ladder; the leaf triangles
+    themselves always solve at full precision.  Conjugation is the
+    caller's job (conj the triangle before calling, as the trans sweeps
+    already do)."""
+    from superlu_dist_tpu.ops.dense import gemm
     w = a.shape[-1]
     if leaf <= 0 or w <= leaf:
         return jax.vmap(lambda m, r: jax.scipy.linalg.solve_triangular(
@@ -86,25 +91,24 @@ def _trsm(a, b, lower, unit, trans, leaf):
     h = w // 2
     a11, a22 = a[:, :h, :h], a[:, h:, h:]
     b1, b2 = b[:, :h], b[:, h:]
-    hi = jax.lax.Precision.HIGHEST
     if lower != bool(trans):
         # dependency runs top-down: x1 first, then fold A21·x1 (notrans
         # lower) / A12ᵀ·x1 (trans upper) out of b2
         off = a[:, h:, :h] if lower else jnp.swapaxes(a[:, :h, h:], 1, 2)
-        x1 = _trsm(a11, b1, lower, unit, trans, leaf)
-        x2 = _trsm(a22, b2 - jnp.matmul(off, x1, precision=hi),
-                   lower, unit, trans, leaf)
+        x1 = _trsm(a11, b1, lower, unit, trans, leaf, prec)
+        x2 = _trsm(a22, b2 - gemm(off, x1, prec),
+                   lower, unit, trans, leaf, prec)
     else:
         # bottom-up: x2 first (notrans upper / trans lower)
         off = a[:, :h, h:] if not lower else jnp.swapaxes(a[:, h:, :h], 1, 2)
-        x2 = _trsm(a22, b2, lower, unit, trans, leaf)
-        x1 = _trsm(a11, b1 - jnp.matmul(off, x2, precision=hi),
-                   lower, unit, trans, leaf)
+        x2 = _trsm(a22, b2, lower, unit, trans, leaf, prec)
+        x1 = _trsm(a11, b1 - gemm(off, x2, prec),
+                   lower, unit, trans, leaf, prec)
     return jnp.concatenate([x1, x2], axis=1)
 
 
 def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv,
-              leaf):
+              leaf, prec="highest"):
     """x[cols] <- L11⁻¹(x[cols] − lsum[cols]); lsum[rows] += L21·x[cols].
 
     With use_inv, L11⁻¹ arrives precomputed and the triangular solve
@@ -122,7 +126,7 @@ def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv,
         y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
     else:
         y = _trsm(lpanel[:, :w, :w], rhs, lower=True, unit=True,
-                  trans=0, leaf=leaf)
+                  trans=0, leaf=leaf, prec=prec)
     x = x.at[cols].set(y, mode="drop")
     if u:
         contrib = jnp.matmul(lpanel[:, w:, :], y,
@@ -132,7 +136,7 @@ def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv,
 
 
 def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv,
-              leaf):
+              leaf, prec="highest"):
     """x[cols] <- U11⁻¹(x[cols] − U12·x[rows])."""
     k = jnp.arange(w)
     cols = jnp.where(k[None, :] < ws[:, None],
@@ -146,12 +150,12 @@ def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv,
         y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
     else:
         y = _trsm(lpanel[:, :w, :w], rhs, lower=False, unit=False,
-                  trans=0, leaf=leaf)
+                  trans=0, leaf=leaf, prec=prec)
     return x.at[cols].set(y, mode="drop")
 
 
 def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
-                    conj, leaf):
+                    conj, leaf, prec="highest"):
     """Transpose forward sweep: x[cols] <- U11⁻ᵀ(x[cols] − lsum[cols]);
     lsum[rows] += U12ᵀ·x[cols].  Mᵀ = UᵀLᵀ, so Uᵀ (lower) leads — the
     trans_t path through the same factors (superlu_defs.h:628-657)."""
@@ -163,7 +167,8 @@ def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
     u11 = lpanel[:, :w, :w]
     if conj:
         u11 = u11.conj()
-    y = _trsm(u11, rhs, lower=False, unit=False, trans=1, leaf=leaf)
+    y = _trsm(u11, rhs, lower=False, unit=False, trans=1, leaf=leaf,
+              prec=prec)
     x = x.at[cols].set(y, mode="drop")
     if u:
         u12 = upanel.conj() if conj else upanel       # (B, w, u)
@@ -173,7 +178,8 @@ def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
     return x, lsum
 
 
-def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj, leaf):
+def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj, leaf,
+                    prec="highest"):
     """Transpose backward sweep: x[cols] <- L11⁻ᵀ(x[cols] − L21ᵀ·x[rows])."""
     k = jnp.arange(w)
     cols = jnp.where(k[None, :] < ws[:, None],
@@ -189,48 +195,53 @@ def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj, leaf):
     l11 = lpanel[:, :w, :w]
     if conj:
         l11 = l11.conj()
-    y = _trsm(l11, rhs, lower=True, unit=True, trans=1, leaf=leaf)
+    y = _trsm(l11, rhs, lower=True, unit=True, trans=1, leaf=leaf,
+              prec=prec)
     return x.at[cols].set(y, mode="drop")
 
 
 @functools.lru_cache(maxsize=None)
-def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False, leaf=0):
+def _fwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False, leaf=0,
+                prec="highest"):
     def step(lpanel, x, lsum, first, rows, ws, linv=None):
         return _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n,
-                         use_inv, linv, leaf)
+                         use_inv, linv, leaf, prec)
 
     return jax.jit(step, donate_argnums=(1, 2))
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False, leaf=0):
+def _bwd_kernel(batch, m, w, u, nrhs, n, dtype, use_inv=False, leaf=0,
+                prec="highest"):
     def step(lpanel, upanel, x, first, rows, ws, uinv=None):
         return _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n,
-                         use_inv, uinv, leaf)
+                         use_inv, uinv, leaf, prec)
 
     return jax.jit(step, donate_argnums=(2,))
 
 
 @functools.lru_cache(maxsize=None)
-def _fwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False, leaf=0):
+def _fwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False, leaf=0,
+                      prec="highest"):
     def step(lpanel, upanel, x, lsum, first, rows, ws):
         return _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws,
-                               w, u, n, conj, leaf)
+                               w, u, n, conj, leaf, prec)
 
     return jax.jit(step, donate_argnums=(2, 3))
 
 
 @functools.lru_cache(maxsize=None)
-def _bwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False, leaf=0):
+def _bwd_trans_kernel(batch, m, w, u, nrhs, n, dtype, conj=False, leaf=0,
+                      prec="highest"):
     def step(lpanel, x, first, rows, ws):
         return _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj,
-                               leaf)
+                               leaf, prec)
 
     return jax.jit(step, donate_argnums=(1,))
 
 
 @functools.lru_cache(maxsize=None)
-def _diag_inv_kernel(w, dtype, leaf=0):
+def _diag_inv_kernel(w, dtype, leaf=0, prec="highest"):
     """Batched inverses of the packed diagonal blocks — the
     pdCompute_Diag_Inv analog (SRC/pdgstrs.c:647, dtrtri per block)."""
 
@@ -238,9 +249,10 @@ def _diag_inv_kernel(w, dtype, leaf=0):
         f11 = lpanel[:, :w, :w]
         eye = jnp.broadcast_to(jnp.eye(w, dtype=lpanel.dtype),
                                f11.shape)
-        linv = _trsm(f11, eye, lower=True, unit=True, trans=0, leaf=leaf)
+        linv = _trsm(f11, eye, lower=True, unit=True, trans=0, leaf=leaf,
+                     prec=prec)
         uinv = _trsm(f11, eye, lower=False, unit=False, trans=0,
-                     leaf=leaf)
+                     leaf=leaf, prec=prec)
         return linv, uinv
 
     return jax.jit(inv)
@@ -287,7 +299,8 @@ class DeviceSolver:
                  schedule: str | None = None, window: int | None = None,
                  align: float | None = None, trsm_leaf: int | None = None,
                  nrhs_max: int | None = None,
-                 nrhs_growth: float | None = None):
+                 nrhs_growth: float | None = None,
+                 gemm_prec: str | None = None):
         """mesh: a jax.sharding.Mesh the factors are sharded over.  Needed
         when the mesh spans MULTIPLE PROCESSES (the pdgstrs-over-the-grid
         case): the RHS then uploads replicated over the global mesh and
@@ -306,6 +319,12 @@ class DeviceSolver:
             from superlu_dist_tpu.utils.options import env_int
             trsm_leaf = env_int("SLU_TPU_SOLVE_TRSM_LEAF")
         self.trsm_leaf = int(trsm_leaf)
+        # GEMM-precision ladder tier for the blocked-TRSM off-diagonal
+        # GEMMs (ops/dense.gemm_precision — the solve-side half of the
+        # throughput ladder), resolved in this uncached constructor and
+        # part of every sweep-kernel cache key below
+        from superlu_dist_tpu.ops.dense import gemm_precision
+        self.gemm_prec = gemm_precision(gemm_prec)
         if mesh is not None:
             solve_plan = build_solve_plan(plan, schedule="factor",
                                           nrhs_max=nrhs_max,
@@ -403,7 +422,8 @@ class DeviceSolver:
             if self.diag_inv:
                 self._invs_cached = [
                     _diag_inv_kernel(grp.w, str(jnp.dtype(self.fact.dtype)),
-                                     self.trsm_leaf)(jnp.asarray(lp))
+                                     self.trsm_leaf,
+                                     self.gemm_prec)(jnp.asarray(lp))
                     for (grp, _, _, _), (lp, _) in zip(self._groups,
                                                        self.fronts)]
             else:
@@ -420,13 +440,14 @@ class DeviceSolver:
         n1 = self.n + 1
         use_inv = self.diag_inv
         leaf = self.trsm_leaf
+        prec = self.gemm_prec
         meta = [(grp.w, grp.u) for grp, _, _, _ in self._groups]
 
         def fwd(x, lsum, fronts, idx, invs):
             for (w, u), (lp, _), (firsts, rows, ws), (linv, _) in zip(
                     meta, fronts, idx, invs):
                 x, lsum = _fwd_body(lp, x, lsum, firsts, rows, ws, w, u,
-                                    n1, use_inv, linv, leaf)
+                                    n1, use_inv, linv, leaf, prec)
             return x, lsum
 
         def bwd(x, fronts, idx, invs):
@@ -434,7 +455,7 @@ class DeviceSolver:
                     reversed(meta), reversed(fronts), reversed(idx),
                     reversed(invs)):
                 x = _bwd_body(lp, up, x, firsts, rows, ws, w, u, n1,
-                              use_inv, uinv, leaf)
+                              use_inv, uinv, leaf, prec)
             return x
 
         fns = (jax.jit(fwd, donate_argnums=(0, 1)),
@@ -448,20 +469,21 @@ class DeviceSolver:
             return fns
         n1 = self.n + 1
         leaf = self.trsm_leaf
+        prec = self.gemm_prec
         meta = [(grp.w, grp.u) for grp, _, _, _ in self._groups]
 
         def fwd(x, lsum, fronts, idx):
             for (w, u), (lp, up), (firsts, rows, ws) in zip(
                     meta, fronts, idx):
                 x, lsum = _fwd_body_trans(lp, up, x, lsum, firsts, rows,
-                                          ws, w, u, n1, conj, leaf)
+                                          ws, w, u, n1, conj, leaf, prec)
             return x, lsum
 
         def bwd(x, fronts, idx):
             for (w, u), (lp, _), (firsts, rows, ws) in zip(
                     reversed(meta), reversed(fronts), reversed(idx)):
                 x = _bwd_body_trans(lp, x, firsts, rows, ws, w, u, n1,
-                                    conj, leaf)
+                                    conj, leaf, prec)
             return x
 
         fns = (jax.jit(fwd, donate_argnums=(0, 1)),
@@ -602,7 +624,8 @@ class DeviceSolver:
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     self._groups, self.fronts):
                 kern = _fwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
-                                         kb, n1, str(dt), conj, leaf)
+                                         kb, n1, str(dt), conj, leaf,
+                                         self.gemm_prec)
                 _audit_sweep(
                     f"fwdT b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
                     f"k{kb} n{self.n}", kern,
@@ -612,7 +635,8 @@ class DeviceSolver:
             for (grp, firsts, rows, ws), (lp, up) in zip(
                     reversed(self._groups), reversed(self.fronts)):
                 kern = _bwd_trans_kernel(grp.batch, grp.m, grp.w, grp.u,
-                                         kb, n1, str(dt), conj, leaf)
+                                         kb, n1, str(dt), conj, leaf,
+                                         self.gemm_prec)
                 _audit_sweep(
                     f"bwdT b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
                     f"k{kb} n{self.n}", kern,
@@ -647,7 +671,7 @@ class DeviceSolver:
             for (grp, firsts, rows, ws), (lp, up), (linv, _) in zip(
                     self._groups, self.fronts, self._invs):
                 kern = _fwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                                   str(dt), use_inv, leaf)
+                                   str(dt), use_inv, leaf, self.gemm_prec)
                 args = ((lp, x, lsum, firsts, rows, ws, linv) if use_inv
                         else (lp, x, lsum, firsts, rows, ws))
                 _audit_sweep(
@@ -659,7 +683,7 @@ class DeviceSolver:
                     reversed(self._groups), reversed(self.fronts),
                     reversed(self._invs)):
                 kern = _bwd_kernel(grp.batch, grp.m, grp.w, grp.u, kb, n1,
-                                   str(dt), use_inv, leaf)
+                                   str(dt), use_inv, leaf, self.gemm_prec)
                 _audit_sweep(
                     f"bwd b{grp.batch} m{grp.m} w{grp.w} u{grp.u} "
                     f"k{kb} n{self.n}", kern,

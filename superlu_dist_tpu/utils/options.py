@@ -67,8 +67,29 @@ def _register_all() -> None:
       "threads for the native symbolic factorization (psymbfact analog)")
     # --- numeric executors -------------------------------------------------
     r("SLU_TPU_PRECISION", "str", "highest",
-      "MXU pass count for f32 Schur GEMMs", group="numeric",
-      choices=("default", "high", "highest"))
+      "MXU pass count for f32 Schur GEMMs (legacy; superseded by "
+      "SLU_TPU_GEMM_PREC — an explicitly-set value still maps onto the "
+      "tier ladder: default->default, high->f32, highest->highest)",
+      group="numeric", choices=("default", "high", "highest"))
+    r("SLU_TPU_GEMM_PREC", "str", "",
+      "Schur-update GEMM precision tier for the factor hot path "
+      "(ops/dense.gemm_precision): bf16 = bf16 inputs with f32 "
+      "accumulation (native MXU rate), default = single-pass bf16 on "
+      "native inputs (the tensorfloat analog), f32 = 3-pass "
+      "(~f32-mantissa), highest = 6-pass full f32.  Empty = 'default' "
+      "unless a legacy SLU_TPU_PRECISION is explicitly set.  Reduced "
+      "tiers are BERR-gated: the escalation ladder refactors the same "
+      "skeleton at the next tier when delivered accuracy misses the "
+      "gate (docs/PERFORMANCE.md throughput ladder)", group="numeric",
+      choices=("", "bf16", "default", "f32", "highest"))
+    r("SLU_TPU_PALLAS", "str", "auto",
+      "Pallas fused gather/scatter kernels for the extend-add and "
+      "A-assembly hot spots (numeric/pallas_kernels.py): auto = on "
+      "when a TPU backend is present, 1/on = force (interprets on "
+      "CPU), interpret = force interpreter mode, 0/off = the .at[] "
+      "lowering.  Both paths are bitwise-identical "
+      "(tests/test_precision_ladder.py pins it)", group="numeric",
+      choices=("auto", "0", "1", "on", "off", "interpret"))
     r("SLU_TPU_PIVOT_KERNEL", "str", "blocked",
       "panel factorization kernel", group="numeric",
       choices=("blocked", "recursive"))
@@ -284,6 +305,11 @@ def _register_all() -> None:
       "deprecated legacy '# lvl=' stderr kernel trace", group="obs")
     r("SLU_TPU_PROGRESS", "int", 0,
       "log every K groups/levels issued (0=silent)", group="obs")
+    r("SLU_TPU_PEAK_GFLOPS", "float", 0.0,
+      "peak GFLOP/s override for the MFU denominator (bench.py, "
+      "scripts/mfu_report.py); 0 = auto-detect from the per-backend/"
+      "per-precision peak table (utils/peaks.py — TPU kinds tabulated, "
+      "CPU calibrated with a one-shot micro-GEMM)", group="obs")
     r("SLU_TPU_METRICS", "str", "",
       "metrics registry: '1' enables; a path additionally dumps the "
       "JSON/Prometheus export there at exit ('%p' expands to the pid)",
@@ -643,6 +669,15 @@ class Options:
     # "dataflow" pad identically and stay bitwise-comparable.
     sched_align: float = dataclasses.field(
         default_factory=lambda: env_float("SLU_TPU_SCHED_ALIGN"))
+    # Schur-update GEMM precision tier (ops/dense.gemm_precision):
+    # None resolves the SLU_TPU_GEMM_PREC knob (empty knob = "default",
+    # the single-pass tensorfloat-analog fast path, with legacy
+    # SLU_TPU_PRECISION interop).  Reduced tiers are made safe by the
+    # gemm-precision escalation rung: delivered BERR above the gate
+    # refactors the same skeleton at the next-higher tier
+    # (drivers/gssvx._escalate, docs/PERFORMANCE.md)
+    gemm_prec: str | None = dataclasses.field(
+        default_factory=lambda: env_str("SLU_TPU_GEMM_PREC") or None)
     # numeric executor selection (numeric/factor.get_executor): "mega"
     # is the bucketed data-driven executor whose compiled-program count
     # is bounded by the closed shape-key set (numeric/mega.py) — pair it

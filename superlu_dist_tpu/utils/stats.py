@@ -105,10 +105,16 @@ class SolveReport:
     converged: bool = True        # final berr <= target (True w/o refine)
     finite: bool = True           # solution passed the isfinite sentinel
     factor_dtype: str = ""        # dtype of the factors the answer rests on
+    gemm_precision: str = ""      # GEMM-precision ladder tier the factors
+                                  # the answer rests on ran at (updated by
+                                  # the gemm-precision escalation rung —
+                                  # ops/dense.GEMM_PREC_LADDER)
 
     def summary(self) -> str:
         parts = [f"factor dtype {self.factor_dtype}" if self.factor_dtype
                  else ""]
+        if self.gemm_precision:
+            parts.append(f"gemm {self.gemm_precision}")
         if self.rcond is not None:
             parts.append(f"rcond {self.rcond:.3e}")
         if self.berr is not None:
@@ -269,7 +275,9 @@ class Stats:
                 f"(level {s.get('n_level_groups', 0)})  "
                 f"occupancy {s.get('occupancy', 0.0):6.2f}  "
                 f"padding {s.get('padding_factor', 0.0):5.2f}x  "
-                f"critical path {s.get('critical_path', 0)}")
+                f"critical path {s.get('critical_path', 0)}"
+                + (f"  moved {s['bytes_moved'] / 1e6:8.1f} MB"
+                   if s.get("bytes_moved") else ""))
         if self.compile and self.compile.get("builds"):
             # compile census (obs/compilestats.py): what the jit builds
             # of the last factorization cost, and which shape-key

@@ -211,8 +211,9 @@ def test_schedule_topological_and_telemetry():
         assert stats["schedule"] == schedule
         assert set(stats) == {"schedule", "n_groups", "n_level_groups",
                               "occupancy", "padding_factor",
-                              "critical_path"}
+                              "critical_path", "bytes_moved"}
         assert stats["critical_path"] >= 1
+        assert stats["bytes_moved"] > 0
         assert stats["n_groups"] == len(plan.groups)
 
 
