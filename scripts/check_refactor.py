@@ -1,0 +1,225 @@
+#!/usr/bin/env python
+"""Refactor-consistency gate: the crash-consistent same-pattern
+refactorization contract, proven end to end (CPU, tens of seconds).
+
+Four phases:
+
+1. **Refactor ≡ fresh factor, bitwise** — ``refactor(handle,
+   new_values)`` over a drifted-values gallery matrix must produce
+   factors whose solves are bitwise identical to an independent handle
+   refreshed through the driver's ``Fact=SamePattern_SameRowPerm``
+   tier, with ZERO symbolic seconds and ZERO fresh-compile seconds
+   (symbolic fact, FactorPlan, and compiled programs reused by
+   construction).
+
+2. **kill -9 mid-refactor, old state serves** — a child process
+   refactors a persisted bundle's handle under
+   ``SLU_TPU_CHAOS=kill_refactor@step=0`` (a REAL SIGKILL after the
+   new values are staged, before anything is adopted): the parent must
+   see rc=-9, and the bundle must still load and solve **bitwise
+   identical** to before — an interrupted refactor leaves the previous
+   consistent state.
+
+3. **Rolling fleet refactor under chaos, zero dropped** — a live
+   3-replica fleet takes ``fleet.refactor(key, values)`` under
+   concurrent traffic: every ticket delivered (zero dropped/errored),
+   post-roll answers bitwise vs the SamePattern baseline.
+
+4. **Failed canary rolls back every swapped replica** — a
+   ``poison_values`` chaos refactor must raise
+   ``RefactorRollbackError`` with the fleet still serving the previous
+   factors bitwise (no replica left on a poisoned bundle).
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (the consolidated CI
+entry point, shared timeout/exit contract): any regression — a recompile,
+a drifted X, a lost ticket, a poisoned refactor surviving its gate —
+raises/asserts, which exits non-zero with the diagnostic on stderr.
+"""
+
+import os
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np  # noqa: E402
+
+
+def _drift(a, scale=2.0, shift=0.01):
+    return type(a)(a.n_rows, a.n_cols, a.indptr, a.indices,
+                   a.data * scale + shift)
+
+
+def _check_bitwise_and_zero_recompile():
+    import dataclasses
+
+    from superlu_dist_tpu.drivers.gssvx import gssvx, refactor
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+    from superlu_dist_tpu.utils.options import Fact, Options
+    from superlu_dist_tpu.utils.stats import Stats
+
+    for executor in ("fused", "stream", "mega"):
+        a = poisson2d(8)
+        b = np.arange(1, a.n_rows + 1, dtype=np.float64)
+        opts = Options(executor=executor)
+        a2 = _drift(a)
+        _, lu_base, _, info = gssvx(opts, a, b, stats=Stats())
+        assert info == 0
+        _, lu_base2, _, info2 = gssvx(
+            dataclasses.replace(opts, fact=Fact.SamePattern_SameRowPerm),
+            a2, b, lu=lu_base, stats=Stats())
+        assert info2 == 0
+
+        _, lu, _, _ = gssvx(opts, a, b, stats=Stats())
+        marker = COMPILE_STATS.marker()
+        st = Stats()
+        refactor(lu, a2, stats=st)
+        assert np.array_equal(
+            np.asarray(lu.solve_factored(b)),
+            np.asarray(lu_base2.solve_factored(b))), \
+            f"{executor}: refactor drifted from the SamePattern baseline"
+        sym = float(st.utime.get("SYMBFACT", 0.0))
+        fresh = float(COMPILE_STATS.block(since=marker)["fresh_seconds"])
+        assert sym == 0.0, f"{executor}: refactor re-ran symbolic ({sym}s)"
+        assert fresh == 0.0, f"{executor}: refactor recompiled ({fresh}s)"
+        print(f"  [1] {executor}: bitwise OK, symbolic=0.0s, "
+              "fresh_compile=0.0s")
+
+
+def _check_kill9_mid_refactor(tmp):
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.persist.serial import load_lu, save_lu
+    from superlu_dist_tpu.utils.options import Options
+    from superlu_dist_tpu.utils.stats import Stats
+
+    d = os.path.join(tmp, "kill9")
+    a = poisson2d(7)
+    b = np.ones(a.n_rows)
+    _, lu, _, _ = gssvx(Options(), a, b, stats=Stats())
+    save_lu(lu, d)
+    x_before = np.asarray(load_lu(d).solve_factored(b))
+    child = (
+        "import numpy as np\n"
+        "from superlu_dist_tpu.drivers.gssvx import refactor\n"
+        "from superlu_dist_tpu.persist.serial import load_lu\n"
+        "from superlu_dist_tpu.models.gallery import poisson2d\n"
+        f"lu = load_lu({d!r})\n"
+        "a = poisson2d(7)\n"
+        "a2 = type(a)(a.n_rows, a.n_cols, a.indptr, a.indices,\n"
+        "             a.data * 2.0)\n"
+        "refactor(lu, a2)\n"
+        "print('UNREACHABLE')\n")
+    env = dict(os.environ, SLU_TPU_CHAOS="kill_refactor@step=0",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", child], env=env, cwd=REPO,
+                       capture_output=True, timeout=300)
+    assert r.returncode == -9, (
+        f"child should die by SIGKILL mid-refactor, got rc={r.returncode}:"
+        f"\n{r.stdout.decode()}\n{r.stderr.decode()}")
+    assert b"UNREACHABLE" not in r.stdout
+    x_after = np.asarray(load_lu(d).solve_factored(b))
+    assert np.array_equal(x_before, x_after), \
+        "interrupted refactor corrupted the persisted state"
+    print("  [2] kill -9 mid-refactor: rc=-9, bundle serves bitwise")
+
+
+def _check_fleet_rolling_refactor(tmp):
+    import dataclasses
+
+    from superlu_dist_tpu.drivers.gssvx import gssvx
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.persist.serial import save_lu
+    from superlu_dist_tpu.serve import FleetRouter, RefactorRollbackError
+    from superlu_dist_tpu.serve.fleet import FLEET_SERVER_KW
+    from superlu_dist_tpu.utils.options import Fact, IterRefine, Options
+    from superlu_dist_tpu.utils.stats import Stats
+
+    a = poisson2d(8)
+    b = a.matvec(np.ones(a.n_rows))
+    opts = Options(iter_refine=IterRefine.NOREFINE)
+    _, lu, _, _ = gssvx(opts, a, b, stats=Stats())
+    d = os.path.join(tmp, "fleet-k0")
+    save_lu(lu, d)
+    a2 = _drift(a)
+    _, lu_b, _, _ = gssvx(opts, a, b, stats=Stats())
+    _, lu_b2, _, _ = gssvx(
+        dataclasses.replace(opts, fact=Fact.SamePattern_SameRowPerm),
+        a2, b, lu=lu_b, stats=Stats())
+    x_expect = np.asarray(lu_b2.solve_factored(b))
+
+    fleet = FleetRouter({"k0": d}, n_replicas=3, kind="thread",
+                        server_kw=FLEET_SERVER_KW)
+    stop = threading.Event()
+    outcomes = []
+    lock = threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            try:
+                fleet.solve("k0", b, timeout=120)
+                tag = "ok"
+            except Exception as e:      # noqa: BLE001 — tallied
+                tag = type(e).__name__
+            with lock:
+                outcomes.append(tag)
+
+    th = threading.Thread(target=client)
+    th.start()
+    try:
+        time.sleep(0.05)
+        summary = fleet.refactor("k0", a2)
+        time.sleep(0.05)
+    finally:
+        stop.set()
+        th.join(30)
+    try:
+        assert outcomes and set(outcomes) == {"ok"}, (
+            f"rolling refactor dropped/errored tickets: {outcomes}")
+        assert summary["replicas_swapped"] == [0, 1, 2], summary
+        x_got = np.asarray(fleet.solve("k0", b))
+        assert np.array_equal(x_got, x_expect), \
+            "post-refactor fleet answer drifted from the baseline"
+        print(f"  [3] rolling refactor: {len(outcomes)} live tickets all "
+              "ok, 3 replicas swapped, bitwise OK")
+
+        # phase 4: poisoned refactor rolls back, old factors keep serving
+        os.environ["SLU_TPU_CHAOS"] = "poison_values=1"
+        try:
+            fleet.refactor("k0", _drift(a, scale=3.0))
+            raise AssertionError(
+                "poisoned refactor survived its canary gate")
+        except RefactorRollbackError as e:
+            assert e.stage in ("factor", "canary"), e.stage
+        finally:
+            os.environ.pop("SLU_TPU_CHAOS", None)
+        assert np.array_equal(np.asarray(fleet.solve("k0", b)), x_got), \
+            "a replica was left serving the rolled-back refactor"
+        st = fleet.stats()
+        assert st["errors"] == 0, st
+        assert st["refactors"] == 1 and st["rollbacks"] == 1, st
+        print("  [4] poisoned refactor: RefactorRollbackError, fleet "
+              "serves previous factors bitwise")
+    finally:
+        fleet.close()
+
+
+def main():
+    t0 = time.perf_counter()
+    with tempfile.TemporaryDirectory(prefix="slu-refactor-gate-") as tmp:
+        _check_bitwise_and_zero_recompile()
+        _check_kill9_mid_refactor(tmp)
+        _check_fleet_rolling_refactor(tmp)
+    print(f"check_refactor: ALL OK ({time.perf_counter() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -74,6 +74,15 @@
 #                   completes under traffic with zero dropped tickets
 #                   and a poisoned bundle rolls back (preflight +
 #                   per-replica canary)
+#   refactor-consistency scripts/check_refactor.py    crash-consistent
+#                   same-pattern refactorization: refactor(handle,
+#                   new_values) bitwise vs a SamePattern_SameRowPerm
+#                   refresh with zero symbolic/fresh-compile seconds
+#                   (fused/stream/mega); kill -9 MID-REFACTOR leaves
+#                   the persisted state serving bitwise; a rolling
+#                   fleet.refactor under live traffic drops zero
+#                   tickets and a poisoned refactor rolls back every
+#                   swapped replica
 #
 # Scan sharing: the slulint gate (and any other in-tree slulint
 # invocation) reads/writes the content-hash scan cache
@@ -110,11 +119,12 @@ declare -A GATES=(
   [program-audit]="python scripts/check_program_audit.py"
   [fleet-failover]="python scripts/check_fleet_failover.py"
   [precision-safety]="python scripts/check_precision_safety.py"
+  [refactor-consistency]="python scripts/check_refactor.py"
 )
 ORDER=(slulint program-audit verify-overhead schedule-equiv solve-equiv
-       precision-safety serve-robust fleet-failover crash-resume
-       rank-failure compile-budget tsan-native trace-overhead nan-guards
-       perf-regress)
+       precision-safety serve-robust fleet-failover refactor-consistency
+       crash-resume rank-failure compile-budget tsan-native
+       trace-overhead nan-guards perf-regress)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

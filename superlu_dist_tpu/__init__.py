@@ -37,13 +37,15 @@ from superlu_dist_tpu.utils.options import (
 )
 from superlu_dist_tpu.utils.stats import Stats, SolveReport
 from superlu_dist_tpu.utils.errors import (
-    SuperLUError, SingularMatrixError, NumericBreakdownError)
+    SuperLUError, SingularMatrixError, NumericBreakdownError,
+    PatternMismatchError, RefactorRollbackError)
 from superlu_dist_tpu.sparse.formats import SparseCSR, SparseCSC
 
 
 def __getattr__(name):
     # lazy: the driver pulls in jax; keep light imports (io, formats) fast
-    if name in ("gssvx", "gssvx_ABglobal", "gssvx_dist", "LUFactorization"):
+    if name in ("gssvx", "gssvx_ABglobal", "gssvx_dist", "LUFactorization",
+                "refactor"):
         import importlib
         mod = importlib.import_module("superlu_dist_tpu.drivers.gssvx")
         return getattr(mod, name)

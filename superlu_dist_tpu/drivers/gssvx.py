@@ -31,7 +31,8 @@ from superlu_dist_tpu.utils.options import (
     print_options)
 from superlu_dist_tpu.utils.stats import Stats, SolveReport, RungRecord
 from superlu_dist_tpu.utils.errors import (
-    SuperLUError, SingularMatrixError, NumericBreakdownError)
+    SuperLUError, SingularMatrixError, NumericBreakdownError,
+    PatternMismatchError, RefactorRollbackError)
 from superlu_dist_tpu.rowperm.equil import gsequ, laqgs
 from superlu_dist_tpu.rowperm.matching import (
     maximum_product_matching, approximate_weight_matching)
@@ -82,6 +83,29 @@ class LUFactorization:
                                        # no process can pull the whole
                                        # factor (pdgstrs over the process
                                        # grid, SRC/pdgstrs.c:838)
+    pattern_digest: str = None         # identity latch for the refactor
+    plan_fp: str = None                # pipeline: sha256 of the symmetrized
+                                       # permuted pattern + the plan
+                                       # fingerprint, latched lazily on
+                                       # first refactor (persist/serial.py
+                                       # computes both; bundles record the
+                                       # pattern digest in their meta)
+
+    def identity(self) -> tuple:
+        """Latch and return ``(pattern_digest, plan_fingerprint)`` — the
+        refactor pipeline's identity discipline: a values-only refactor
+        reuses symbolic + plan + compiled programs by OBJECT identity,
+        so the handle carries a durable fingerprint of both and drift
+        raises :class:`PatternMismatchError` instead of silently
+        re-running symbolic."""
+        from superlu_dist_tpu.persist.serial import (
+            pattern_digest, plan_fingerprint)
+        if self.pattern_digest is None and self.a_sym_indptr is not None:
+            self.pattern_digest = pattern_digest(self.a_sym_indptr,
+                                                 self.a_sym_indices)
+        if self.plan_fp is None and self.plan is not None:
+            self.plan_fp = plan_fingerprint(self.plan)
+        return self.pattern_digest, self.plan_fp
 
     # -- combined transforms --------------------------------------------------
     @property
@@ -468,6 +492,209 @@ def factorize_numeric(lu: LUFactorization, bvals: np.ndarray,
         # of the first i with U(i,i)==0 (pdgstrf.c:1920-1924)
         return numeric.info_col + 1
     return 0
+
+
+# per-process refactor counter: the chaos harness's `kill_refactor@step=K`
+# spec is scoped to the Kth refactor of the victim process (0-based)
+_REFACTOR_SEQ = [0]
+
+
+def refactor(lu: LUFactorization, new_values,
+             stats: Stats | None = None, canary_b: np.ndarray = None,
+             berr_max: float | None = None):
+    """Values-only refactorization — the middle rung of the Fact ladder
+    (SamePattern_SameRowPerm economics as a first-class crash-consistent
+    verb, ROADMAP item 2).
+
+    ``new_values`` is either a :class:`SparseCSR` with the SAME sparsity
+    pattern the handle was analyzed on, or a raw data array replacing
+    ``lu.a.data`` entry-for-entry.  The symbolic structure, FactorPlan,
+    bucket set AND compiled programs are reused by object identity —
+    zero symbolic seconds and zero fresh-compile seconds by construction
+    (the executor cache on ``plan._factor_fns`` is keyed by the plan
+    object; ``stats.compile['fresh_seconds']`` proves it per call).
+
+    Identity discipline: the pattern digest + plan fingerprint are
+    latched on the handle (:meth:`LUFactorization.identity`); a matrix
+    whose symmetrized permuted pattern drifts from the latch raises a
+    structured :class:`PatternMismatchError` instead of silently
+    re-running symbolic.
+
+    Commit protocol (adopt-only-on-improvement): the numeric
+    factorization runs against a SHADOW copy of the handle — in-flight
+    solves keep the previous panels — and is adopted onto ``lu`` only
+    after (a) the factorization finished finite (breakdown sentinels /
+    singularity reject at ``stage='factor'``), and (b) the BERR canary
+    passed: one un-refined solve of ``canary_b`` (default: ones) must
+    come back finite, and — when a gate is armed via ``berr_max`` /
+    ``SLU_TPU_REFACTOR_BERR_MAX`` — with componentwise backward error
+    at or below it.  A canary miss at a reduced GEMM tier first climbs
+    the PR 15 escalation ladder (``SLU_TPU_REFACTOR_ESCALATE``) one
+    tier per rung; if the ladder tops out the refactor raises
+    :class:`RefactorRollbackError` and ``lu`` is untouched.  An
+    interrupted refactor (kill -9, deadline, poisoned values — the
+    ``kill_refactor``/``poison_values`` chaos specs) always leaves the
+    previous consistent handle serving.
+
+    Returns ``stats``; on success ``lu`` serves the new factors (its
+    ``numeric``/``a``/``anorm`` swapped, device caches invalidated)."""
+    if stats is None:
+        stats = Stats()
+    step = _REFACTOR_SEQ[0]
+    _REFACTOR_SEQ[0] += 1
+    from superlu_dist_tpu.obs.metrics import get_metrics
+    m = get_metrics()
+    if m.enabled:
+        m.inc("slu_refactor_total", 1.0)
+
+    if lu.sf is None or lu.plan is None:
+        raise SuperLUError(
+            "refactor requires an analyzed handle (lu.sf/lu.plan is "
+            "None — run analyze/gssvx first)")
+    if lu.sf.value_perm is None:
+        raise SuperLUError(
+            "refactor requires a serial-analysis skeleton; this one came "
+            "from the distributed analysis (parallel/panalysis.py) — "
+            "re-analyze with Fact=DOFACT")
+    if lu.a_sym_indptr is None:
+        raise SuperLUError(
+            "refactor requires the handle's analyzed pattern "
+            "(a_sym_indptr is None — e.g. a hand-built skeleton); "
+            "re-analyze with Fact=DOFACT")
+    expected_digest, _ = lu.identity()
+
+    # ---- new-values intake + pattern identity check ------------------------
+    a_new = new_values
+    if not hasattr(a_new, "indptr"):
+        vals = np.asarray(new_values)
+        if lu.a is None:
+            raise SuperLUError(
+                "refactor from a raw value array needs the handle's "
+                "matrix for its pattern (lu.a is None — pass a SparseCSR "
+                "instead)")
+        if vals.ndim != 1 or vals.shape[0] != lu.a.nnz:
+            raise PatternMismatchError(
+                f"value array has {vals.shape} entries, the handle's "
+                f"pattern has {lu.a.nnz} nonzeros",
+                expected_digest=expected_digest, n=lu.n, nnz=lu.a.nnz)
+        a_new = SparseCSR(lu.a.n_rows, lu.a.n_cols, lu.a.indptr,
+                          lu.a.indices, vals)
+    if a_new.n_rows != lu.n or a_new.n_cols != lu.n:
+        raise PatternMismatchError(
+            f"matrix is {a_new.n_rows}x{a_new.n_cols}, the handle was "
+            f"analyzed at n={lu.n}", expected_digest=expected_digest,
+            n=lu.n)
+    # apply the handle's stored transforms to the new matrix (the
+    # SamePattern_SameRowPerm recipe: reuse scalings + row order), then
+    # verify the symmetrized permuted pattern is EXACTLY the analyzed one
+    # — nnz equality is not enough, a moved entry with equal count would
+    # gather values into wrong structural slots silently
+    a1 = (a_new.row_scale(lu.dr).col_scale(lu.dc)
+          if lu.equed != "N" else a_new)
+    a2 = a1.row_scale(lu.r1).col_scale(lu.c1).permute(perm_r=lu.row_order)
+    sym = symmetrize_pattern(a2)
+    if sym.nnz != len(lu.sf.value_perm) or not (
+            np.array_equal(sym.indptr, lu.a_sym_indptr)
+            and np.array_equal(sym.indices, lu.a_sym_indices)):
+        from superlu_dist_tpu.persist.serial import pattern_digest
+        raise PatternMismatchError(
+            "the matrix's symmetrized permuted pattern differs from the "
+            "one the handle's symbolic structure was built on",
+            expected_digest=expected_digest,
+            got_digest=pattern_digest(sym.indptr, sym.indices),
+            n=lu.n, nnz=sym.nnz)
+    bvals = sym.data[lu.sf.value_perm]
+    anorm = a2.norm_max()
+
+    # ---- chaos hooks (testing/chaos.py, consulted once per refactor) -------
+    from superlu_dist_tpu.testing.chaos import get_refactor_chaos
+    monkey = get_refactor_chaos()
+    if monkey is not None:
+        bvals = monkey.poison_refactor_values(lu.plan, bvals)
+        if monkey.refactor_kill_due(step):
+            # mid-refactor: the new values are staged, nothing adopted —
+            # crash consistency demands the previous handle (and any
+            # bundle on disk) survive this untouched
+            monkey.kill_now()
+
+    # ---- shadow numeric factorization (adopt-only-on-improvement) ----------
+    from superlu_dist_tpu.refine.ir import request_berrs
+    from superlu_dist_tpu.ops.dense import next_gemm_precision
+    from superlu_dist_tpu.utils.options import env_flag, env_float
+    if berr_max is None:
+        berr_max = env_float("SLU_TPU_REFACTOR_BERR_MAX")
+    escalate = env_flag("SLU_TPU_REFACTOR_ESCALATE")
+    if canary_b is None:
+        canary_b = np.ones(lu.n, dtype=np.asarray(a_new.data).dtype)
+
+    def rollback(stage, cause="", berr=-1.0):
+        if m.enabled:
+            m.inc("slu_refactor_rollbacks_total", 1.0, stage=stage)
+        return RefactorRollbackError(
+            "handle", stage=stage, cause=cause, berr=berr,
+            berr_target=berr_max if berr_max > 0 else -1.0)
+
+    tier = None                    # None = the handle's configured tier
+    rungs = max(int(lu.options.recovery.max_rungs), 1)
+    shadow = None
+    for rung in range(rungs):
+        opts = (lu.options if tier is None
+                else dataclasses.replace(lu.options, gemm_prec=tier))
+        shadow = dataclasses.replace(
+            lu, numeric=None, dev_solver=None, dev_spmv=None, berrs=None,
+            a=a_new, anorm=anorm, options=opts)
+        try:
+            info = factorize_numeric(shadow, bvals, stats)
+        except SuperLUError as e:
+            raise rollback("factor", f"{type(e).__name__}: {e}") from e
+        if info != 0:
+            raise rollback("factor", f"singular: info={info}")
+        # ---- BERR canary (refine/ir.py — one solve + one SpMV pair) ----
+        try:
+            x = shadow.solve_factored(canary_b)
+            finite = bool(np.all(np.isfinite(np.asarray(x))))
+            berr = (float(request_berrs(a_new, canary_b, x).max())
+                    if finite else float("inf"))
+        except SuperLUError as e:
+            raise rollback("canary", f"{type(e).__name__}: {e}") from e
+        if finite and (berr_max <= 0 or berr <= berr_max):
+            break
+        nxt = next_gemm_precision(
+            getattr(shadow.numeric, "gemm_prec", "highest"))
+        if not escalate or nxt is None or rung == rungs - 1:
+            raise rollback(
+                "canary",
+                "non-finite canary X" if not finite else
+                "canary backward error above the gate", berr=berr)
+        # the PR 15 escalation machinery: retry the shadow one GEMM
+        # tier up — same plan, same programs at that tier's cache slot
+        tier = nxt
+        if m.enabled:
+            m.inc("slu_recovery_rungs_total", 1.0,
+                  rung="refactor-gemm-precision", improved="pending")
+
+    # ---- atomic adoption ---------------------------------------------------
+    # single-field rebinds onto the live handle: a concurrent solve holds
+    # either the complete old numeric or the complete new one (the serve
+    # tier additionally serializes via its swap lock)
+    lu.numeric = shadow.numeric
+    lu.mesh = shadow.mesh
+    lu.dev_solver = None
+    lu.dev_spmv = None
+    lu.berrs = None
+    lu.a = a_new
+    lu.anorm = anorm
+    if tier is not None:
+        lu.options = shadow.options
+    if m.enabled:
+        m.inc("slu_refactor_adopted_total", 1.0)
+    from superlu_dist_tpu.obs.flightrec import get_flightrec
+    get_flightrec().event(
+        "refactor-adopted", cat="refactor", step=step,
+        pattern=expected_digest[:12] if expected_digest else "",
+        fresh_compile_s=float(stats.compile.get("fresh_seconds", 0.0))
+        if stats.compile else 0.0)
+    return stats
 
 
 def gssvx(options: Options, a: SparseCSR, b: np.ndarray,

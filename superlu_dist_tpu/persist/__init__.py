@@ -25,7 +25,7 @@ docs/RELIABILITY.md.
 
 from superlu_dist_tpu.persist.serial import (          # noqa: F401
     FORMAT_VERSION, save_lu, load_lu, write_bundle, read_bundle,
-    plan_fingerprint, values_digest)
+    plan_fingerprint, values_digest, pattern_digest, lu_meta)
 from superlu_dist_tpu.persist.checkpoint import (      # noqa: F401
     FactorCheckpointer, ResumeState, load_checkpoint, flush_active,
     last_checkpoint)

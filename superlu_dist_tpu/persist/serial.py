@@ -227,6 +227,21 @@ def plan_fingerprint(plan) -> str:
     return h.hexdigest()
 
 
+def pattern_digest(indptr, indices) -> str:
+    """Identity of a symmetrized-permuted sparsity pattern: sha256 over
+    the CSR structure arrays (widths canonicalized to int64, so the
+    digest is int-width portable like the bundles themselves).  This is
+    the refactor pipeline's pattern key (``drivers/gssvx.refactor``):
+    two handles/bundles with equal digests were analyzed on the SAME
+    structure and may share symbolic + plan + compiled programs, paying
+    only the numeric phase — drift raises ``PatternMismatchError``
+    instead of silently re-running symbolic."""
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(indptr, dtype=np.int64).tobytes())
+    h.update(np.ascontiguousarray(indices, dtype=np.int64).tobytes())
+    return h.hexdigest()
+
+
 def dtype_str(dtype) -> str:
     """Canonical dtype name, tolerating extension dtypes (bfloat16)
     numpy's constructor rejects."""
@@ -353,6 +368,13 @@ def save_lu(lu, dirpath: str) -> str:
         # than it ran (the escalation rung and SolveReport read this)
         "gemm_precision": getattr(numeric, "gemm_prec", "highest"),
     }
+    if lu.a_sym_indptr is not None:
+        # pattern-keyed plan sharing (docs/RELIABILITY.md): bundles with
+        # equal digests were analyzed on the same structure — a refactor
+        # or a same-pattern sibling may reuse this bundle's symbolic +
+        # plan + compiled programs wholesale, paying only numeric
+        meta["pattern_digest"] = pattern_digest(lu.a_sym_indptr,
+                                                lu.a_sym_indices)
     return write_manifest(dirpath, "lu_handle", meta, entries)
 
 

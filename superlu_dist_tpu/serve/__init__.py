@@ -4,6 +4,6 @@ from superlu_dist_tpu.serve.handlecache import HandleCache  # noqa: F401
 from superlu_dist_tpu.serve.fleet import (    # noqa: F401
     FleetRouter, FleetTicket, ProcessReplica, ThreadReplica)
 from superlu_dist_tpu.utils.errors import (   # noqa: F401
-    DeployRollbackError, FactorCorruptError, ReplicaFailureError,
-    ServeDeadlineError, ServeOverloadError, ServePoisonedError,
-    ServerClosedError)
+    DeployRollbackError, FactorCorruptError, PatternMismatchError,
+    RefactorRollbackError, ReplicaFailureError, ServeDeadlineError,
+    ServeOverloadError, ServePoisonedError, ServerClosedError)

@@ -80,8 +80,8 @@ from superlu_dist_tpu.parallel.treecomm import pid_alive
 from superlu_dist_tpu.serve.handlecache import HandleCache
 from superlu_dist_tpu.utils.errors import (
     CheckpointError, DeployRollbackError, FactorCorruptError,
-    ReplicaFailureError, ServeDeadlineError, ServeOverloadError,
-    ServerClosedError, SuperLUError)
+    RefactorRollbackError, ReplicaFailureError, ServeDeadlineError,
+    ServeOverloadError, ServerClosedError, SuperLUError)
 from superlu_dist_tpu.utils.lockwatch import make_condition, make_lock
 
 #: default SolveServer keywords for fleet-loaded handles: no coalescing
@@ -713,6 +713,7 @@ class FleetRouter:
         self._reroutes = 0
         self._failovers = 0
         self._deploys = 0
+        self._refactors = 0
         self._rollbacks = 0
         m = get_metrics()
         self._metrics = m if m.enabled else None
@@ -1119,6 +1120,85 @@ class FleetRouter:
             self._metrics.inc("slu_fleet_rollbacks_total", 1.0)
 
     # ------------------------------------------------------------------
+    def refactor(self, key, new_values, canary_b: np.ndarray | None = None,
+                 berr_max: float = 0.0, workdir: str | None = None,
+                 preflight: bool = True) -> dict:
+        """Rolling same-pattern refactorization of the fleet's handle
+        for ``key``: the registered bundle is loaded router-side, its
+        numeric phase re-run over ``new_values`` (same-pattern
+        SparseCSR) through the crash-consistent
+        ``drivers.gssvx.refactor`` pipeline — symbolic, plan, and
+        compiled programs reused, BERR-canaried, adopted only on
+        success — persisted as a sibling bundle, and rolled across the
+        replicas one at a time through the :meth:`deploy` drain-point +
+        canary machinery (zero dropped tickets; values cross the pipe
+        as a bundle, the replica protocol is unchanged).  Failure at
+        ANY stage raises
+        :class:`~superlu_dist_tpu.utils.errors.RefactorRollbackError`
+        with every already-swapped replica restored to the previous
+        bundle, which keeps serving — the fleet never mixes old and new
+        factors.  Pattern drift raises ``PatternMismatchError`` before
+        anything is touched.  Returns the :meth:`deploy` summary dict
+        plus the new bundle path."""
+        from superlu_dist_tpu.drivers.gssvx import refactor as _refactor
+        from superlu_dist_tpu.persist.serial import load_lu, save_lu
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("FleetRouter is closed")
+            if key not in self._registry:
+                raise SuperLUError(
+                    f"matrix key {key!r} is not registered with this "
+                    "fleet")
+            old_path = self._registry[key]
+            seq = self._refactors + self._rollbacks
+        if self._metrics is not None:
+            self._metrics.inc("slu_fleet_refactor_total", 1.0)
+        try:
+            lu = load_lu(old_path)
+        except Exception as e:              # noqa: BLE001 — gate
+            self._note_rollback()
+            raise RefactorRollbackError(
+                key, "load", cause=f"{type(e).__name__}: {e}")
+        try:
+            _refactor(lu, new_values, canary_b=canary_b,
+                      berr_max=berr_max)
+        except RefactorRollbackError as e:
+            # the shadow factorization/canary already rolled back at
+            # the handle level; nothing was persisted, no replica saw it
+            self._note_rollback()
+            raise RefactorRollbackError(
+                key, e.stage, cause=e.cause or "handle-level refactor "
+                "rolled back", berr=e.berr,
+                berr_target=e.berr_target) from e
+        new_path = (os.path.join(workdir, f"refactor-{seq:04d}")
+                    if workdir is not None
+                    else f"{old_path}.refactor-{seq:04d}")
+        try:
+            save_lu(lu, new_path)
+        except Exception as e:              # noqa: BLE001 — gate
+            self._note_rollback()
+            raise RefactorRollbackError(
+                key, "persist", cause=f"{type(e).__name__}: {e}")
+        a_gate = lu.a if berr_max > 0 else None
+        try:
+            summary = self.deploy(new_path, key=key, canary_b=canary_b,
+                                  a=a_gate, berr_max=berr_max,
+                                  preflight=preflight)
+        except DeployRollbackError as e:
+            # deploy() already restored every swapped replica and noted
+            # the rollback; surface it under the refactor contract
+            raise RefactorRollbackError(
+                key, e.stage, replica=e.replica,
+                rolled_back=e.rolled_back, cause=e.cause) from e
+        with self._lock:
+            self._refactors += 1
+        if self._metrics is not None:
+            self._metrics.inc("slu_fleet_refactor_adopted_total", 1.0)
+        summary["previous"] = old_path
+        summary["bundle"] = new_path
+        return summary
+
+    # ------------------------------------------------------------------
     def drain(self, timeout: float | None = None) -> bool:
         """Reject new submits (``ServeOverloadError`` reason
         ``draining``) while finishing everything undelivered.  True
@@ -1184,6 +1264,7 @@ class FleetRouter:
                 "reroutes": self._reroutes,
                 "failovers": self._failovers,
                 "deploys": self._deploys,
+                "refactors": self._refactors,
                 "rollbacks": self._rollbacks,
                 "pending_cols": self._pending_cols,
                 "queue_max": self.queue_max,

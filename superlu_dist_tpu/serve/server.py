@@ -289,6 +289,7 @@ class SolveServer:
         self._poisoned = 0
         self._refined = 0
         self._swaps = 0
+        self._refactors = 0
         self._scrub_runs = 0
         self._scrub_failures = 0
         self._metrics = m = get_metrics()
@@ -563,6 +564,41 @@ class SolveServer:
         return self
 
     # ------------------------------------------------------------------
+    def refactor(self, new_values, canary_b=None,
+                 berr_max=None) -> "SolveServer":
+        """Same-pattern hot refactorization: re-run the numeric phase of
+        the SERVED handle over ``new_values`` (a same-pattern SparseCSR,
+        or a raw CSR data array in the original matrix's ordering) and
+        :meth:`swap` the result in — symbolic, plan, and compiled
+        programs all reused, zero tickets dropped.  The pipeline is the
+        crash-consistent one from ``drivers.gssvx.refactor``: the shadow
+        factorization runs against a COPY of the handle, is BERR-gated
+        on a canary solve, and only an adopted shadow reaches the swap —
+        a poisoned/singular refactor raises
+        :class:`~superlu_dist_tpu.utils.errors.RefactorRollbackError`
+        (or :class:`PatternMismatchError` on pattern drift) with the
+        previous handle still serving every queued and future ticket."""
+        import dataclasses
+
+        from superlu_dist_tpu.drivers.gssvx import refactor as _refactor
+        with self._lock:
+            if self._closed:
+                raise ServerClosedError("SolveServer is closed")
+            live = self.lu
+        # the shadow handle shares the (immutable) symbolic fact, plan,
+        # and compiled executors with the live one; refactor() adopts
+        # onto the shadow only, so in-flight batches keep the old panels
+        shadow = dataclasses.replace(live)
+        _refactor(shadow, new_values, canary_b=canary_b,
+                  berr_max=berr_max)
+        self.swap(shadow)
+        with self._lock:
+            self._refactors += 1
+        if self._metrics is not None:
+            self._metrics.inc("slu_serve_refactors_total", 1.0)
+        return self
+
+    # ------------------------------------------------------------------
     def _compute_digests(self, lu=None):
         from superlu_dist_tpu.persist.serial import front_digests
         return front_digests((lu or self.lu).numeric.fronts)
@@ -655,6 +691,7 @@ class SolveServer:
                 "poisoned_columns": self._poisoned,
                 "refined": self._refined,
                 "swaps": self._swaps,
+                "refactors": self._refactors,
                 "scrub_runs": self._scrub_runs,
                 "scrub_failures": self._scrub_failures,
                 "queue_depth": self._pending_cols,

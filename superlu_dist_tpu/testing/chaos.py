@@ -47,6 +47,16 @@ Enable in a victim process via the registered env knob::
                                               # batch: slow, NOT dead — the
                                               # fleet health monitor must
                                               # yield ZERO false failovers
+    SLU_TPU_CHAOS='kill_refactor@step=2'    # SIGKILL self MID-REFACTOR on
+                                              # the 3rd refactor (shadow
+                                              # numeric started, nothing
+                                              # adopted) — the previous
+                                              # handle must keep serving
+    SLU_TPU_CHAOS='poison_values=3'         # NaN the new-values entry
+                                              # assembling into supernode 3
+                                              # mid-refactor — the canary /
+                                              # sentinels must roll back,
+                                              # adopting nothing
 
 The factor path consults :func:`get_chaos` once per factorization
 (numeric/factor.py) and the streamed executor calls
@@ -123,12 +133,24 @@ class ChaosPlan:
     slow_replica: int = -1    # this replica stalls `secs` once before
                               # a batch — slow, NOT dead: the health
                               # monitor must not fail it over
+    # ---- refactor domain (ISSUE 16) -----------------------------------
+    kill_refactor: int = -1   # kill self MID-REFACTOR (shadow numeric
+                              # running, nothing adopted yet) on the
+                              # Kth refactor of this process (0-based;
+                              # spec shorthand kill_refactor@step=K) —
+                              # the interrupted-refactor domain: the
+                              # previous handle must keep serving
+    poison_values: int = -1   # NaN the new-values entry assembling into
+                              # supernode S mid-refactor (same targeting
+                              # as nan_supernode, scoped to refactor) —
+                              # the sentinels/canary must reject and
+                              # roll back, adopting nothing
 
     @property
     def armed(self) -> bool:
         return (self.kill_group >= 0 or self.nan_supernode >= 0
                 or self.comm_armed or self.serve_armed
-                or self.fleet_armed)
+                or self.fleet_armed or self.refactor_armed)
 
     @property
     def comm_armed(self) -> bool:
@@ -143,6 +165,10 @@ class ChaosPlan:
     def fleet_armed(self) -> bool:
         return (self.kill_replica >= 0 or self.quarantine_replica >= 0
                 or self.slow_replica >= 0)
+
+    @property
+    def refactor_armed(self) -> bool:
+        return self.kill_refactor >= 0 or self.poison_values >= 0
 
 
 def parse_chaos_spec(spec: str) -> ChaosPlan:
@@ -167,10 +193,16 @@ def parse_chaos_spec(spec: str) -> ChaosPlan:
             rid, at, batch = val.partition("@batch=")
             plan.kill_replica = int(rid)
             plan.batch = int(batch) if at else 0
+        elif key == "kill_refactor@step" or key == "kill_refactor":
+            # 'kill_refactor@step=K' (the documented shorthand) or the
+            # plain 'kill_refactor=K' both mean: die mid-refactor on
+            # the Kth refactor of this process
+            plan.kill_refactor = int(val)
         elif key in ("kill_group", "nan_supernode", "kill_op",
                      "stall_rank", "stall_op", "epoch", "poison_rhs",
                      "slow_client", "corrupt_panel", "batch",
-                     "quarantine_replica", "slow_replica"):
+                     "quarantine_replica", "slow_replica",
+                     "poison_values"):
             setattr(plan, key, int(val))
         elif key == "secs":
             plan.secs = float(val)
@@ -206,6 +238,7 @@ class ChaosMonkey:
         self.groups_seen = 0
         self._stalled = False
         self._panel_corrupted = False
+        self._values_poisoned = False
 
     def _kill_self(self) -> None:
         sig = (signal.SIGTERM if self.plan.signal == "term"
@@ -343,6 +376,44 @@ class ChaosMonkey:
         self._stalled = True
         return p.secs
 
+    # ---- refactor domain (drivers/gssvx.refactor hooks) ------------------
+    def refactor_kill_due(self, step_index: int) -> bool:
+        """``kill_refactor@step=K``: True when the ``step_index``-th
+        refactor of this process (0-based count, maintained by the
+        caller) must die MID-REFACTOR — after the shadow numeric
+        factorization has started, before anything is adopted.  The
+        caller SIGKILLs via :meth:`kill_now`; crash consistency demands
+        the previous handle (and any bundle on disk) stay untouched.
+        Epoch-scoped like every serve injection."""
+        p = self.plan
+        return (p.kill_refactor >= 0 and step_index >= p.kill_refactor
+                and self._serve_epoch_ok())
+
+    def kill_now(self) -> None:
+        """The injected death itself (SIGKILL, or SIGTERM under
+        ``signal=term`` — exercising the checkpoint/flightrec SIGTERM
+        chain before dying)."""
+        self._kill_self()
+
+    def poison_refactor_values(self, plan,
+                               bvals: np.ndarray) -> np.ndarray:
+        """``poison_values=S``: NaN the NEW values' entry that assembles
+        into supernode S — the poisoned-refactor domain: the breakdown
+        sentinels (or the BERR canary) must reject the shadow factors
+        and the refactor must roll back adopting nothing.  Same
+        deterministic targeting as :meth:`poke_nan`; fires once per
+        monkey; returns a poisoned COPY (no-op otherwise)."""
+        s = self.plan.poison_values
+        if s < 0 or self._values_poisoned or not self._serve_epoch_ok():
+            return bvals
+        self._values_poisoned = True
+        # clamp to the plan's supernode count so one spec drives tests
+        # of every problem size (deterministic either way)
+        s = min(s, len(plan.sn_group) - 1)
+        sub = dataclasses.replace(self.plan, nan_supernode=s,
+                                  poison_values=-1)
+        return ChaosMonkey(sub).poke_nan(plan, bvals)
+
     # ---- numeric-poison domain -----------------------------------------
     def poke_nan(self, plan, pattern_values: np.ndarray) -> np.ndarray:
         """Poison supernode ``nan_supernode``: NaN one A-entry that
@@ -394,6 +465,19 @@ def get_serve_chaos() -> ChaosMonkey | None:
     armed, so submit/scrub hooks stay one ``is None`` test."""
     monkey = get_chaos()
     if monkey is None or not monkey.plan.serve_armed:
+        return None
+    return monkey
+
+
+def get_refactor_chaos() -> ChaosMonkey | None:
+    """Refactor-domain injector for ``drivers/gssvx.refactor``
+    (kill_refactor / poison_values specs).  Consulted ONCE per refactor
+    — each refactor call gets its own monkey so the fire-once poison
+    latch is per-refactor state — and None unless a refactor injection
+    is armed, so the production refactor path pays one ``is None``
+    test."""
+    monkey = get_chaos()
+    if monkey is None or not monkey.plan.refactor_armed:
         return None
     return monkey
 

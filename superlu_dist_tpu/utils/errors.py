@@ -112,6 +112,36 @@ class CheckpointMismatchError(CheckpointError):
     silently splice incompatible frontiers, so this is a hard error."""
 
 
+class PatternMismatchError(SuperLUError):
+    """A values-only refactorization (``drivers/gssvx.refactor``) was
+    handed a matrix whose sparsity pattern, shape, or permutation
+    identity differs from the one the handle's symbolic structure was
+    built on.  Silently re-running the symbolic phase here would break
+    the refactor contract (zero symbolic cost, zero recompile, plan and
+    compiled programs reused by identity), so drift is a hard, typed
+    error: re-analyze with ``Fact=DOFACT`` to factor the new pattern.
+    ``expected_digest``/``got_digest`` carry the sha256 pattern digests
+    (persist.serial.pattern_digest — the same identity bundles record)
+    when both sides could compute one.  Dumps a flight-recorder
+    postmortem at construction."""
+
+    def __init__(self, reason: str, expected_digest: str = "",
+                 got_digest: str = "", n: int = -1, nnz: int = -1):
+        self.reason = reason
+        self.expected_digest = expected_digest
+        self.got_digest = got_digest
+        self.n = int(n)
+        self.nnz = int(nnz)
+        dg = (f" (handle pattern {expected_digest[:12]}, "
+              f"got {got_digest[:12]})"
+              if expected_digest and got_digest else "")
+        super().__init__(
+            f"refactor refused: {reason}{dg} — a values-only refactor "
+            "requires the exact sparsity pattern the handle was analyzed "
+            "on; factor the new pattern with Fact=DOFACT instead")
+        _flight_dump(self)
+
+
 class CommTimeoutError(SuperLUError):
     """A bounded-wait collective leg (``SLU_TPU_COMM_TIMEOUT_S``) kept
     timing out on a peer whose process is still ALIVE, and the retry
@@ -342,6 +372,43 @@ class DeployRollbackError(SuperLUError):
             f"{key!r} rolled back at the {stage} check{at}{why}{back} "
             "— the fleet keeps serving the previous factors "
             "(docs/SERVING.md fleet chapter)")
+        _flight_dump(self)
+
+
+class RefactorRollbackError(SuperLUError):
+    """A refactorization was ROLLED BACK: the shadow factorization over
+    the new values broke down (NaN/Inf, singular), missed its BERR
+    canary gate, or — on the fleet verb (``FleetRouter.refactor``) — a
+    replica failed its per-replica canary mid-roll, so every replica
+    already swapped to the refactored bundle was restored and the
+    previous consistent handle keeps serving.  ``stage`` names the
+    failing check (``factor`` / ``canary`` / ``deploy``), ``replica``
+    the replica it failed on (-1 for the handle-level pipeline),
+    ``rolled_back`` the replicas restored, ``berr``/``berr_target`` the
+    measured vs required canary backward error when the gate fired.
+    Dumps a flight-recorder postmortem at construction."""
+
+    def __init__(self, key, stage: str, replica: int = -1,
+                 rolled_back=(), cause: str = "", berr: float = -1.0,
+                 berr_target: float = -1.0):
+        self.key = key
+        self.stage = stage
+        self.replica = int(replica)
+        self.rolled_back = sorted(int(r) for r in rolled_back)
+        self.cause = cause
+        self.berr = float(berr)
+        self.berr_target = float(berr_target)
+        at = f" on replica {replica}" if replica >= 0 else ""
+        why = f": {cause}" if cause else ""
+        gate = (f" (berr {berr:.3e} > gate {berr_target:.3e})"
+                if berr >= 0.0 and berr_target >= 0.0 else "")
+        back = (f"; replica(s) {self.rolled_back} restored to the "
+                "previous factors" if self.rolled_back else "")
+        super().__init__(
+            f"refactor of handle {key!r} rolled back at the {stage} "
+            f"check{at}{why}{gate}{back} — the previous consistent "
+            "factorization keeps serving (docs/SERVING.md fleet-refactor "
+            "verb)")
         _flight_dump(self)
 
 

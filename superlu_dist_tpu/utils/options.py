@@ -279,6 +279,16 @@ def _register_all() -> None:
       group="recovery")
     r("SLU_TPU_SENTINELS", "flag", True,
       "non-finite isfinite sentinels in the numeric layer", group="recovery")
+    r("SLU_TPU_REFACTOR_BERR_MAX", "float", 0.0,
+      "componentwise-BERR adoption gate for refactor(handle, new_values): "
+      "the shadow factorization's canary solve must come in at or under "
+      "this backward error or the refactor rolls back (0 = finite-only "
+      "gate; an explicit berr_max argument overrides)", group="recovery")
+    r("SLU_TPU_REFACTOR_ESCALATE", "flag", True,
+      "let a BERR-gated refactor climb the GEMM-precision ladder "
+      "(ops/dense.next_gemm_precision, up to recovery.max_rungs shadow "
+      "attempts) before rolling back; off = single attempt at the "
+      "handle's tier", group="recovery")
     # --- persistence / crash consistency -----------------------------------
     r("SLU_TPU_CKPT_EVERY", "int", 0,
       "flush a factor checkpoint every K completed dispatch groups "
@@ -332,7 +342,8 @@ def _register_all() -> None:
     # --- test / CI harness -------------------------------------------------
     r("SLU_TPU_CHAOS", "str", "",
       "failure-domain chaos-injection spec (testing/chaos.py, e.g. "
-      "'kill_group=5' or 'nan_supernode=3'); empty = off", group="test")
+      "'kill_group=5', 'nan_supernode=3', 'kill_refactor@step=0', "
+      "'poison_values=2'); empty = off", group="test")
     r("SLU_TPU_SKIP_PROBE", "flag", False,
       "__graft_entry__: skip the accelerator probe", group="test")
     r("SLU_TPU_DRYRUN_BIG", "str", "1",

@@ -11,7 +11,8 @@ import pytest
 EXAMPLES = ["pddrive.py", "pddrive1.py", "pddrive2.py", "pddrive3.py",
             "pddrive4.py", "pzdrive.py", "pzdrive1.py", "pzdrive2.py",
             "pzdrive3.py", "pzdrive4.py", "pddrive_ABglobal.py",
-            "pddrive_dist.py", "pddrive_df64.py", "pddrive_grid.py"]
+            "pddrive_dist.py", "pddrive_df64.py", "pddrive_grid.py",
+            "pddrive_refactor.py"]
 ROOT = os.path.join(os.path.dirname(__file__), "..")
 
 

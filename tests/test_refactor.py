@@ -1,0 +1,337 @@
+"""Crash-consistent same-pattern refactorization (drivers/gssvx.py
+``refactor`` + ``SolveServer.refactor`` + ``FleetRouter.refactor``):
+values-only refactorization reuses the symbolic fact, FactorPlan, and
+compiled programs (zero recompile, bitwise-identical to a
+SamePattern_SameRowPerm driver pass), refuses drifted patterns with a
+structured error, and — under the chaos specs ``kill_refactor@step=K``
+and ``poison_values=S`` — always leaves the previous consistent handle
+serving: an interrupted, NaN-poisoned, or BERR-rejected refactor adopts
+nothing, and the fleet verb rolls every swapped replica back."""
+
+import dataclasses
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from superlu_dist_tpu.drivers.gssvx import gssvx, refactor
+from superlu_dist_tpu.models.gallery import hilbert, poisson2d
+from superlu_dist_tpu.persist.serial import (load_lu, lu_meta,
+                                             pattern_digest, save_lu)
+from superlu_dist_tpu.serve import (FleetRouter, PatternMismatchError,
+                                    RefactorRollbackError, SolveServer)
+from superlu_dist_tpu.serve.fleet import FLEET_SERVER_KW
+from superlu_dist_tpu.utils.errors import SuperLUError
+from superlu_dist_tpu.utils.options import Fact, IterRefine, Options
+from superlu_dist_tpu.utils.stats import Stats
+
+pytestmark = pytest.mark.refactor
+
+ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _drift(a, scale=2.0, shift=0.01):
+    return type(a)(a.n_rows, a.n_cols, a.indptr, a.indices,
+                   a.data * scale + shift)
+
+
+def _same_pattern_baseline(a, a2, b, opts):
+    """The ground truth a refactor must hit bitwise: an independent
+    handle refreshed through the driver's SamePattern_SameRowPerm
+    tier."""
+    _, lu, _, info = gssvx(opts, a, b, stats=Stats())
+    assert info == 0
+    _, lu2, _, info2 = gssvx(
+        dataclasses.replace(opts, fact=Fact.SamePattern_SameRowPerm),
+        a2, b, lu=lu, stats=Stats())
+    assert info2 == 0
+    return lu2
+
+
+# ---------------------------------------------------------------------------
+# the tentpole invariant: refactor ≡ SamePattern refresh, zero recompile
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("executor", ["fused", "stream", "mega"])
+@pytest.mark.parametrize("dtype", ["float64", "complex128", "df64"])
+def test_refactor_bitwise_and_zero_recompile(executor, dtype):
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+    a = poisson2d(7)
+    if dtype == "complex128":
+        a = type(a)(a.n_rows, a.n_cols, a.indptr, a.indices,
+                    a.data.astype(np.complex128) * (1 + 0.25j))
+    b = np.arange(1, a.n_rows + 1, dtype=np.float64)
+    opts = Options(executor=executor, factor_dtype=dtype,
+                   iter_refine=IterRefine.NOREFINE)
+    a2 = _drift(a)
+    base = _same_pattern_baseline(a, a2, b, opts)
+
+    _, lu, _, info = gssvx(opts, a, b, stats=Stats())
+    assert info == 0
+    marker = COMPILE_STATS.marker()
+    st = Stats()
+    refactor(lu, a2, stats=st)
+    assert np.array_equal(np.asarray(lu.solve_factored(b)),
+                          np.asarray(base.solve_factored(b)))
+    # the economics, asserted: no symbolic pass, no fresh compile
+    assert float(st.utime.get("SYMBFACT", 0.0)) == 0.0
+    blk = COMPILE_STATS.block(since=marker)
+    assert float(blk["fresh_seconds"]) == 0.0, blk
+    # symbolic fact + plan are the SAME objects (reuse by construction)
+    assert lu.sf is not None and lu.plan is not None
+
+
+def test_refactor_raw_values_array():
+    """The serving verbs pass a bare CSR data array; it must land
+    bitwise on the SparseCSR path."""
+    a = poisson2d(7)
+    b = np.ones(a.n_rows)
+    opts = Options(iter_refine=IterRefine.NOREFINE)
+    vals = a.data * 0.5
+    a2 = type(a)(a.n_rows, a.n_cols, a.indptr, a.indices, vals)
+    base = _same_pattern_baseline(a, a2, b, opts)
+    _, lu, _, _ = gssvx(opts, a, b, stats=Stats())
+    refactor(lu, vals)
+    assert np.array_equal(np.asarray(lu.solve_factored(b)),
+                          np.asarray(base.solve_factored(b)))
+    with pytest.raises(PatternMismatchError):
+        refactor(lu, vals[:-1])          # wrong nnz
+
+
+def test_refactor_identity_latch_and_pattern_digest():
+    a = poisson2d(6)
+    _, lu, _, _ = gssvx(Options(), a, np.ones(a.n_rows), stats=Stats())
+    dig, fp = lu.identity()
+    assert dig and fp
+    assert dig == pattern_digest(lu.a_sym_indptr, lu.a_sym_indices)
+    assert lu.identity() == (dig, fp)    # latched, stable
+
+
+def test_pattern_drift_refused_structured():
+    """A different sparsity pattern must refuse with the structured
+    error, not silently re-run symbolic analysis."""
+    a = poisson2d(6)
+    _, lu, _, _ = gssvx(Options(), a, np.ones(a.n_rows), stats=Stats())
+    sf, plan = lu.sf, lu.plan
+    with pytest.raises(PatternMismatchError) as ei:
+        refactor(lu, hilbert(a.n_rows))
+    assert ei.value.expected_digest
+    assert "DOFACT" in str(ei.value)
+    # nothing was touched: same symbolic/plan, handle still solves
+    assert lu.sf is sf and lu.plan is plan
+    assert np.isfinite(np.asarray(lu.solve_factored(
+        np.ones(a.n_rows)))).all()
+
+
+# ---------------------------------------------------------------------------
+# rollback domains: poisoned values, BERR gate, kill -9 mid-refactor
+# ---------------------------------------------------------------------------
+
+def test_poisoned_refactor_rolls_back_adopting_nothing(monkeypatch):
+    a = poisson2d(7)
+    b = np.arange(1, a.n_rows + 1, dtype=np.float64)
+    _, lu, _, _ = gssvx(Options(), a, b, stats=Stats())
+    x_before = np.asarray(lu.solve_factored(b))
+    old_numeric, old_a = lu.numeric, lu.a
+    monkeypatch.setenv("SLU_TPU_CHAOS", "poison_values=1")
+    with pytest.raises(RefactorRollbackError) as ei:
+        refactor(lu, _drift(a))
+    monkeypatch.delenv("SLU_TPU_CHAOS")
+    assert ei.value.stage in ("factor", "canary")
+    assert lu.numeric is old_numeric and lu.a is old_a
+    assert np.array_equal(np.asarray(lu.solve_factored(b)), x_before)
+    # and the handle still accepts a CLEAN refactor afterwards
+    refactor(lu, _drift(a))
+    assert lu.numeric is not old_numeric
+
+
+def test_berr_gate_rejects_without_adoption(monkeypatch):
+    monkeypatch.setenv("SLU_TPU_REFACTOR_ESCALATE", "0")
+    a = poisson2d(7)
+    b = np.ones(a.n_rows)
+    _, lu, _, _ = gssvx(Options(), a, b, stats=Stats())
+    old_numeric = lu.numeric
+    with pytest.raises(RefactorRollbackError) as ei:
+        refactor(lu, _drift(a), berr_max=1e-300)   # unmeetable gate
+    assert ei.value.stage == "canary"
+    assert ei.value.berr > ei.value.berr_target >= 0
+    assert lu.numeric is old_numeric
+    # a meetable gate adopts
+    refactor(lu, _drift(a), berr_max=1e-8)
+    assert lu.numeric is not old_numeric
+
+
+def test_kill9_mid_refactor_preserves_bundle(tmp_path):
+    """kill_refactor@step=0 SIGKILLs the child MID-REFACTOR; the bundle
+    it was serving from must still load and solve bitwise — an
+    interrupted refactor leaves the previous consistent state."""
+    d = str(tmp_path / "bundle")
+    a = poisson2d(6)
+    b = np.ones(a.n_rows)
+    _, lu, _, _ = gssvx(Options(), a, b, stats=Stats())
+    save_lu(lu, d)
+    x_before = np.asarray(load_lu(d).solve_factored(b))
+    child = (
+        "import numpy as np\n"
+        "from superlu_dist_tpu.drivers.gssvx import refactor\n"
+        "from superlu_dist_tpu.persist.serial import load_lu\n"
+        "from superlu_dist_tpu.models.gallery import poisson2d\n"
+        f"lu = load_lu({d!r})\n"
+        "a = poisson2d(6)\n"
+        "a2 = type(a)(a.n_rows, a.n_cols, a.indptr, a.indices,\n"
+        "             a.data * 2.0)\n"
+        "refactor(lu, a2)\n"
+        "print('UNREACHABLE')\n")
+    env = dict(os.environ, SLU_TPU_CHAOS="kill_refactor@step=0",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", child], env=env, cwd=ROOT,
+                       capture_output=True, timeout=300)
+    assert r.returncode == -9, (r.returncode, r.stdout, r.stderr)
+    assert b"UNREACHABLE" not in r.stdout
+    lu2 = load_lu(d)
+    assert np.array_equal(np.asarray(lu2.solve_factored(b)), x_before)
+    assert lu_meta(d)["pattern_digest"] == lu.identity()[0]
+
+
+# ---------------------------------------------------------------------------
+# serving tiers: hot refactor with zero dropped tickets
+# ---------------------------------------------------------------------------
+
+def test_server_refactor_swaps_and_rolls_back():
+    a = poisson2d(7)
+    b = np.arange(1, a.n_rows + 1, dtype=np.float64)
+    _, lu, _, _ = gssvx(Options(), a, b, stats=Stats())
+    a2 = _drift(a)
+    base = _same_pattern_baseline(a, a2, b, Options())
+    srv = SolveServer(lu, max_wait_s=0.0)
+    try:
+        srv.refactor(a2)
+        assert np.array_equal(np.asarray(srv.solve(b)),
+                              np.asarray(base.solve_factored(b)))
+        st = srv.stats()
+        assert st["refactors"] == 1 and st["swaps"] == 1
+        x_now = np.asarray(srv.solve(b))
+        os.environ["SLU_TPU_CHAOS"] = "poison_values=1"
+        try:
+            with pytest.raises(RefactorRollbackError):
+                srv.refactor(_drift(a, scale=3.0))
+        finally:
+            del os.environ["SLU_TPU_CHAOS"]
+        # the failed refactor never reached the swap
+        assert srv.stats()["swaps"] == 1
+        assert np.array_equal(np.asarray(srv.solve(b)), x_now)
+    finally:
+        srv.close()
+
+
+def test_fleet_rolling_refactor_under_traffic_and_rollback(tmp_path):
+    a = poisson2d(7)
+    b = a.matvec(np.ones(a.n_rows))
+    _, lu, _, _ = gssvx(Options(iter_refine=IterRefine.NOREFINE), a, b,
+                        stats=Stats())
+    d = str(tmp_path / "k0")
+    save_lu(lu, d)
+    a2 = _drift(a)
+    base = _same_pattern_baseline(
+        a, a2, b, Options(iter_refine=IterRefine.NOREFINE))
+    fleet = FleetRouter({"k0": d}, n_replicas=3, kind="thread",
+                        server_kw=FLEET_SERVER_KW)
+    stop = threading.Event()
+    outcomes = []
+    lock = threading.Lock()
+
+    def client():
+        while not stop.is_set():
+            try:
+                fleet.solve("k0", b, timeout=120)
+                tag = "ok"
+            except Exception as e:        # noqa: BLE001 — tallied
+                tag = type(e).__name__
+            with lock:
+                outcomes.append(tag)
+
+    th = threading.Thread(target=client)
+    th.start()
+    try:
+        time.sleep(0.05)
+        summary = fleet.refactor("k0", a2)
+        time.sleep(0.05)
+    finally:
+        stop.set()
+        th.join(30)
+    try:
+        # rolling refactor under live traffic dropped nothing
+        assert outcomes and set(outcomes) == {"ok"}, outcomes
+        assert summary["replicas_swapped"] == [0, 1, 2]
+        assert np.array_equal(np.asarray(fleet.solve("k0", b)),
+                              np.asarray(base.solve_factored(b)))
+        x_now = np.asarray(fleet.solve("k0", b))
+        # poisoned refactor: every replica keeps the adopted bundle
+        os.environ["SLU_TPU_CHAOS"] = "poison_values=1"
+        try:
+            with pytest.raises(RefactorRollbackError) as ei:
+                fleet.refactor("k0", _drift(a, scale=3.0))
+        finally:
+            del os.environ["SLU_TPU_CHAOS"]
+        assert ei.value.stage in ("factor", "canary")
+        assert np.array_equal(np.asarray(fleet.solve("k0", b)), x_now)
+        st = fleet.stats()
+        assert st["refactors"] == 1 and st["rollbacks"] == 1
+        assert st["errors"] == 0
+    finally:
+        fleet.close()
+
+
+@pytest.mark.slow
+def test_fleet_refactor_with_kill9_replica_zero_loss(monkeypatch,
+                                                     tmp_path):
+    """Process replicas, a REAL kill -9 of one replica mid-stream while
+    a rolling refactor lands: every accepted ticket is still delivered
+    and the refactored factors serve bitwise."""
+    a = poisson2d(6)
+    b = a.matvec(np.ones(a.n_rows))
+    _, lu, _, _ = gssvx(Options(iter_refine=IterRefine.NOREFINE), a, b,
+                        stats=Stats())
+    d = str(tmp_path / "k0")
+    save_lu(lu, d)
+    a2 = _drift(a)
+    base = _same_pattern_baseline(
+        a, a2, b, Options(iter_refine=IterRefine.NOREFINE))
+    monkeypatch.setenv("SLU_TPU_CHAOS", "kill_replica=1@batch=1")
+    fleet = FleetRouter({"k0": d}, n_replicas=3, kind="process",
+                        server_kw=FLEET_SERVER_KW)
+    try:
+        tickets = [fleet.submit("k0", b) for _ in range(8)]
+        monkeypatch.delenv("SLU_TPU_CHAOS")
+        # the kill -9 fires on batch 1; the failover machinery reroutes
+        # and every accepted ticket is still delivered
+        xs = [t.result(300) for t in tickets]
+        assert all(np.isfinite(x).all() for x in xs)
+        st = fleet.stats()
+        assert st["failovers"] >= 1 and 1 in st["replicas_failed"]
+        # the rolling refactor then lands on the SURVIVING replicas
+        summary = fleet.refactor("k0", a2)
+        assert 1 not in summary["replicas_swapped"]
+        tickets2 = [fleet.submit("k0", b) for _ in range(6)]
+        for t in tickets2:
+            assert np.array_equal(np.asarray(t.result(300)),
+                                  np.asarray(base.solve_factored(b)))
+        st = fleet.stats()
+        assert st["errors"] == 0
+        assert st["delivered"] == 14
+        assert st["refactors"] == 1
+    finally:
+        fleet.close()
+
+
+def test_refactor_requires_factored_handle():
+    a = poisson2d(5)
+    _, lu, _, _ = gssvx(Options(), a, np.ones(a.n_rows), stats=Stats())
+    with pytest.raises(SuperLUError):
+        refactor(dataclasses.replace(lu, sf=None), a)
+    with pytest.raises(SuperLUError):
+        refactor(dataclasses.replace(lu, plan=None), a)
