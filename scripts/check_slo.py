@@ -1,0 +1,190 @@
+#!/usr/bin/env python
+"""SLO CI gate: serve-path p99 latency vs the bench-history baseline.
+
+Serves ``SLO_GATE_REQUESTS`` single-ticket solves per nrhs size (from
+``SLO_GATE_NRHS``, default "1,8") through a real ``SolveServer`` in a
+fresh subprocess, reads the p99 off the always-on latency accounter
+(obs/slo.py — the same streaming histogram the serving fleet exports),
+and compares each size against the MEDIAN of prior same-configuration
+rows in the bench-history DB (scripts/bench_history.py).  The
+check_perf_regress.py discipline, inverted for latency (LOWER is
+better):
+
+* SELF-SEEDING — with fewer than ``SLO_GATE_MIN_SAMPLES`` comparable
+  rows for a size, its fresh row is appended and the gate passes, so
+  the first run on a new machine is green and later runs have a
+  baseline;
+* the failure threshold is ``p99 > (1 + SLO_GATE_TOL) * median``
+  (default tol 1.0 — CI schedulers are noisy; a serve-path regression
+  worth failing on is a multiple, not a percentage);
+* a failing row is still appended, flagged ``gate_fail``, so it never
+  poisons the baseline median.
+
+Usage:  check_slo.py [--row FILE] [--history PATH]
+  --row      compare an existing measurement JSON (``{"1": p99_ms,...}``
+             on the last line; FILE may be '-') instead of serving
+  --history  override the DB path (default: SLU_TPU_BENCH_HISTORY or
+             .cache/bench_history.jsonl)
+
+Gate contract (scripts/ci_gates.sh): exit 0 = pass/seeded, exit 1 =
+regression or no measurement, diagnostics on stdout/stderr.
+"""
+
+import json
+import os
+import statistics
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from superlu_dist_tpu.utils.options import (          # noqa: E402
+    env_float, env_int, env_str)
+from bench_history import (                           # noqa: E402
+    append_row, history_path, load_history, row_key)
+
+#: history rows consulted for the baseline (most recent first)
+BASELINE_WINDOW = 8
+
+# the child: factor a small poisson2d, serve REQUESTS single-ticket
+# submits per nrhs size through a SolveServer, report the accounter's
+# p99 per size as one JSON line
+CHILD = r"""
+import json, os
+import numpy as np
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.obs import slo
+from superlu_dist_tpu.serve.server import SolveServer
+
+sizes = [int(s) for s in os.environ["_SLO_GATE_NRHS"].split(",")]
+n_req = int(os.environ["_SLO_GATE_REQUESTS"])
+a = poisson2d(10)
+n = a.n_rows
+_, lu, _, info = slu.gssvx(slu.Options(), a, np.ones(n))
+assert info == 0, info
+rng = np.random.default_rng(0)
+acct = slo.get_accounter()
+out = {}
+with SolveServer(lu, max_wait_s=0.0) as srv:
+    for k in sizes:
+        b = rng.standard_normal((n, k))
+        b = b[:, 0] if k == 1 else b
+        srv.submit(b)           # warm (compile) ticket
+        srv.flush()
+        # window the p99 on the histogram DELTA around the measured
+        # loop: the warm ticket's compile-dominated latency lands in
+        # the always-on accounter too, and must not be the p99
+        skey = "serve|%d" % slo.nrhs_bucket(k)
+        pre = acct.snapshot().get(skey)
+        for _ in range(n_req):
+            t = srv.submit(b)
+            srv.flush()
+            x = np.asarray(t.result(60.0))
+            assert np.isfinite(x).all()
+        post = acct.snapshot()[skey]
+        if pre is None:
+            win = [post["count"], 0.0, post["buckets"]]
+        else:
+            win = [post["count"] - pre["count"], 0.0,
+                   [c - p for c, p in zip(post["buckets"],
+                                          pre["buckets"])]]
+        out[str(k)] = slo.LatencyAccounter._quantile_from(win, 0.99)
+print(json.dumps(out))
+"""
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    raise SystemExit(1)
+
+
+def run_serve_child(sizes: str, n_req: int) -> dict:
+    """One serve run pinned to the CPU backend with telemetry knobs
+    cleared (the gate measures the DISABLED-path latency the fleet
+    ships with by default)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               _SLO_GATE_NRHS=sizes, _SLO_GATE_REQUESTS=str(n_req))
+    for k in ("SLU_TPU_TRACE", "SLU_TPU_METRICS", "SLU_TPU_FLIGHTREC",
+              "SLU_TPU_SLO_P99_MS", "SLU_TPU_SLO_TARGETS"):
+        env.pop(k, None)
+    r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
+                       stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+    if r.returncode != 0:
+        sys.stderr.write(r.stderr.decode())
+        fail(f"serve child failed (rc={r.returncode})")
+    lines = [ln for ln in r.stdout.decode().strip().splitlines()
+             if ln.strip()]
+    if not lines:
+        fail("serve child produced no measurement line")
+    return json.loads(lines[-1])
+
+
+def main(argv) -> int:
+    row_file = None
+    hist_path = None
+    it = iter(argv)
+    for a in it:
+        if a == "--row":
+            row_file = next(it, None)
+        elif a == "--history":
+            hist_path = next(it, None)
+        else:
+            print(__doc__, file=sys.stderr)
+            return 2
+    hist_path = hist_path or history_path()
+    tol = env_float("SLO_GATE_TOL")
+    min_samples = env_int("SLO_GATE_MIN_SAMPLES")
+    sizes = env_str("SLO_GATE_NRHS").strip()
+
+    if row_file:
+        text = (sys.stdin.read() if row_file == "-"
+                else open(row_file).read())
+        lines = [ln for ln in text.strip().splitlines() if ln.strip()]
+        measured = json.loads(lines[-1])
+    else:
+        measured = run_serve_child(sizes, env_int("SLO_GATE_REQUESTS"))
+
+    history = load_history(hist_path)
+    bad = []
+    for k, p99 in sorted(measured.items(), key=lambda kv: int(kv[0])):
+        if p99 is None:
+            fail(f"nrhs={k}: no p99 measurement (accounter empty)")
+        row = {"metric": f"serve_p99_ms_nrhs{k}", "backend": "cpu",
+               "value": round(float(p99), 4)}
+        key = row_key(row)
+        prior = [h for h in history
+                 if h.get("history_key", row_key(h)) == key
+                 and h.get("value") is not None
+                 and not h.get("gate_fail")]
+        if len(prior) < min_samples:
+            append_row(row, hist_path)
+            print(f"slo gate: SEEDED nrhs={k} ({len(prior)} -> "
+                  f"{len(prior) + 1} rows; enforcement starts at "
+                  f"{min_samples}) — p99 {p99:.3f} ms")
+            continue
+        window = prior[-BASELINE_WINDOW:]
+        base = statistics.median(float(h["value"]) for h in window)
+        ceiling = (1.0 + tol) * base
+        ok = float(p99) <= ceiling
+        append_row(row, hist_path, gate_fail=not ok)
+        verdict = "OK" if ok else "REGRESSION"
+        print(f"slo gate: {verdict} nrhs={k} p99 {p99:.3f} ms vs median "
+              f"{base:.3f} over {len(window)} rows (ceiling "
+              f"{ceiling:.3f}, tol {tol:.0%})")
+        if not ok:
+            bad.append(k)
+    if bad:
+        print(f"FAIL: serve p99 latency regressed past the noise "
+              f"ceiling for nrhs {', '.join(bad)}; inspect "
+              f"'{sys.executable} scripts/bench_history.py list "
+              "serve_p99' and recent serve-path changes",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -72,12 +72,39 @@ print(json.dumps(out))
 """
 
 
-def run_child(extra_env):
+# serve-path child: two submits through a SolveServer with ALL obs
+# knobs unset — both tickets must carry the shared NULL_TICKET
+# singleton (zero TicketContext allocations per submit)
+SERVE_CHILD = r"""
+import json
+import numpy as np
+import superlu_dist_tpu as slu
+from superlu_dist_tpu.models.gallery import poisson2d
+from superlu_dist_tpu.obs import slo
+from superlu_dist_tpu.serve.server import SolveServer
+
+a = poisson2d(10)
+_, lu, _, info = slu.gssvx(slu.Options(), a, np.ones(a.n_rows))
+assert info == 0, info
+with SolveServer(lu, max_wait_s=0.0) as srv:
+    t1 = srv.submit(np.ones(a.n_rows))
+    t2 = srv.submit(np.ones(a.n_rows))
+    srv.flush()
+    x1, x2 = t1.result(30.0), t2.result(30.0)
+assert np.isfinite(x1).all() and np.isfinite(x2).all()
+print(json.dumps({
+    "ctx_null": t1._req.ctx is t2._req.ctx is slo.NULL_TICKET,
+    "ctx_type": type(t1._req.ctx).__name__,
+}))
+"""
+
+
+def run_child(extra_env, src=CHILD):
     env = dict(os.environ, JAX_PLATFORMS="cpu", **extra_env)
     for k in ("SLU_TPU_TRACE", "SLU_TPU_METRICS", "SLU_TPU_FLIGHTREC"):
         env.pop(k, None)
     env.update(extra_env)
-    r = subprocess.run([sys.executable, "-c", CHILD], env=env, cwd=REPO,
+    r = subprocess.run([sys.executable, "-c", src], env=env, cwd=REPO,
                        stdout=subprocess.PIPE, stderr=subprocess.PIPE)
     if r.returncode != 0:
         sys.stderr.write(r.stderr.decode())
@@ -113,6 +140,13 @@ def main():
         fail("disabled path allocated a flight-recorder ring")
     print(f"off: null tracer/metrics/flightrec, no artifact, "
           f"FACT {off['fact_seconds']:.3f}s")
+
+    # ---- off path, serve tier: submits must not allocate a ticket
+    # context — both tickets carry the one NULL_TICKET singleton
+    serve_off = run_child({}, src=SERVE_CHILD)
+    if not serve_off["ctx_null"]:
+        fail(f"disabled serve path allocated a TicketContext: {serve_off}")
+    print("off (serve): submits carry the shared NULL_TICKET singleton")
 
     # ---- on path: artifact exists and is well-formed ---------------------
     on = run_child({"SLU_TPU_TRACE": trace_path})

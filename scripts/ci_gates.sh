@@ -24,6 +24,11 @@
 #   perf-regress    scripts/check_perf_regress.py     micro-bench factor
 #                   GFLOP/s vs the bench-history median (noise-tolerant,
 #                   self-seeding on an empty history)
+#   slo-gate        scripts/check_slo.py              serve-path p99
+#                   latency per nrhs size (real SolveServer, always-on
+#                   obs/slo accounter) vs the bench-history median —
+#                   LOWER-is-better, noise-tolerant, self-seeding on an
+#                   empty history
 #   crash-resume    scripts/check_crash_resume.py     kill -9 a
 #                   factorization mid-run, resume from the durable
 #                   checkpoint frontier, assert bitwise-identical L/U
@@ -112,6 +117,7 @@ declare -A GATES=(
   [solve-equiv]="python scripts/check_solve_equiv.py"
   [serve-robust]="python scripts/check_serve_robust.py"
   [perf-regress]="python scripts/check_perf_regress.py"
+  [slo-gate]="python scripts/check_slo.py"
   [crash-resume]="python scripts/check_crash_resume.py"
   [rank-failure]="python scripts/check_rank_failure.py"
   [compile-budget]="python scripts/compile_census.py --buckets 16 32 48 --stage"
@@ -124,7 +130,7 @@ declare -A GATES=(
 ORDER=(slulint program-audit verify-overhead schedule-equiv solve-equiv
        precision-safety serve-robust fleet-failover refactor-consistency
        crash-resume rank-failure compile-budget tsan-native
-       trace-overhead nan-guards perf-regress)
+       trace-overhead nan-guards perf-regress slo-gate)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

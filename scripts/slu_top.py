@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""slu_top — a live console over a metrics export snapshot.
+
+Point ``SLU_TPU_METRICS`` at a ``.json`` path in the serving process
+(the registry dumps there at the fleet's observability heartbeat and at
+exit), then watch it here::
+
+    SLU_TPU_METRICS=/tmp/slu-metrics.json python serve_something.py &
+    python scripts/slu_top.py /tmp/slu-metrics.json
+
+Renders, top-like, once per ``--interval`` seconds (or a single frame
+with ``--once``):
+
+* traffic — requests / delivered columns / shed / deadline misses,
+  fleet reroutes + failovers + healthy-replica count;
+* serving — queue depth, batch fill, queue-wait and request-latency
+  histogram means;
+* latency — the always-on accounter's p50/p95/p99 gauges per (traffic
+  class, nrhs bucket) (``slu_latency_*_ms``, obs/slo.py);
+* SLO — per-series burn rate and ok/violating state
+  (``slu_slo_burn_rate`` / ``slu_slo_ok``, armed by
+  ``SLU_TPU_SLO_P99_MS`` / ``SLU_TPU_SLO_TARGETS``).
+
+Reads ONE file; no sockets, no dependencies — the reader side of the
+atomic temp+rename contract ``obs/metrics._dump`` maintains, so a frame
+is never torn.  Exit 0 on ctrl-C.
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+import time
+
+_LABELS = re.compile(r'([\w.]+)="([^"]*)"')
+
+
+def parse_key(key: str):
+    """``name{k="v",...}`` -> (name, {labels})."""
+    m = re.match(r"^([^{]+)\{(.*)\}$", key)
+    if not m:
+        return key, {}
+    return m.group(1), dict(_LABELS.findall(m.group(2)))
+
+
+def pick(table: dict, name: str):
+    """All (labels, value) rows of one metric name."""
+    out = []
+    for key, val in table.items():
+        n, labels = parse_key(key)
+        if n == name:
+            out.append((labels, val))
+    return out
+
+
+def one(table: dict, name: str, default=0.0):
+    rows = pick(table, name)
+    return rows[0][1] if rows else default
+
+
+def hist_mean(hists: dict, name: str):
+    for key, h in hists.items():
+        n, _ = parse_key(key)
+        if n == name and h.get("count"):
+            return h["sum"] / h["count"], h["count"]
+    return None, 0
+
+
+def render(snap: dict, path: str) -> str:
+    c = snap.get("counters", {})
+    g = snap.get("gauges", {})
+    h = snap.get("histograms", {})
+    lines = [f"slu_top — {path} — {time.strftime('%H:%M:%S')}"]
+
+    served = one(c, "slu_serve_requests_total") \
+        + one(c, "slu_fleet_requests_total")
+    lines.append(
+        "traffic   requests {:>10.0f}   shed {:>7.0f}   deadline miss "
+        "{:>6.0f}".format(served, one(c, "slu_serve_shed_total"),
+                          one(c, "slu_serve_deadline_miss_total")))
+    if pick(c, "slu_fleet_requests_total") or \
+            pick(g, "slu_fleet_replicas_healthy"):
+        lines.append(
+            "fleet     healthy  {:>10.0f}   reroutes {:>3.0f}   "
+            "failovers {:>9.0f}".format(
+                one(g, "slu_fleet_replicas_healthy"),
+                one(c, "slu_fleet_reroutes_total"),
+                one(c, "slu_fleet_failovers_total")))
+
+    depth = one(g, "slu_serve_queue_depth")
+    fill_mean, _ = hist_mean(h, "slu_serve_batch_fill")
+    wait_mean, _ = hist_mean(h, "slu_serve_queue_wait_seconds")
+    req_mean, req_n = hist_mean(h, "slu_serve_request_seconds")
+    lines.append(
+        "serving   queue depth {:>7.0f}   batch fill {:>6s}   "
+        "queue wait {:>9s}".format(
+            depth,
+            f"{fill_mean:.2f}" if fill_mean is not None else "-",
+            f"{wait_mean * 1e3:.2f} ms" if wait_mean is not None else "-"))
+    if req_mean is not None:
+        lines.append(f"          request mean {req_mean * 1e3:.3f} ms "
+                     f"over {req_n} requests")
+
+    lat = {}
+    for q in ("p50", "p95", "p99"):
+        for labels, val in pick(g, f"slu_latency_{q}_ms"):
+            key = (labels.get("class", "?"), int(labels.get("nrhs", 0)))
+            lat.setdefault(key, {})[q] = val
+    for labels, val in pick(g, "slu_latency_requests_total"):
+        key = (labels.get("class", "?"), int(labels.get("nrhs", 0)))
+        lat.setdefault(key, {})["n"] = val
+    if lat:
+        lines.append("latency   class    nrhs>=      n      p50 ms   "
+                     "p95 ms   p99 ms")
+        for (klass, nb), s in sorted(lat.items()):
+            lines.append(
+                "          {:<8s} {:<6d} {:>6.0f}   {:>8s} {:>8s} "
+                "{:>8s}".format(
+                    klass, nb, s.get("n", 0),
+                    *(f"{s[q]:.3f}" if q in s else "-"
+                      for q in ("p50", "p95", "p99"))))
+
+    burn = {}
+    for labels, val in pick(g, "slu_slo_burn_rate"):
+        key = (labels.get("class", "?"), labels.get("nrhs", "?"))
+        burn[key] = [val, None]
+    for labels, val in pick(g, "slu_slo_ok"):
+        key = (labels.get("class", "?"), labels.get("nrhs", "?"))
+        burn.setdefault(key, [None, None])[1] = val
+    if burn:
+        lines.append("slo       class    nrhs>=   burn     state")
+        for (klass, nb), (b, ok) in sorted(burn.items()):
+            state = ("-" if ok is None
+                     else ("ok" if ok else "VIOLATING"))
+            lines.append(
+                "          {:<8s} {:<8s} {:>6s}   {}".format(
+                    klass, str(nb),
+                    f"{b:.2f}" if b is not None else "-", state))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="live console over a SLU_TPU_METRICS json export")
+    ap.add_argument("path", help="metrics export file "
+                                 "(SLU_TPU_METRICS=<path>.json)")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in seconds (default 2)")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit")
+    args = ap.parse_args(argv)
+    while True:
+        try:
+            with open(args.path) as f:
+                snap = json.load(f)
+        except FileNotFoundError:
+            frame = (f"slu_top — waiting for {args.path} "
+                     "(SLU_TPU_METRICS not exporting yet?)")
+        except json.JSONDecodeError:
+            time.sleep(0.05)    # mid-rename; the next read is whole
+            continue
+        else:
+            frame = render(snap, args.path)
+        if args.once:
+            print(frame)
+            return 0
+        os.system("clear" if os.name != "nt" else "cls")
+        print(frame)
+        try:
+            time.sleep(max(args.interval, 0.1))
+        except KeyboardInterrupt:
+            return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Merge per-process Chrome trace artifacts onto one wall clock.
+
+Every tracer artifact (``SLU_TPU_TRACE=trace-%p.json`` — one file per
+process) stamps its spans in microseconds relative to its OWN
+``perf_counter`` epoch and records one ``clock-anchor`` event carrying
+the epoch's absolute wall time (``args.unix_time``).  This script joins
+N such artifacts on those anchors: the earliest anchor becomes the
+merged timeline's zero, every other artifact's events are shifted by
+its anchor's offset from that zero, and the result is ONE Chrome/
+Perfetto JSON in which a router-side ``fleet-request`` span and its
+replica-side ``request`` stage spans line up on the same axis — follow
+the shared ``trace_id`` arg across the process tracks.
+
+Usage::
+
+    python scripts/trace_merge.py -o merged.json trace-123.json trace-456.json
+
+Sub-millisecond alignment only (the anchors are wall-clock reads, not a
+clock-sync protocol) — good enough to eyeball a ticket's journey, not
+to time a single kernel across hosts.
+
+Exit 0 on success; non-zero when an input is unreadable or carries no
+clock anchor.
+"""
+
+import argparse
+import json
+import sys
+
+
+def load_events(path: str) -> tuple[list, float]:
+    """The artifact's events plus its anchor's absolute wall time."""
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    if not isinstance(events, list) or not events:
+        raise SystemExit(f"{path}: no traceEvents")
+    anchors = [e for e in events if e.get("name") == "clock-anchor"]
+    if not anchors:
+        raise SystemExit(f"{path}: no clock-anchor event (artifact too "
+                         "old, or not a superlu_dist_tpu trace)")
+    a = anchors[0]
+    try:
+        unix0 = float(a["args"]["unix_time"]) - float(a["ts"]) / 1e6
+    except (KeyError, TypeError, ValueError):
+        raise SystemExit(f"{path}: malformed clock-anchor {a!r}")
+    return events, unix0
+
+
+def merge(paths: list) -> dict:
+    loaded = [(p, *load_events(p)) for p in paths]
+    base = min(unix0 for _p, _ev, unix0 in loaded)
+    out = []
+    for path, events, unix0 in loaded:
+        shift_us = (unix0 - base) * 1e6
+        for ev in events:
+            ev = dict(ev)
+            ev["ts"] = round(float(ev["ts"]) + shift_us, 3)
+            out.append(ev)
+    out.sort(key=lambda e: (e.get("pid", 0), e["ts"], -e.get("dur", 0)))
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {"tool": "superlu_dist_tpu.obs trace_merge",
+                      "sources": [p for p, _e, _u in loaded],
+                      "base_unix_time": round(base, 6),
+                      "spans": len(out)},
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="merge per-process trace artifacts on their "
+                    "clock anchors")
+    ap.add_argument("inputs", nargs="+", help="tracer JSON artifacts")
+    ap.add_argument("-o", "--output", required=True,
+                    help="merged Chrome trace JSON path")
+    args = ap.parse_args(argv)
+    doc = merge(args.inputs)
+    with open(args.output, "w") as f:
+        json.dump(doc, f)
+    pids = {e.get("pid") for e in doc["traceEvents"]}
+    print(f"merged {len(args.inputs)} artifacts -> {args.output} "
+          f"({doc['otherData']['spans']} spans, {len(pids)} process "
+          "tracks)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

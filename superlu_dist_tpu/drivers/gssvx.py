@@ -22,6 +22,7 @@ A·x = b is solved as
 from __future__ import annotations
 
 import dataclasses
+import time
 
 import numpy as np
 
@@ -1046,6 +1047,7 @@ def _escalate(options: Options, a: SparseCSR, op, b: np.ndarray,
 
 def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
                       lu: LUFactorization, stats: Stats):
+    t_req0 = time.perf_counter()
     n = a.n_rows
     # trans dispatch (reference trans_t, superlu_defs.h:628-657): TRANS and
     # CONJ solve AᵀX=B / AᴴX=B through the same factors; refinement then
@@ -1201,6 +1203,13 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
     report.finite = bool(np.all(np.isfinite(np.asarray(x))))
     if not report.finite and recovery.sentinels:
         raise NumericBreakdownError(where="solve")
+    # end-to-end driver latency (SOLVE + refine + ladder + condest):
+    # the "driver" series of the always-on latency accounter, so batch
+    # users get the same quantile surface the serving fleet does
+    lat = time.perf_counter() - t_req0
+    report.latency_ms = round(lat * 1e3, 3)
+    from superlu_dist_tpu.obs.slo import get_accounter
+    get_accounter().observe(nrhs, lat, klass="driver")
     if options.print_stat:
         stats.print()
     return x, lu_final, stats, info

@@ -494,6 +494,11 @@ class StreamExecutor:
         # spans must sum to the FACT wall time); the flight recorder
         # alone does NOT (tracer.profiling False) — its ring must not
         # serialize the async dispatch stream
+        from superlu_dist_tpu.utils.options import deprecated_knob_warning
+        deprecated_knob_warning(
+            "SLU_TPU_PROFILE",
+            "set SLU_TPU_TRACE=trace.json instead — the tracer's "
+            "kernel spans carry the same per-kernel timings")
         profile = env_flag("SLU_TPU_PROFILE") or tracer.profiling
         if profile:
             self.last_profile = []

@@ -331,11 +331,21 @@ def _reset():
 
 def on_error(exc) -> str | None:
     """Structured-error hook (called from utils/errors.py constructors):
-    dump the postmortem when the recorder is live.  Never raises."""
+    dump the postmortem when the recorder is live.  A ticket-scoped
+    error carrying ``ticket_stages`` (the TicketContext per-stage
+    timings, obs/slo.py) gets them attached under ``extra`` — the dump
+    names the stage that ate the budget.  Never raises."""
     try:
         fr = get_flightrec()
         if not fr.enabled:
             return None
-        return fr.dump(type(exc).__name__, detail=str(exc))
+        extra = None
+        stages = getattr(exc, "ticket_stages", None)
+        if stages:
+            extra = {"ticket_stages": dict(stages)}
+            trace_id = getattr(exc, "trace_id", None)
+            if trace_id:
+                extra["trace_id"] = trace_id
+        return fr.dump(type(exc).__name__, detail=str(exc), extra=extra)
     except Exception:
         return None

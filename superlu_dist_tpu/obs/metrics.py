@@ -65,6 +65,12 @@ class NullMetrics:
     def snapshot(self):
         return {}
 
+    def merge_snapshot(self, snap, base=None):
+        pass
+
+    def dump_now(self):
+        return False
+
     def to_json(self):
         return "{}"
 
@@ -80,6 +86,17 @@ NULL_METRICS = NullMetrics()
 
 def _series(name: str, labels: dict) -> tuple:
     return (name, tuple(sorted((str(k), str(v)) for k, v in labels.items())))
+
+
+def _parse_fmt(key: str) -> tuple:
+    """Invert ``_fmt``: ``name{k="v",...}`` back to the series tuple —
+    the snapshot-merge path (a snapshot's keys are _fmt strings)."""
+    import re
+    m = re.match(r'^([^{]+)\{(.*)\}$', key)
+    if m is None:
+        return (key, ())
+    labels = tuple(re.findall(r'([\w.]+)="([^"]*)"', m.group(2)))
+    return (m.group(1), labels)
 
 
 def _fmt(series: tuple) -> str:
@@ -102,6 +119,18 @@ class Metrics:
         self._gauges: dict[tuple, float] = {}
         # histogram: [count, sum, min, max, per-bucket counts]
         self._hists: dict[tuple, list] = {}
+        # armed by get_metrics() when SLU_TPU_METRICS names a path —
+        # dump_now() refreshes the export mid-run (slu_top's feed)
+        self.export_path: str | None = None
+
+    def dump_now(self) -> bool:
+        """Refresh the on-disk export immediately (atomic temp+rename,
+        same artifact the atexit dump writes).  True when a path is
+        armed; no-op False otherwise."""
+        if not self.export_path:
+            return False
+        _dump(self, self.export_path)
+        return True
 
     # ---- producers -----------------------------------------------------
     def inc(self, name, value=1.0, **labels):
@@ -149,6 +178,51 @@ class Metrics:
                               "buckets": list(h[4])}
                     for k, h in self._hists.items()},
             }
+
+    def merge_snapshot(self, snap: dict, base: dict | None = None):
+        """Fold another registry's ``snapshot()`` into this one —
+        the fleet-wide aggregation path (a process replica's child
+        registry dies with the process; the router absorbs its
+        snapshots at heartbeat/teardown so ``to_prometheus()`` covers
+        the whole fleet).
+
+        ``base`` is the previously absorbed snapshot from the SAME
+        source: counters and histogram counts/sums/buckets merge as the
+        DELTA vs base (so repeated heartbeat absorption never double
+        counts), gauges and min/max merge absolutely (last/extreme
+        writer wins)."""
+        if not snap:
+            return
+        base = base or {}
+        bc = base.get("counters", {})
+        bh = base.get("histograms", {})
+        with self._lock:
+            for key, v in snap.get("counters", {}).items():
+                d = float(v) - float(bc.get(key, 0.0))
+                if d:
+                    sk = _parse_fmt(key)
+                    self._counters[sk] = self._counters.get(sk, 0.0) + d
+            for key, v in snap.get("gauges", {}).items():
+                self._gauges[_parse_fmt(key)] = float(v)
+            for key, sh in snap.get("histograms", {}).items():
+                prev = bh.get(key) or {"count": 0, "sum": 0.0,
+                                       "buckets": [0] * (len(sh["buckets"]))}
+                sk = _parse_fmt(key)
+                h = self._hists.get(sk)
+                if h is None:
+                    h = self._hists[sk] = [0, 0.0, float("inf"),
+                                           float("-inf"),
+                                           [0] * (len(HIST_BUCKETS) + 1)]
+                h[0] += int(sh["count"]) - int(prev["count"])
+                h[1] += float(sh["sum"]) - float(prev["sum"])
+                if sh.get("min") is not None:
+                    h[2] = min(h[2], float(sh["min"]))
+                if sh.get("max") is not None:
+                    h[3] = max(h[3], float(sh["max"]))
+                for i in range(min(len(sh["buckets"]), len(h[4]))):
+                    h[4][i] += int(sh["buckets"][i]) - int(
+                        prev["buckets"][i] if i < len(prev["buckets"])
+                        else 0)
 
     def to_json(self) -> str:
         return json.dumps(self.snapshot(), sort_keys=True)
@@ -270,6 +344,7 @@ def get_metrics():
                 else:
                     _metrics = Metrics()
                     if _looks_like_path(raw):
+                        _metrics.export_path = raw
                         atexit.register(_dump, _metrics, raw)
             m = _metrics
     return m

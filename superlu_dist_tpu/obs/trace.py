@@ -46,9 +46,14 @@ import time
 #: (parallel/treecomm.LockstepVerifier) and unexpected-recompile events
 #: (numeric/stream.RetraceSentinel).  "compile" spans come from the
 #: compile census (obs/compilestats.py): one per jit build, tagged with
-#: the shape-key bucket and persistent-cache hit/miss.
+#: the shape-key bucket and persistent-cache hit/miss.  "request" spans
+#: come from the serving tier's TicketContext (obs/slo.py, emitted by
+#: serve/server.py and serve/fleet.py): one enclosing span per ticket
+#: with nested per-stage children (queue_wait / coalesce / dispatch /
+#: device / refine / deliver), all tagged with the ticket's trace_id so
+#: scripts/trace_merge.py can join a ticket across processes.
 CATEGORIES = ("phase", "dispatch", "kernel", "comm", "host-offload",
-              "verify", "compile")
+              "verify", "compile", "request")
 
 
 class _NullSpan:

@@ -86,16 +86,25 @@ def refine_ticket(a: SparseCSR, b: np.ndarray, x: np.ndarray, solve_fn,
     when it strictly improved the worst column's berr; otherwise the
     original x comes back unchanged (``adopted=False``) so a
     non-converging refinement can never make a served answer worse."""
-    berr_before = float(request_berrs(a, b, x,
-                                      residual_dtype=residual_dtype).max())
-    if berr_before <= berr_target:
-        return x, berr_before, berr_before, False
-    x_ref, _hist = iterative_refinement(a, b, x, solve_fn, itmax=itmax,
-                                        residual_dtype=residual_dtype)
-    x_ref = np.asarray(x_ref).astype(np.asarray(x).dtype, copy=False)
-    berr_after = float(request_berrs(a, b, x_ref,
-                                     residual_dtype=residual_dtype).max())
-    if berr_after < berr_before:
+    from superlu_dist_tpu.obs.trace import get_tracer
+    with get_tracer().span("refine-ticket", cat="request",
+                           berr_target=berr_target) as sp:
+        berr_before = float(
+            request_berrs(a, b, x, residual_dtype=residual_dtype).max())
+        if berr_before <= berr_target:
+            sp.set(berr_before=berr_before, adopted=False)
+            return x, berr_before, berr_before, False
+        x_ref, _hist = iterative_refinement(
+            a, b, x, solve_fn, itmax=itmax,
+            residual_dtype=residual_dtype)
+        x_ref = np.asarray(x_ref).astype(np.asarray(x).dtype, copy=False)
+        berr_after = float(
+            request_berrs(a, b, x_ref,
+                          residual_dtype=residual_dtype).max())
+        adopted = berr_after < berr_before
+        sp.set(berr_before=berr_before, berr_after=berr_after,
+               adopted=adopted, iters=len(_hist))
+    if adopted:
         return x_ref, berr_before, berr_after, True
     return x, berr_before, berr_before, False
 

@@ -76,6 +76,10 @@ import time
 import numpy as np
 
 from superlu_dist_tpu.obs.metrics import get_metrics
+from superlu_dist_tpu.obs.slo import (NULL_TICKET, SLOEvaluator,
+                                      TicketContext, get_accounter,
+                                      parent_ref)
+from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.parallel.treecomm import pid_alive
 from superlu_dist_tpu.serve.handlecache import HandleCache
 from superlu_dist_tpu.utils.errors import (
@@ -111,7 +115,7 @@ class _TicketRec:
 
     __slots__ = ("token", "key", "b", "k", "squeeze", "t_submit",
                  "deadline_s", "t_deadline", "event", "error", "x",
-                 "replica", "tried", "attempts")
+                 "replica", "tried", "attempts", "ctx", "t_routed")
 
     def __init__(self, token: int, key, b: np.ndarray, squeeze: bool):
         self.token = token
@@ -119,7 +123,9 @@ class _TicketRec:
         self.b = b
         self.k = b.shape[1]
         self.squeeze = squeeze
+        self.ctx = NULL_TICKET   # TicketContext when tracing is on
         self.t_submit = time.perf_counter()
+        self.t_routed = self.t_submit   # last route/reroute stage edge
         self.deadline_s = 0.0
         self.t_deadline = None
         self.event = threading.Event()
@@ -339,7 +345,10 @@ class ThreadReplica:
                 return False
         try:
             srv = self._cache.get(rec.key)
-            t = srv.submit(rec.b)
+            # same-process replica: the router-minted context IS the
+            # parent, so the server's stage spans share its trace id
+            t = srv.submit(rec.b,
+                           parent=rec.ctx if rec.ctx.enabled else None)
             srv.flush()
             x = None
             while x is None:
@@ -415,9 +424,24 @@ def _replica_child_main(conn, rid: int, paths: dict, server_kw: dict,
                     conn.send(("cmd", seq, False,
                                f"{type(e).__name__}: {e}"))
                 continue
+            if tag == "metrics_pull":
+                _, seq = msg
+                try:
+                    from superlu_dist_tpu.obs.metrics import get_metrics
+                    m = get_metrics()
+                    conn.send(("cmd", seq, True,
+                               m.snapshot() if m.enabled else None))
+                except Exception as e:      # noqa: BLE001 — travels back
+                    conn.send(("cmd", seq, False,
+                               f"{type(e).__name__}: {e}"))
+                continue
             if tag != "submit":
                 continue
-            _, token, key, b = msg
+            # 5-element frame carries the router-side trace id; the
+            # 4-element form is accepted for wire compat (a parent one
+            # commit ahead of a child, or vice versa)
+            _, token, key, b = msg[:4]
+            tid = msg[4] if len(msg) > 4 else ""
             if chaos is not None:
                 stall = chaos.replica_stall_s(rid)
                 if stall > 0:
@@ -429,7 +453,10 @@ def _replica_child_main(conn, rid: int, paths: dict, server_kw: dict,
                 if chaos.replica_kill_due(rid, batches):
                     os.kill(os.getpid(), signal.SIGKILL)
             try:
-                x = np.asarray(cache.get(key).solve(b, 300.0))
+                srv = cache.get(key)
+                t = srv.submit(b, parent=parent_ref(tid))
+                srv.flush()
+                x = np.asarray(t.result(300.0))
                 batches += 1
                 conn.send(("ok", token, x))
             except (FactorCorruptError, CheckpointError) as e:
@@ -440,6 +467,16 @@ def _replica_child_main(conn, rid: int, paths: dict, server_kw: dict,
             except Exception as e:          # noqa: BLE001 — per-ticket
                 conn.send(("err", token, type(e).__name__, str(e)))
     finally:
+        try:
+            # final metrics push: the parent absorbs whatever this
+            # replica counted, even across a graceful close (a kill -9
+            # forfeits it — the delta-merge makes that loss bounded)
+            from superlu_dist_tpu.obs.metrics import get_metrics
+            m = get_metrics()
+            if m.enabled:
+                conn.send(("metrics", m.snapshot()))
+        except Exception:                   # noqa: BLE001 — teardown
+            pass
         try:
             cache.close()
         except Exception:                   # noqa: BLE001 — teardown
@@ -499,7 +536,8 @@ class ProcessReplica:
             if self._closed or self._dead or self._quarantined:
                 return False
             self._keys_routed.add(rec.key)
-        return self._send(("submit", rec.token, rec.key, rec.b))
+        return self._send(("submit", rec.token, rec.key, rec.b,
+                           rec.ctx.trace_id))
 
     def register(self, key, path: str) -> None:
         self._send(("register", key, path))
@@ -528,6 +566,15 @@ class ProcessReplica:
 
     def deploy(self, key, path: str) -> bool:
         return bool(self._run_cmd(("deploy", key, path)))
+
+    def poll_metrics(self, timeout: float = 5.0):
+        """Pull the child's metrics snapshot over the command channel
+        and fold the delta into the router registry (the process-
+        replica aggregation satellite).  Returns the raw snapshot."""
+        snap = self._run_cmd(("metrics_pull",), timeout=timeout)
+        if snap:
+            self._router._absorb_replica_metrics(self.rid, snap)
+        return snap
 
     def canary(self, key, b: np.ndarray) -> np.ndarray:
         return np.asarray(self._run_cmd(("canary", key, b)))
@@ -606,6 +653,8 @@ class ProcessReplica:
                     self._quarantined = True
                 if not already:
                     self._router._replica_unroutable(self.rid, msg[2])
+            elif tag == "metrics":
+                self._router._absorb_replica_metrics(self.rid, msg[1])
             elif tag == "cmd":
                 _, seq, ok, val = msg
                 with self._lock:
@@ -717,6 +766,14 @@ class FleetRouter:
         self._rollbacks = 0
         m = get_metrics()
         self._metrics = m if m.enabled else None
+        # latched once (the NULL_TRACER discipline): submit mints a
+        # TicketContext only when tracing is on
+        t = get_tracer()
+        self._tracer = t if t.enabled else None
+        self._accounter = get_accounter()    # always-on latency floor
+        self._slo = SLOEvaluator()
+        self._slo_state: dict = {}
+        self._replica_snaps: dict = {}      # rid -> last absorbed snap
         bundles = dict(bundles or {})
         self._registry.update(
             {k: str(p) for k, p in bundles.items()})
@@ -793,6 +850,10 @@ class FleetRouter:
             self._seq += 1
             rec = _TicketRec(self._seq, key, b2, squeeze)
             rec.t_submit = t0
+            rec.t_routed = t0
+            if self._tracer is not None:
+                rec.ctx = TicketContext(f"f{rec.token}", t0)
+                rec.ctx.note(nrhs=k, key=str(key))
             if self.deadline_s > 0:
                 rec.deadline_s = self.deadline_s
                 rec.t_deadline = t0 + self.deadline_s
@@ -863,6 +924,14 @@ class FleetRouter:
                 self._deliver(rec, err=err, rid=rec.replica)
                 return
             if self._replicas[rid].submit(rec):
+                if rec.ctx.enabled:
+                    # stage edge: routing time since submit (or since
+                    # the previous route on a failover lap)
+                    tnow = time.perf_counter()
+                    rec.ctx.stage("reroute" if rerouted else "route",
+                                  rec.t_routed, tnow - rec.t_routed)
+                    rec.ctx.note(replica=rid)
+                    rec.t_routed = tnow
                 if rerouted:
                     with self._lock:
                         self._reroutes += 1
@@ -890,10 +959,20 @@ class FleetRouter:
                 self._delivered += 1
             rec.event.set()
             self._cond.notify_all()
+        t_end = time.perf_counter()
+        lat = t_end - rec.t_submit
+        # the always-on latency floor: one histogram increment per
+        # delivered (or errored) ticket, keyed by traffic class
+        self._accounter.observe(rec.k, lat, klass="fleet")
+        ctx = rec.ctx
+        if ctx.enabled:
+            ctx.stage("serve", rec.t_routed, t_end - rec.t_routed)
+            if err is not None:
+                ctx.note(error=type(err).__name__)
+            ctx.emit(self._tracer, t_end, name="fleet-request")
         m = self._metrics
         if m is not None:
-            m.observe("slu_fleet_route_seconds",
-                      time.perf_counter() - rec.t_submit)
+            m.observe("slu_fleet_route_seconds", lat)
         return True
 
     def _deliver_token(self, token: int, x=None, err=None,
@@ -916,6 +995,13 @@ class FleetRouter:
                 self._deadline_miss += 1
             if self._metrics is not None:
                 self._metrics.inc("slu_serve_deadline_miss_total", 1.0)
+            # _deliver recorded the final serve stage; attach the
+            # timings so the postmortem names the stage that ate the
+            # budget, then dump — outside every lock (SLU109)
+            if rec.ctx.enabled:
+                err.ticket_stages = rec.ctx.stages_ms() or None
+                err.trace_id = rec.ctx.trace_id
+            err.flight_postmortem()
             return True
         return False
 
@@ -994,6 +1080,50 @@ class FleetRouter:
                 for rec in due:
                     self._expire(rec, now)
             self._gauge_healthy()
+            self._heartbeat_obs()
+
+    def _heartbeat_obs(self) -> None:
+        """Observability heartbeat (piggybacks the health poll): pull
+        process-replica child metrics into the router registry, publish
+        the latency quantile gauges, evaluate the SLO burn rate, and
+        refresh the metrics export snapshot — so ``slu_top`` reading
+        the export file sees a live fleet, not an atexit one."""
+        m = self._metrics
+        if m is not None and self.kind == "process":
+            for r in self._replicas:
+                try:
+                    r.poll_metrics()
+                except Exception:           # noqa: BLE001 — best effort
+                    pass
+        if m is not None:
+            self._accounter.publish(m)
+        if self._slo.armed:
+            state = self._slo.evaluate(self._accounter)
+            with self._lock:
+                self._slo_state = state
+            if m is not None:
+                for key, s in state.items():
+                    klass, _, nb = key.partition("|")
+                    labels = {"class": klass, "nrhs": nb}
+                    m.set("slu_slo_burn_rate", float(s["burn"]),
+                          **labels)
+                    m.set("slu_slo_ok", 1.0 if s["ok"] else 0.0,
+                          **labels)
+        if m is not None:
+            m.dump_now()
+
+    def _absorb_replica_metrics(self, rid: int, snap: dict) -> None:
+        """Fold a process-replica child's metrics snapshot into the
+        router registry as a DELTA vs the last snapshot absorbed from
+        that replica — heartbeat pulls and the teardown push both land
+        here, so double counting is structurally impossible."""
+        m = self._metrics
+        if m is None or not snap:
+            return
+        with self._lock:
+            base = self._replica_snaps.get(rid)
+            self._replica_snaps[rid] = snap
+        m.merge_snapshot(snap, base=base)
 
     # ------------------------------------------------------------------
     def deploy(self, bundle_path: str, key=None,
@@ -1272,6 +1402,7 @@ class FleetRouter:
                 "keys": len(self._registry),
                 "closed": self._closed,
                 "draining": self._draining,
+                "slo": dict(self._slo_state),
             }
         st["replicas_healthy"] = sum(
             1 for r in self._replicas if r.routable())

@@ -32,6 +32,7 @@ from __future__ import annotations
 import collections
 
 from superlu_dist_tpu.obs.metrics import get_metrics
+from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.utils.errors import SuperLUError
 from superlu_dist_tpu.utils.lockwatch import make_condition, make_lock
 
@@ -156,12 +157,15 @@ class HandleCache:
         from superlu_dist_tpu.persist.serial import lu_meta
         from superlu_dist_tpu.serve.server import SolveServer
         nbytes = int(lu_meta(path).get("nbytes", 0))
-        self._evict_for(nbytes)
-        server = SolveServer.from_bundle(path, **self._server_kw)
-        # scrub-verified (re)load: the resident panel stacks must match
-        # the bundle manifest's sha256 ground truth BEFORE serving
-        # (raises FactorCorruptError and quarantines on mismatch)
-        server.scrub_now()
+        with get_tracer().span("handle-load", cat="request",
+                               key=str(key), nbytes=nbytes):
+            self._evict_for(nbytes)
+            server = SolveServer.from_bundle(path, **self._server_kw)
+            # scrub-verified (re)load: the resident panel stacks must
+            # match the bundle manifest's sha256 ground truth BEFORE
+            # serving (raises FactorCorruptError + quarantine on
+            # mismatch)
+            server.scrub_now()
         return server, nbytes
 
     def _evict_for(self, incoming: int) -> int:

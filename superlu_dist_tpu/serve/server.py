@@ -74,6 +74,7 @@ import time
 import numpy as np
 
 from superlu_dist_tpu.obs.metrics import get_metrics
+from superlu_dist_tpu.obs.slo import NULL_TICKET, TicketContext, get_accounter
 from superlu_dist_tpu.utils.lockwatch import make_condition, make_lock
 from superlu_dist_tpu.obs.trace import get_tracer
 from superlu_dist_tpu.solve.plan import bucket_nrhs
@@ -89,7 +90,7 @@ class _Request:
 
     __slots__ = ("b", "k", "squeeze", "remaining", "parts", "error",
                  "t_submit", "t_deadline", "deadline_s", "slow_client_s",
-                 "rungs", "event")
+                 "rungs", "event", "ctx")
 
     def __init__(self, b: np.ndarray, squeeze: bool):
         self.b = b
@@ -98,6 +99,7 @@ class _Request:
         self.remaining = self.k
         self.parts = []          # [(col offset, solved columns array)]
         self.error = None
+        self.ctx = NULL_TICKET   # TicketContext when tracing is on
         self.t_submit = time.perf_counter()
         self.t_deadline = None   # absolute perf_counter expiry, or None
         self.deadline_s = 0.0
@@ -294,6 +296,11 @@ class SolveServer:
         self._scrub_failures = 0
         self._metrics = m = get_metrics()
         self._metrics = m if m.enabled else None
+        # latched once (the NULL_TRACER discipline): None when tracing
+        # is off so submit pays one `is None` test and mints no context
+        t = get_tracer()
+        self._tracer = t if t.enabled else None
+        self._accounter = get_accounter()    # always-on latency floor
         from superlu_dist_tpu.testing.chaos import get_serve_chaos
         self._chaos = get_serve_chaos()
         if self.scrub_s > 0:
@@ -341,12 +348,19 @@ class SolveServer:
             self._thread.start()
         return self
 
-    def submit(self, b: np.ndarray) -> SolveTicket:
+    def submit(self, b: np.ndarray, parent=None) -> SolveTicket:
         """Enqueue one right-hand side — (n,) or (n, k), original
         labeling — and return its ticket immediately.  Admission control
         runs HERE: a closed server raises :class:`ServerClosedError`, a
         quarantined handle :class:`FactorCorruptError`, a draining or
-        over-capacity queue sheds with :class:`ServeOverloadError`."""
+        over-capacity queue sheds with :class:`ServeOverloadError`.
+
+        ``parent`` is an optional parent trace context (a router-minted
+        ``TicketContext`` or an ``obs.slo.parent_ref``): when tracing is
+        on, the request's ``request``-category span chain joins the
+        parent's trace id.  With all obs knobs unset and no parent, the
+        request carries the shared ``NULL_TICKET`` singleton — zero
+        per-submit allocation (enforced by check_trace_overhead.py)."""
         b = np.asarray(b)
         squeeze = b.ndim == 1
         b2 = b[:, None] if squeeze else b
@@ -356,47 +370,60 @@ class SolveServer:
                 "handle (need (n,) or (n, k>0))")
         k = b2.shape[1]
         m = self._metrics
-        with self._cond:
-            if self._closed:
-                raise ServerClosedError("SolveServer is closed")
-            if self._quarantine is not None:
-                q = self._quarantine
-                # dump=False: this re-raise of an already-reported
-                # quarantine performs NO postmortem I/O under the lock
-                raise FactorCorruptError(  # slulint: disable=SLU109
-                    q.groups, q.source, dump=False)
-            now = time.perf_counter()
-            self._expire_due_locked(now)
-            if self._draining:
-                self._shed += 1
-                if m is not None:
-                    m.inc("slu_serve_shed_total", 1.0, reason="draining")
-                raise ServeOverloadError(k, self._pending_cols,
-                                         self.queue_max,
-                                         reason="draining")
-            if self.queue_max > 0 and self._pending_cols + k > \
-                    self.queue_max:
-                self._shed += 1
-                if m is not None:
-                    m.inc("slu_serve_shed_total", 1.0,
-                          reason="queue_full")
-                raise ServeOverloadError(k, self._pending_cols,
-                                         self.queue_max)
-            if self._chaos is not None:
-                b2 = self._chaos.poison_submit(b2, self._columns)
-            req = _Request(b2, squeeze)
-            if self.deadline_s > 0:
-                req.deadline_s = self.deadline_s
-                req.t_deadline = req.t_submit + self.deadline_s
-            if self._chaos is not None and \
-                    self._chaos.is_slow_client(self._requests):
-                req.slow_client_s = self._chaos.plan.secs
-            self._queue.append([req, 0])
-            self._pending_cols += req.k
-            self._requests += 1
-            self._columns += req.k
-            depth = self._pending_cols
-            self._cond.notify_all()
+        expired = ()
+        try:
+            with self._cond:
+                if self._closed:
+                    raise ServerClosedError("SolveServer is closed")
+                if self._quarantine is not None:
+                    q = self._quarantine
+                    # dump=False: this re-raise of an already-reported
+                    # quarantine performs NO postmortem I/O under the lock
+                    raise FactorCorruptError(  # slulint: disable=SLU109
+                        q.groups, q.source, dump=False)
+                now = time.perf_counter()
+                expired = self._expire_due_locked(now)
+                if self._draining:
+                    self._shed += 1
+                    if m is not None:
+                        m.inc("slu_serve_shed_total", 1.0,
+                              reason="draining")
+                    raise ServeOverloadError(k, self._pending_cols,
+                                             self.queue_max,
+                                             reason="draining")
+                if self.queue_max > 0 and self._pending_cols + k > \
+                        self.queue_max:
+                    self._shed += 1
+                    if m is not None:
+                        m.inc("slu_serve_shed_total", 1.0,
+                              reason="queue_full")
+                    raise ServeOverloadError(k, self._pending_cols,
+                                             self.queue_max)
+                if self._chaos is not None:
+                    b2 = self._chaos.poison_submit(b2, self._columns)
+                req = _Request(b2, squeeze)
+                if self.deadline_s > 0:
+                    req.deadline_s = self.deadline_s
+                    req.t_deadline = req.t_submit + self.deadline_s
+                if self._chaos is not None and \
+                        self._chaos.is_slow_client(self._requests):
+                    req.slow_client_s = self._chaos.plan.secs
+                self._queue.append([req, 0])
+                self._pending_cols += req.k
+                self._requests += 1
+                self._columns += req.k
+                depth = self._pending_cols
+                if self._tracer is not None or (
+                        parent is not None
+                        and getattr(parent, "enabled", False)):
+                    req.ctx = TicketContext(f"s{self._requests}",
+                                            req.t_submit, parent)
+                    req.ctx.note(nrhs=req.k)
+                self._cond.notify_all()
+        finally:
+            # deadline postmortems (flight dump + span emit) run OUTSIDE
+            # the lock — the SLU109 hold discipline
+            self._deadline_postmortems(expired)
         if m is not None:
             m.inc("slu_serve_requests_total", 1.0)
             m.inc("slu_serve_columns_total", float(req.k))
@@ -714,6 +741,7 @@ class SolveServer:
         was delivered its ServeDeadlineError (or had already been
         delivered something); False when the request is in-flight in a
         batch — the result is imminent and wins."""
+        delivered = False
         with self._cond:
             if req.event.is_set():
                 return True
@@ -723,34 +751,69 @@ class SolveServer:
                     self._pending_cols -= req.k - entry[1]
                     self._fail_expired_locked(req, now)
                     self._cond.notify_all()
-                    return True
-            return False
+                    delivered = True
+                    break
+        if delivered:
+            self._deadline_postmortems([req])
+        return delivered
 
     def _fail_expired_locked(self, req: _Request, now: float) -> None:
+        ctx = req.ctx
+        if ctx.enabled:
+            # the whole budget went to the queue: one contiguous stage
+            ctx.stage("queue_wait", req.t_submit, now - req.t_submit)
+            ctx.note(deadline_s=req.deadline_s)
+        # constructed under the lock: ServeDeadlineError does NO
+        # postmortem I/O at construction — the caller invokes
+        # flight_postmortem() outside the lock (_deadline_postmortems)
         req.error = ServeDeadlineError(req.deadline_s,
-                                       now - req.t_submit, req.k)
+                                       now - req.t_submit, req.k,
+                                       stages=ctx.stages_ms() or None)
+        req.error.trace_id = ctx.trace_id
         req.event.set()
         self._deadline_miss += 1
+        self._accounter.observe(req.k, now - req.t_submit)
         if self._metrics is not None:
             self._metrics.inc("slu_serve_deadline_miss_total", 1.0)
 
-    def _expire_due_locked(self, now: float) -> None:
+    def _expire_due_locked(self, now: float) -> list:
         """Under the lock: expire every queued request whose serving
         deadline has passed — expired work never reaches a batch, so a
-        backlog of abandoned requests cannot starve live ones."""
+        backlog of abandoned requests cannot starve live ones.  Returns
+        the expired requests; the caller MUST hand them to
+        ``_deadline_postmortems`` after releasing the lock."""
         if self.deadline_s <= 0:
-            return
+            return []
         expired = [e for e in self._queue
                    if e[0].t_deadline is not None
                    and now >= e[0].t_deadline]
         if not expired:
-            return
+            return []
         for entry in expired:
             req, off = entry
             self._queue.remove(entry)
             self._pending_cols -= req.k - off
             self._fail_expired_locked(req, now)
         self._cond.notify_all()
+        return [e[0] for e in expired]
+
+    def _deadline_postmortems(self, reqs) -> None:
+        """OUTSIDE the lock (SLU109): flight-dump each expired request's
+        ServeDeadlineError (stage timings attached) and emit its span
+        chain so the deadline miss shows up on the Perfetto track."""
+        if not reqs:
+            return
+        tracer = None
+        for req in reqs:
+            err = req.error
+            if isinstance(err, ServeDeadlineError):
+                err.flight_postmortem()
+            ctx = req.ctx
+            if ctx.enabled:
+                if tracer is None:
+                    tracer = get_tracer()
+                ctx.emit(tracer, req.t_submit + getattr(
+                    err, "waited_s", 0.0), status="deadline_miss")
 
     def _earliest_deadline_locked(self):
         due = [e[0].t_deadline for e in self._queue
@@ -801,10 +864,11 @@ class SolveServer:
     def _dispatch_loop(self):
         tracer = get_tracer()
         while True:
+            expired = []
             with self._cond:
                 while True:
                     now = time.perf_counter()
-                    self._expire_due_locked(now)
+                    expired += self._expire_due_locked(now)
                     if self._quarantine is not None and self._queue:
                         q = self._quarantine
                         self._purge_queue_locked(
@@ -813,21 +877,26 @@ class SolveServer:
                     if self._queue:
                         break
                     if self._closed:
-                        return
+                        # exit via the empty-batch path below so the
+                        # expired postmortems run OUTSIDE the lock
+                        break
                     due = self._earliest_deadline_locked()
                     self._flush = False
                     self._cond.wait(None if due is None
                                     else max(due - now, 0.0))
                 # coalescing: hold the oldest request open for the
                 # batching window unless the batch can already fill (or
-                # a flush/close/drain asked for immediacy)
-                deadline = time.perf_counter() + self.max_wait_s
+                # a flush/close/drain asked for immediacy).  t_co0 marks
+                # the window's start — the queue_wait/coalesce stage
+                # boundary for the requests this batch carves.
+                t_co0 = time.perf_counter()
+                deadline = t_co0 + self.max_wait_s
                 while (self._pending_cols < self.max_batch
                        and not self._closed and not self._flush
                        and not self._draining
                        and self._quarantine is None):
                     now = time.perf_counter()
-                    self._expire_due_locked(now)
+                    expired += self._expire_due_locked(now)
                     if not self._queue:
                         break
                     left = deadline - now
@@ -839,17 +908,20 @@ class SolveServer:
                     self._cond.wait(left)
                 self._flush = False
                 now = time.perf_counter()
-                self._expire_due_locked(now)
+                expired += self._expire_due_locked(now)
                 segs = self._take_batch()
                 depth = self._pending_cols
                 solve_fn = self._solve    # swap-safe snapshot
                 self._inflight = sum(hi - lo for _, lo, hi in segs)
+            self._deadline_postmortems(expired)
             if not segs:
                 with self._cond:
                     self._cond.notify_all()    # wake drain waiters
+                    if self._closed and not self._queue:
+                        return
                 continue
             try:
-                self._dispatch(segs, depth, tracer, solve_fn)
+                self._dispatch(segs, depth, tracer, solve_fn, t_co0)
             except Exception as e:     # noqa: BLE001 — the dispatcher
                 for req, lo, hi in segs:       # must never die holding
                     if not req.event.is_set():  # undelivered tickets
@@ -928,10 +1000,23 @@ class SolveServer:
         if self._metrics is not None:
             self._metrics.inc("slu_serve_refined_total", 1.0)
 
-    def _dispatch(self, segs, depth, tracer, solve_fn):
+    def _stage_prefix(self, ctx, req, t_co0, t0, td0, td1):
+        """Record the shared stage prefix of a completing/poisoned
+        request: queue_wait → coalesce → dispatch → device, contiguous
+        from submit to the device-solve end (each stage starts where
+        the previous one ended, so durations sum exactly)."""
+        tc = min(max(t_co0, req.t_submit), t0)
+        ctx.stage("queue_wait", req.t_submit, tc - req.t_submit)
+        ctx.stage("coalesce", tc, t0 - tc)
+        ctx.stage("dispatch", t0, td0 - t0)
+        ctx.stage("device", td0, td1 - td0)
+
+    def _dispatch(self, segs, depth, tracer, solve_fn, t_co0=None):
         cols = sum(hi - lo for _, lo, hi in segs)
         kb = bucket_nrhs(min(cols, self.max_batch), self._bucket_set)
         t0 = time.perf_counter()
+        if t_co0 is None:
+            t_co0 = t0
         m = self._metrics
         if m is not None:
             for req, lo, hi in segs:
@@ -948,6 +1033,7 @@ class SolveServer:
                 mat[:, c:c + hi - lo] = req.b[:, lo:hi]
                 c += hi - lo
         x, err, bad = None, None, ()
+        td0 = time.perf_counter()      # dispatch/device stage boundary
         try:
             with tracer.span("serve-batch", cat="dispatch", columns=cols,
                              bucket=kb, requests=len(segs),
@@ -968,6 +1054,7 @@ class SolveServer:
             x, err = None, e            # to the tickets, not the loop
         now = time.perf_counter()
         done_lat = []
+        acct = self._accounter
         with self._lock:
             self._batches += 1
             self._batch_cols += cols
@@ -981,22 +1068,43 @@ class SolveServer:
             if req.event.is_set():      # expired while in flight
                 c += w
                 continue
+            ctx = req.ctx
             seg_bad = [j for j in bad if c <= j < c + w]
             if err is not None:
                 req.error = err
                 req.event.set()
             elif seg_bad:
+                if ctx.enabled:
+                    self._stage_prefix(ctx, req, t_co0, t0, td0, now)
+                # constructed OUTSIDE the server lock: the flight dump
+                # at construction carries the stage timings
                 req.error = ServePoisonedError(
                     [lo + (j - c) for j in seg_bad], batch_columns=cols,
-                    where="serve-batch")
+                    where="serve-batch",
+                    stages=ctx.stages_ms() or None)
+                req.error.trace_id = ctx.trace_id
+                if ctx.enabled:
+                    ctx.emit(tracer, now, status="poisoned")
                 req.event.set()
             else:
                 req.parts.append((lo, x[:, c:c + w]))
                 req.remaining -= w
                 if req.remaining == 0:
+                    tref = now
                     if self._berr_max > 0:
                         self._berr_gate(req, solve_fn)
-                    done_lat.append(now - req.t_submit)
+                        tref = time.perf_counter()
+                    t_end = time.perf_counter()
+                    lat = t_end - req.t_submit
+                    if ctx.enabled:
+                        self._stage_prefix(ctx, req, t_co0, t0, td0, now)
+                        ctx.stage("refine", now, tref - now)
+                        ctx.stage("deliver", tref, t_end - tref)
+                        ctx.note(bucket=kb, batch_columns=cols,
+                                 queue_depth=depth)
+                        ctx.emit(tracer, t_end)
+                    done_lat.append(lat)
+                    acct.observe(req.k, lat)
                     req.event.set()
             c += w
         if m is not None:

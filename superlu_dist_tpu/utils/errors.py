@@ -246,16 +246,33 @@ class ServeDeadlineError(SuperLUError):
     waiting ticket itself, when the dispatcher is stalled) expired it
     instead of serving an answer the caller has already abandoned.
     Expired work is removed from the queue, so a backlog of dead
-    requests cannot starve live ones."""
+    requests cannot starve live ones.
 
-    def __init__(self, deadline_s: float, waited_s: float, columns: int):
+    ``stages`` carries the ticket's per-stage timings (TicketContext
+    ``stages_ms()``, obs/slo.py) when request tracing is on, so the
+    flight-recorder postmortem names the stage that ate the budget.
+    The error is constructed UNDER server locks (the expiry paths), so
+    it performs no postmortem I/O at construction — callers invoke
+    :meth:`flight_postmortem` once outside the locks (the SLU109 hold
+    discipline)."""
+
+    def __init__(self, deadline_s: float, waited_s: float, columns: int,
+                 stages: dict | None = None):
         self.deadline_s = float(deadline_s)
         self.waited_s = float(waited_s)
         self.columns = int(columns)
+        self.ticket_stages = dict(stages) if stages else None
+        self.flightrec_dump = None
         super().__init__(
             f"solve request ({columns} column(s)) missed its "
             f"{deadline_s:.3f}s serving deadline after {waited_s:.3f}s "
             "in queue (shed, not served)")
+
+    def flight_postmortem(self):
+        """Dump the flight-recorder postmortem (with the ticket's stage
+        timings attached) — call OUTSIDE any server/router lock."""
+        _flight_dump(self)
+        return self.flightrec_dump
 
 
 class ServePoisonedError(SuperLUError):
@@ -266,12 +283,18 @@ class ServePoisonedError(SuperLUError):
     isolated and served bit-identically to an unpoisoned run — one bad
     right-hand side costs only its own ticket (serve/server.py,
     ``_isolate``).  ``columns`` are request-relative 0-based column
-    indices.  Dumps a flight-recorder postmortem at construction."""
+    indices.  Dumps a flight-recorder postmortem at construction (the
+    poison scatter path constructs it outside the server lock);
+    ``stages`` attaches the ticket's per-stage timings (TicketContext
+    ``stages_ms()``, obs/slo.py) so the postmortem carries the span
+    chain."""
 
-    def __init__(self, columns, batch_columns: int = 0, where: str = ""):
+    def __init__(self, columns, batch_columns: int = 0, where: str = "",
+                 stages: dict | None = None):
         self.columns = sorted(int(c) for c in columns)
         self.batch_columns = int(batch_columns)
         self.where = where
+        self.ticket_stages = dict(stages) if stages else None
         stage = f" during {where}" if where else ""
         batch = (f" of a {batch_columns}-column micro-batch"
                  if batch_columns else "")

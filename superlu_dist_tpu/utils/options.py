@@ -331,6 +331,18 @@ def _register_all() -> None:
     r("SLU_TPU_FLIGHTREC_DEPTH", "int", 512,
       "flight-recorder ring depth (events kept for the postmortem)",
       group="obs")
+    r("SLU_TPU_SLO_P99_MS", "float", 0.0,
+      "global p99 latency SLO target in ms for the serving tier "
+      "(obs/slo.py SLOEvaluator, fleet health model; 0 = no SLO)",
+      group="obs")
+    r("SLU_TPU_SLO_TARGETS", "str", "",
+      "per-traffic-class p99 SLO overrides, 'class=ms,class=ms' "
+      "(classes: serve, fleet, driver, bench; overrides "
+      "SLU_TPU_SLO_P99_MS for the named class)", group="obs")
+    r("SLU_TPU_SLO_BUDGET", "float", 0.01,
+      "SLO error budget: provisioned fraction of requests allowed over "
+      "the p99 target; burn rate = over-target fraction / budget",
+      group="obs")
     # --- native layer ------------------------------------------------------
     r("SLU_TPU_NO_NATIVE", "flag", False,
       "disable the native C++ host-analysis library", group="native")
@@ -410,7 +422,15 @@ def _register_all() -> None:
              "check_perf_regress noise tolerance (fail below "
              "(1-tol)*median)"),
             ("PERF_GATE_MIN_SAMPLES", "int", 3,
-             "check_perf_regress history rows required before enforcing")):
+             "check_perf_regress history rows required before enforcing"),
+            ("SLO_GATE_NRHS", "str", "1,8",
+             "check_slo served-workload nrhs sweep (comma list)"),
+            ("SLO_GATE_REQUESTS", "int", 48,
+             "check_slo requests per nrhs bucket"),
+            ("SLO_GATE_TOL", "float", 1.0,
+             "check_slo noise tolerance (fail above (1+tol)*median p99)"),
+            ("SLO_GATE_MIN_SAMPLES", "int", 3,
+             "check_slo history rows required before enforcing")):
         r(name, kind, default, help_, group="scripts")
 
 
@@ -494,6 +514,22 @@ def env_flag(name: str, default=_UNSET) -> bool:
     if raw is None:
         return bool(d)
     return raw.strip().lower() not in _FLAG_FALSE
+
+
+_deprecation_warned: set = set()
+
+
+def deprecated_knob_warning(name: str, hint: str) -> None:
+    """One-shot ``DeprecationWarning`` for a deprecated-but-still-honored
+    knob (at most once per process per knob, and only when the knob is
+    actually set in the environment) — the knob's OUTPUT stays unchanged
+    so downstream parsers (scripts/mfu_report.py) keep working."""
+    if name in _deprecation_warned or os.environ.get(name) is None:
+        return
+    _deprecation_warned.add(name)
+    import warnings
+    warnings.warn(f"{name} is deprecated: {hint}",
+                  DeprecationWarning, stacklevel=3)
 
 
 def knob_table_md(groups: tuple | None = None) -> str:

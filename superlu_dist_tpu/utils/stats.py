@@ -109,6 +109,10 @@ class SolveReport:
                                   # the answer rests on ran at (updated by
                                   # the gemm-precision escalation rung —
                                   # ops/dense.GEMM_PREC_LADDER)
+    latency_ms: float | None = None  # end-to-end driver solve latency
+                                  # (SOLVE + refine + ladder + condest),
+                                  # also fed to the always-on obs/slo
+                                  # accounter under class "driver"
 
     def summary(self) -> str:
         parts = [f"factor dtype {self.factor_dtype}" if self.factor_dtype
@@ -121,6 +125,8 @@ class SolveReport:
             parts.append(f"berr {self.berr:.3e}")
         if self.ferr:
             parts.append(f"ferr {max(self.ferr):.3e}")
+        if self.latency_ms is not None:
+            parts.append(f"latency {self.latency_ms:.3f} ms")
         if self.tiny_pivots:
             parts.append(f"{self.tiny_pivots} tiny pivots replaced")
         for r in self.rungs:
@@ -307,6 +313,17 @@ class Stats:
             lines.append(f"    refinement steps: {self.refine_steps}")
         if self.solve_report is not None:
             lines.append(f"    solve health: {self.solve_report.summary()}")
+        try:
+            from superlu_dist_tpu.obs.slo import get_accounter
+            lat_lines = get_accounter().report_lines()
+        except Exception:
+            lat_lines = []
+        if lat_lines:
+            # the always-on streaming latency histograms (obs/slo.py):
+            # per (traffic class, nrhs bucket) quantiles — the serving
+            # SLO layer's view, printed wherever Stats is printed
+            lines.append("**** Latency (ms, per class / nrhs bucket) ****")
+            lines.extend(lat_lines)
         if self.for_lu_bytes:
             # dQuerySpace_dist-style report (SRC/dmemory_dist.c:73)
             lines.append(f"    L\\U storage {self.for_lu_bytes / 1e6:10.2f} MB"
