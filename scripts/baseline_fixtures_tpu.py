@@ -29,6 +29,8 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from superlu_dist_tpu.utils import tols  # noqa: E402
+
 FIXTURES = [
     ("1", "/root/reference/EXAMPLE/g20.rua", "float32"),
     ("2", "/root/reference/EXAMPLE/big.rua", "float32"),
@@ -77,7 +79,7 @@ def main():
         # cannot discard an earlier measurement
         with open(out_path, "a") as f:
             f.write(json.dumps(rec) + "\n")
-        assert info == 0 and resid < 1e-10, rec
+        assert info == 0 and resid < tols.RESID_GATE_TIGHT, rec
 
 
 if __name__ == "__main__":

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Precision-lint gate (slulint v5): the tree is clean under the
+precision-flow rules and every program the REAL executors build passes
+the runtime dtype audit.
+
+Phase A — whole-tree source scan: SLU115 (implicit downcast), SLU116
+(accumulation dtype), SLU117 (EFT purity) and SLU118 (tolerance
+hygiene) over the default scan scope via the slulint CLI — any finding
+fails the gate (the in-tree true positives were fixed by the v5 PR;
+new ones must not accrete).
+
+Phase B — runtime twin coverage: ``SLU_TPU_VERIFY_DTYPES=1`` over the
+gate gallery (poisson2d + hilbert) through all three factor executors
+and the device solve sweeps (fused and streamed, plain and transpose):
+every submitted program is traced and walked by
+``audit_narrowing``/``audit_accumulation`` with ZERO findings, the
+census ``#dtypes`` audit notes cover 100% of the audited programs, and
+a bf16-GEMM-tier factorization proves the sanctioned GEMM-input
+narrowing (cast consumed by an f32-accumulating dot_general) passes
+the audit rather than false-positiving.
+
+Exit 0 = pass.  One gate of scripts/ci_gates.sh (shared contract:
+diagnostics on stdout/stderr, non-zero on any regression, hard
+timeout).
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SLU_TPU_VERIFY_DTYPES"] = "1"
+
+import numpy as np  # noqa: E402
+
+
+def phase_a() -> None:
+    cmd = [sys.executable, "-m", "superlu_dist_tpu.analysis",
+           "--rules", "SLU115,SLU116,SLU117,SLU118", "--no-baseline"]
+    proc = subprocess.run(cmd, cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+    sys.stdout.write(proc.stdout)
+    sys.stderr.write(proc.stderr)
+    assert proc.returncode == 0, \
+        f"whole-tree SLU115-SLU118 scan found new precision findings"
+    print("[precision-lint] phase A: tree clean under SLU115-SLU118")
+
+
+def _analyzed(a):
+    from superlu_dist_tpu.ordering.dispatch import get_perm_c
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+    from superlu_dist_tpu.utils.options import Options
+
+    sym = symmetrize_pattern(a)
+    col_order = get_perm_c(Options(), a, sym)
+    sf = symbolic_factorize(sym, col_order)
+    return sf, sym.data[sf.value_perm], a.norm_max()
+
+
+def check(name, a, gemm_prec=None) -> int:
+    from superlu_dist_tpu.numeric.factor import numeric_factorize
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.solve.device import DeviceSolver
+
+    sf, vals, anorm = _analyzed(a)
+    plan = build_plan(sf)
+    rng = np.random.default_rng(7)
+    rhs = rng.standard_normal((plan.n, 5))
+    for ex in ("fused", "stream", "mega"):
+        fact = numeric_factorize(plan, vals, anorm, executor=ex,
+                                 gemm_prec=gemm_prec)
+        if ex == "stream":
+            for fused in (True, False):
+                ds = DeviceSolver(fact, fused=fused)
+                ds.solve(rhs)
+                ds.solve_trans(rhs)
+    from superlu_dist_tpu.utils import programaudit
+    aud = programaudit._DTYPE_AUDITOR
+    assert aud is not None, "SLU_TPU_VERIFY_DTYPES=1 allocated no auditor"
+    assert aud.findings == [], aud.findings
+    tier = f", gemm_prec={gemm_prec}" if gemm_prec else ""
+    print(f"[precision-lint] {name}{tier}: {len(aud.audited)} program(s) "
+          "audited clean")
+    return len(aud.audited)
+
+
+def main():
+    phase_a()
+
+    import jax
+    jax.config.update("jax_enable_x64", True)
+    from superlu_dist_tpu.models.gallery import hilbert, poisson2d
+
+    total = 0
+    total = max(total, check("poisson2d nx=12", poisson2d(12)))
+    total = max(total, check("hilbert n=48", hilbert(48)))
+    # the bf16 tier narrows GEMM inputs by design — the sanctioned
+    # pattern (cast -> f32-accumulating dot_general) must audit CLEAN
+    total = max(total, check("poisson2d nx=12", poisson2d(12),
+                             gemm_prec="bf16"))
+
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+    blk = COMPILE_STATS.audit_block()
+    assert blk["programs"] == total and total > 0, \
+        f"census #dtypes notes disagree: {blk} vs {total} audited"
+    assert blk["findings"] == 0, f"findings leaked past submit: {blk}"
+    print(f"[precision-lint] OK: {blk['programs']} programs dtype-audited, "
+          "0 findings, 100% coverage")
+
+
+if __name__ == "__main__":
+    main()

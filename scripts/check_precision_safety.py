@@ -42,6 +42,7 @@ def phase_a() -> None:
     from superlu_dist_tpu.drivers.gssvx import gssvx
     from superlu_dist_tpu.models.gallery import hilbert
     from superlu_dist_tpu.utils.options import IterRefine, Options
+    from superlu_dist_tpu.utils import tols
 
     a = hilbert(8)
     b = a.matvec(np.ones(a.n_rows))
@@ -58,6 +59,13 @@ def phase_a() -> None:
         if rep.berr is None or rep.target is None:
             fail(f"phase A [{label}]: no BERR gate was applied "
                  f"({rep.summary()})")
+        # the delivered gate must BE the central model's target — a
+        # driver that minted its own threshold would bypass utils/tols
+        want_target = float(tols.berr_target(np.float64))
+        if float(rep.target) != want_target:
+            fail(f"phase A [{label}]: gate target {rep.target!r} is not "
+                 f"tols.berr_target(float64) = {want_target!r} — the "
+                 "driver drifted off the central tolerance model")
         if not rep.converged or rep.berr > rep.target:
             fail(f"phase A [{label}]: delivered berr {rep.berr:.3e} "
                  f"misses the gate {rep.target:.3e} and was still "

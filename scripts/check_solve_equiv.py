@@ -37,6 +37,7 @@ sys.path.insert(0, REPO)
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np  # noqa: E402
+from superlu_dist_tpu.utils import tols  # noqa: E402
 
 
 def _factored(a):
@@ -76,12 +77,14 @@ def check(name, a):
                 ref = x_str
             else:
                 np.testing.assert_allclose(
-                    x_str, ref, rtol=1e-11, atol=1e-13,
+                    x_str, ref, rtol=tols.SCHEDULE_DRIFT_RTOL,
+                    atol=tols.SCHEDULE_DRIFT_ATOL,
                     err_msg=f"{name}: schedule {sched} drifted past "
                             f"tolerance at nrhs={nrhs}")
             # tier 3: device vs host
             np.testing.assert_allclose(
-                x_str, want, rtol=1e-9, atol=1e-11,
+                x_str, want, rtol=tols.DEVICE_VS_HOST_RTOL,
+                atol=tols.DEVICE_VS_HOST_ATOL,
                 err_msg=f"{name}: device ({sched}) vs host solve "
                         f"disagree at nrhs={nrhs}")
             # padding honesty: executed covers structural, padded nrhs
@@ -95,7 +98,8 @@ def check(name, a):
         want_t = lu_solve_trans(lu.numeric, d)
         got_t = DeviceSolver(lu.numeric, schedule="dataflow").solve_trans(d)
         np.testing.assert_allclose(
-            got_t, want_t, rtol=1e-9, atol=1e-11,
+            got_t, want_t, rtol=tols.DEVICE_VS_HOST_RTOL,
+            atol=tols.DEVICE_VS_HOST_ATOL,
             err_msg=f"{name}: transpose device vs host at nrhs={nrhs}")
     sp = build_solve_plan(lu.plan, schedule="dataflow", window=0)
     assert len(sp.groups) <= sp.n_factor_groups, (

@@ -42,13 +42,14 @@ import numpy as np
 import superlu_dist_tpu as slu
 from superlu_dist_tpu.models.gallery import poisson2d
 from superlu_dist_tpu.obs import flightrec, metrics, trace
+from superlu_dist_tpu.utils import tols
 
 a = poisson2d(10)
 b = np.ones(a.n_rows)
 x, lu, stats, info = slu.gssvx(slu.Options(), a, b)
 assert info == 0, info
 res = float(np.linalg.norm(b - a.matvec(x)) / np.linalg.norm(b))
-assert res < 1e-8, res
+assert res < tols.RESID_GATE, res
 t = trace.get_tracer()
 m = metrics.get_metrics()
 fr = flightrec.get_flightrec()

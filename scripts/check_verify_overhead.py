@@ -28,6 +28,16 @@ And the SLU111/SLU112/SLU114 program auditor (utils/programaudit.py):
   exactly once, and the census audit block reports full donation
   coverage.
 
+And the SLU115/SLU116 precision twin (same module, separate knob):
+
+* dtypes OFF — the same workload allocates NO dtype-auditor state
+  (``programaudit._DTYPE_AUDITOR is None``) and the two knobs stay
+  independent (``SLU_TPU_VERIFY_PROGRAMS=1`` alone must not arm the
+  dtype twin, and vice versa);
+* dtypes ON  — every submitted program passes ``audit_narrowing`` +
+  ``audit_accumulation`` with zero findings and the census ``#dtypes``
+  notes match the audit count.
+
 Exit 0 = pass.  Gate contract (shared with run_slulint.sh,
 check_nan_guards.sh and check_trace_overhead.py — see
 scripts/ci_gates.sh): any regression raises/asserts, which exits
@@ -120,10 +130,14 @@ DeviceSolver(fact).solve(np.ones(plan.n))
 from superlu_dist_tpu.utils import programaudit
 from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
 aud = programaudit._AUDITOR
+daud = programaudit._DTYPE_AUDITOR
 blk = COMPILE_STATS.audit_block()
 print(json.dumps({
     "auditor": aud is not None,
     "audited": len(aud.audited) if aud is not None else 0,
+    "dtype_auditor": daud is not None,
+    "dtype_audited": len(daud.audited) if daud is not None else 0,
+    "dtype_findings": len(daud.findings) if daud is not None else 0,
     "census_programs": blk["programs"],
     "coverage": blk["donation_coverage_pct"],
 }))
@@ -134,7 +148,7 @@ def run_child(extra_env, code=None):
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     for k in ("SLU_TPU_VERIFY_COLLECTIVES", "SLU_TPU_COMM_TIMEOUT_S",
               "SLU_TPU_CHAOS", "SLU_TPU_VERIFY_LOCKS",
-              "SLU_TPU_VERIFY_PROGRAMS"):
+              "SLU_TPU_VERIFY_PROGRAMS", "SLU_TPU_VERIFY_DTYPES"):
         env.pop(k, None)
     env.update(extra_env)
     r = subprocess.run([sys.executable, "-c", code or CHILD], env=env,
@@ -172,11 +186,15 @@ def main():
     poff = run_child({}, code=PROG_CHILD)
     if poff["auditor"]:
         fail("program-audit off-path allocated an auditor")
+    if poff["dtype_auditor"]:
+        fail("dtype-audit off-path allocated an auditor")
     if poff["census_programs"] != 0:
         fail(f"program-audit off-path left census audit notes: {poff}")
     pon = run_child({"SLU_TPU_VERIFY_PROGRAMS": "1"}, code=PROG_CHILD)
     if not pon["auditor"] or pon["audited"] == 0:
         fail(f"program-audit verify mode audited nothing: {pon}")
+    if pon["dtype_auditor"]:
+        fail("SLU_TPU_VERIFY_PROGRAMS=1 alone armed the dtype twin")
     if pon["census_programs"] != pon["audited"]:
         fail(f"census audit notes disagree with the auditor: {pon}")
     if pon["coverage"] != 100.0:
@@ -184,6 +202,20 @@ def main():
     print(f"check_verify_overhead: programs OK (off path allocates no "
           f"auditor; on path audited {pon['audited']} programs at "
           f"{pon['coverage']}% donation coverage)")
+
+    # ---- SLU115/116 precision (dtype) auditor ---------------------------
+    don = run_child({"SLU_TPU_VERIFY_DTYPES": "1"}, code=PROG_CHILD)
+    if not don["dtype_auditor"] or don["dtype_audited"] == 0:
+        fail(f"dtype-audit verify mode audited nothing: {don}")
+    if don["dtype_findings"] != 0:
+        fail(f"dtype audit flagged the real executors: {don}")
+    if don["auditor"]:
+        fail("SLU_TPU_VERIFY_DTYPES=1 alone armed the program auditor")
+    if don["census_programs"] != don["dtype_audited"]:
+        fail(f"#dtypes census notes disagree with the auditor: {don}")
+    print(f"check_verify_overhead: dtypes OK (off path allocates no "
+          f"auditor; on path audited {don['dtype_audited']} programs, "
+          f"0 findings)")
 
     # ---- SLU106 collective lockstep verifier ----------------------------
     off = run_child({})

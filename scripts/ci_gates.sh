@@ -79,6 +79,16 @@
 #                   completes under traffic with zero dropped tickets
 #                   and a poisoned bundle rolls back (preflight +
 #                   per-replica canary)
+#   precision-lint  scripts/check_precision_lint.py   slulint v5
+#                   precision-flow rules: the whole tree is clean under
+#                   SLU115 (implicit downcast), SLU116 (accumulation
+#                   dtype), SLU117 (EFT purity) and SLU118 (tolerance
+#                   hygiene); under SLU_TPU_VERIFY_DTYPES=1 every
+#                   program the real executors submit (gate gallery,
+#                   all three factor executors + device solve sweeps,
+#                   plus a bf16-GEMM-tier run proving the sanctioned
+#                   narrowing) passes the runtime dtype audit with zero
+#                   findings and 100% census coverage
 #   refactor-consistency scripts/check_refactor.py    crash-consistent
 #                   same-pattern refactorization: refactor(handle,
 #                   new_values) bitwise vs a SamePattern_SameRowPerm
@@ -125,12 +135,14 @@ declare -A GATES=(
   [program-audit]="python scripts/check_program_audit.py"
   [fleet-failover]="python scripts/check_fleet_failover.py"
   [precision-safety]="python scripts/check_precision_safety.py"
+  [precision-lint]="python scripts/check_precision_lint.py"
   [refactor-consistency]="python scripts/check_refactor.py"
 )
-ORDER=(slulint program-audit verify-overhead schedule-equiv solve-equiv
-       precision-safety serve-robust fleet-failover refactor-consistency
-       crash-resume rank-failure compile-budget tsan-native
-       trace-overhead nan-guards perf-regress slo-gate)
+ORDER=(slulint precision-lint program-audit verify-overhead
+       schedule-equiv solve-equiv precision-safety serve-robust
+       fleet-failover refactor-consistency crash-resume rank-failure
+       compile-budget tsan-native trace-overhead nan-guards
+       perf-regress slo-gate)
 
 requested=("$@")
 if [ ${#requested[@]} -eq 0 ]; then

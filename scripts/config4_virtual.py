@@ -168,7 +168,8 @@ def main():
     with open(out, "w") as f:
         json.dump(rec, f, indent=1)
     print(json.dumps(rec), flush=True)
-    assert resid < 1e-10, resid
+    from superlu_dist_tpu.utils import tols
+    assert resid < tols.RESID_GATE_TIGHT, resid
 
 
 if __name__ == "__main__":

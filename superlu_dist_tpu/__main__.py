@@ -20,6 +20,8 @@ import time
 
 import numpy as np
 
+from superlu_dist_tpu.utils import tols
+
 
 def _build_parser():
     p = argparse.ArgumentParser(
@@ -143,7 +145,7 @@ def run_once(a, args) -> int:
         if berr is not None:
             print(f"    backward error (IR)      {berr:.3e}")
         print(f"    total wall time          {wall:.4f} s")
-    ok = res < 1e-8
+    ok = res < tols.RESID_GATE
     if not ok:
         print(f"RESIDUAL TOO LARGE: {res:.3e}")
     return 0 if ok else 1
@@ -170,7 +172,7 @@ def run_sweep(a, args) -> int:
                 try:
                     x, lu, stats, info = slu.gssvx(opts, a, b, lu=lu)
                     res = _resid(a, x, b) if info == 0 else np.inf
-                    ok = info == 0 and res < 1e-8
+                    ok = info == 0 and res < tols.RESID_GATE
                 except Exception as e:          # robustness: keep sweeping
                     res, ok = float("nan"), False
                     print(f"  exception in {fact.name}: {e}")
@@ -187,7 +189,7 @@ def run_sweep(a, args) -> int:
         try:
             x, _, stats, info = slu.gssvx(opts, a, b)
             res = _resid(a, x, b) if info == 0 else np.inf
-            ok = info == 0 and res < 1e-8
+            ok = info == 0 and res < tols.RESID_GATE
         except Exception as e:
             res, ok = float("nan"), False
             print(f"  exception in colperm {cp.name}: {e}")

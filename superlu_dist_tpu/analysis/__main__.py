@@ -38,13 +38,17 @@ def _build_parser():
                     "diversity SLU107, shared-mutable access SLU108, "
                     "lock-order/hold-discipline SLU109, thread "
                     "lifecycle SLU110, dispatch-loop host round-trips "
-                    "SLU113; the SLU106 runtime twin lives in "
+                    "SLU113, implicit downcast SLU115, accumulation "
+                    "dtype SLU116, EFT purity SLU117, tolerance hygiene "
+                    "SLU118; the SLU106 runtime twin lives in "
                     "parallel/treecomm.py under "
                     "SLU_TPU_VERIFY_COLLECTIVES=1, the SLU109 runtime "
                     "twin in utils/lockwatch.py under "
-                    "SLU_TPU_VERIFY_LOCKS=1, and the program-level IR "
+                    "SLU_TPU_VERIFY_LOCKS=1, the program-level IR "
                     "rules SLU111/SLU112/SLU114 in utils/programaudit.py "
-                    "under SLU_TPU_VERIFY_PROGRAMS=1)")
+                    "under SLU_TPU_VERIFY_PROGRAMS=1, and the "
+                    "SLU115/SLU116 precision twin there too under "
+                    "SLU_TPU_VERIFY_DTYPES=1)")
     p.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
                    help="files/directories to scan (default: the package, "
                         "scripts/, bench.py, examples/)")

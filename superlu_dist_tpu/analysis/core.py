@@ -35,7 +35,7 @@ import re
 #: bumped whenever the rule set / engine semantics change — part of the
 #: result-cache key (analysis/cache.py), so a stale cache can never
 #: serve findings computed by an older rule set
-ANALYSIS_VERSION = "4"
+ANALYSIS_VERSION = "5"
 
 
 @dataclasses.dataclass
@@ -191,10 +191,15 @@ def default_rules() -> list:
     from superlu_dist_tpu.analysis.rules_lifecycle import \
         ThreadLifecycleRule
     from superlu_dist_tpu.analysis.rules_program import HostRoundTripRule
+    from superlu_dist_tpu.analysis.rules_precision import (
+        AccumulationDtypeRule, EFTPurityRule, ImplicitDowncastRule,
+        ToleranceLiteralRule)
     return [CollectiveRule(), TracePurityRule(), IndexWidthRule(),
             EnvKnobRule(), JitCacheKeyRule(), JitKeyShapeDiversityRule(),
             SharedMutableRule(), LockOrderRule(), ThreadLifecycleRule(),
-            HostRoundTripRule()]
+            HostRoundTripRule(), ImplicitDowncastRule(),
+            AccumulationDtypeRule(), EFTPurityRule(),
+            ToleranceLiteralRule()]
 
 
 def analyze_source(source: str, path: str, rules, project=None) -> list:

@@ -48,6 +48,22 @@ TAINT_ENV = "env"
 # sanctioned EXPLICIT syncs: their results are host-side (taint cleared).
 TAINT_DEVICE = "device"
 TAINT_JITFN = "jitfn"
+# v5 (rules_precision): the precision-flow component.  Width taints say
+# what FLOAT width a value is KNOWN to carry (an explicit ctor dtype, an
+# `.astype`, an `np.float64(x)` cast, or — via the return-taint fixpoint
+# — the return of a function producing one); two separate kinds so the
+# width survives the provenance-string wrapping of summarized returns.
+# The EFT taint marks df64 hi/lo pair COMPONENTS (results of the
+# ops/df64.py error-free transforms): their bit patterns only mean
+# something under the EFT primitive algebra, so raw arithmetic on them
+# is SLU117's hazard.  16-bit floats get no kind of their own — width 16
+# is the lattice floor; nothing narrows below it.
+TAINT_F64 = "f64"
+TAINT_F32 = "f32"
+TAINT_EFT = "eft"
+
+#: taints that do not survive a comparison (comparisons yield bools)
+_NONBOOL_TAINTS = (TAINT_I32, TAINT_F64, TAINT_F32, TAINT_EFT)
 
 #: explicit host-materialization calls — the fix SLU113's hint asks for,
 #: so their results must not keep the device taint
@@ -78,6 +94,68 @@ _I64_NAMES = frozenset({"np.int64", "numpy.int64", "int64", "np.intp",
 
 _ARRAY_CTORS = frozenset({"zeros", "empty", "full", "arange", "array",
                           "asarray", "ones"})
+
+# ---- float dtype recognition (v5 precision lattice) -----------------------
+# Complex dtypes resolve to their COMPONENT width: narrowing c128 -> c64
+# loses exactly the bits narrowing f64 -> f32 does.
+
+_F64_DOTTED = frozenset({"np.float64", "numpy.float64", "jnp.float64",
+                         "float64", "np.double", "numpy.double",
+                         "np.complex128", "numpy.complex128",
+                         "jnp.complex128", "complex128"})
+_F32_DOTTED = frozenset({"np.float32", "numpy.float32", "jnp.float32",
+                         "float32", "np.single", "numpy.single",
+                         "np.complex64", "numpy.complex64",
+                         "jnp.complex64", "complex64"})
+_F16_DOTTED = frozenset({"np.float16", "numpy.float16", "jnp.float16",
+                         "float16", "jnp.bfloat16", "bfloat16",
+                         "ml_dtypes.bfloat16"})
+
+#: the ops/df64.py error-free-transform primitive set — the ONLY algebra
+#: allowed to touch df64 hi/lo components (SLU117).  Recognized by call
+#: tail so fixture-local definitions taint the same way.  The merge
+#: helpers df64_to_f64/zdf64_to_c128 are deliberately absent: their
+#: results are plain f64 values, not pair components.
+EFT_PRIMITIVES = frozenset({
+    "two_sum", "quick_two_sum", "two_prod", "df64_add", "df64_sub",
+    "df64_mul", "df64_div", "df64_neg", "df64_from_f64", "zdf64_add",
+    "zdf64_sub", "zdf64_mul", "zdf64_div", "zdf64_neg",
+    "zdf64_from_c128"})
+
+
+def float_width_name(name: str) -> int | None:
+    """64/32/16 when ``name`` lexically names a float/complex dtype
+    (complex -> component width), else None."""
+    if name in _F64_DOTTED:
+        return 64
+    if name in _F32_DOTTED:
+        return 32
+    if name in _F16_DOTTED:
+        return 16
+    return None
+
+
+def float_width_node(node) -> int | None:
+    """Float width of a dtype EXPRESSION: ``np.float32`` / ``'float32'``
+    / ``jnp.bfloat16`` ... — None for dynamic dtypes (``x.dtype``),
+    which the precision rules deliberately cannot see through."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return float_width_name(node.value.strip())
+    return float_width_name(dotted_name(node))
+
+
+def width_taint_kind(width) -> str | None:
+    return {64: TAINT_F64, 32: TAINT_F32}.get(width)
+
+
+def taint_width(taints: dict) -> int | None:
+    """The widest float width a taint set attests (promotion picks the
+    wider operand, so after a BinOp merge the max is the result width)."""
+    if TAINT_F64 in taints:
+        return 64
+    if TAINT_F32 in taints:
+        return 32
+    return None
 # calls through which an i32 taint survives unchanged
 _PASSTHROUGH = frozenset({"cumsum", "asarray", "ascontiguousarray",
                           "array", "copy", "ravel", "reshape",
@@ -458,7 +536,8 @@ class FnFlow:
             elif TAINT_I32 in rt and _const_like(node.left):
                 out[TAINT_I32] = rt[TAINT_I32]
             for t in (lt, rt):
-                for k in (TAINT_RANK, TAINT_ENV, TAINT_DEVICE):
+                for k in (TAINT_RANK, TAINT_ENV, TAINT_DEVICE,
+                          TAINT_F64, TAINT_F32, TAINT_EFT):
                     if k in t:
                         out.setdefault(k, t[k])
             return out
@@ -468,7 +547,7 @@ class FnFlow:
             out = {}
             for v in vals:
                 for k, p in self.taint(v).items():
-                    if k != TAINT_I32:      # comparisons yield bools
+                    if k not in _NONBOOL_TAINTS:  # comparisons yield bools
                         out.setdefault(k, p)
             return out
         if isinstance(node, ast.IfExp):
@@ -518,7 +597,8 @@ class FnFlow:
             return {TAINT_ENV: f"os.environ[{env[0]!r}]"}
         fn = node.func
         name = dotted_name(fn)
-        # x.astype(D): promotion clears, demotion taints
+        # x.astype(D): promotion clears, demotion taints; a lexical
+        # float dtype rebinds the width kinds (and clears the stale one)
         if isinstance(fn, ast.Attribute) and fn.attr == "astype" \
                 and node.args:
             base = dict(self.taint(fn.value))
@@ -526,12 +606,30 @@ class FnFlow:
                 base[TAINT_I32] = f"`.astype({dotted_name(node.args[0]) or 'int32'})` at line {node.lineno}"
             else:
                 base.pop(TAINT_I32, None)
+            w = float_width_node(node.args[0])
+            if w is not None:
+                base.pop(TAINT_F64, None)
+                base.pop(TAINT_F32, None)
+                k = width_taint_kind(w)
+                if k is not None:
+                    base[k] = (f"`.astype({dotted_name(node.args[0]) or node.args[0].value})` "
+                               f"at line {node.lineno}")
             return base
         # np.int32(x) and friends
         if is_explicit_i32_expr(node):
             return {TAINT_I32: f"`{name}()` cast at line {node.lineno}"}
-        # array ctors / cumsum with an explicit 32-bit dtype
         tail = name.rsplit(".", 1)[-1]
+        # the df64 error-free-transform algebra: every result is a pair
+        # component (tuple results taint each unpacked element)
+        if tail in EFT_PRIMITIVES:
+            return {TAINT_EFT: f"`{tail}(...)` at line {node.lineno}"}
+        # np.float64(x) / jnp.float32(x) explicit width casts
+        if (node.args or node.keywords) and not isinstance(
+                node.func, ast.Call):
+            k = width_taint_kind(float_width_name(name))
+            if k is not None:
+                return {k: f"`{name}()` cast at line {node.lineno}"}
+        # array ctors / cumsum with an explicit 32-bit dtype
         if tail in _ARRAY_CTORS or tail == "cumsum":
             dt = dtype_kw(node)
             if dt is None and tail in _ARRAY_CTORS and len(node.args) >= 2 \
@@ -542,6 +640,11 @@ class FnFlow:
                     return {TAINT_I32: f"`{name}(dtype="
                                        f"{dotted_name(dt) or 'int32'})` "
                                        f"at line {node.lineno}"}
+                k = width_taint_kind(float_width_node(dt))
+                if k is not None:
+                    return {k: f"`{name}(dtype="
+                               f"{dotted_name(dt) or 'float'})` "
+                               f"at line {node.lineno}"}
                 return {}
             if tail in _PASSTHROUGH and node.args:
                 return dict(self.taint(node.args[0]))

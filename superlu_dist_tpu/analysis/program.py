@@ -292,3 +292,22 @@ def audit_spec(spec: ProgramSpec, donate_min_bytes: int,
     stats.update(don_stats)
     stats.update(const_stats)
     return findings, stats
+
+
+def audit_dtypes(spec: ProgramSpec):
+    """Run the v5 precision rules (SLU115 narrowing converts / SLU116
+    accumulation dtypes) over one spec — the jaxpr half of the
+    ``SLU_TPU_VERIFY_DTYPES=1`` runtime twin (utils/programaudit.py).
+
+    Returns ``(findings, stats)`` like :func:`audit_spec`; stats carry
+    the convert/dot_general census the precision audit notes report.
+    """
+    from superlu_dist_tpu.analysis import rules_precision as rp
+    f1, narrow_stats = rp.audit_narrowing(spec)
+    f2, accum_stats = rp.audit_accumulation(spec)
+    findings = f1 + f2
+    stats = {"label": spec.label, "site": spec.site,
+             "findings": len(findings)}
+    stats.update(narrow_stats)
+    stats.update(accum_stats)
+    return findings, stats

@@ -1,0 +1,526 @@
+"""slulint v5 precision-flow rules — SLU115-SLU118.
+
+GESP's correctness story (static pivoting + iterative refinement) rests
+on arithmetic precision being exactly what the escalation ladder
+believes it is: since the ladder landed, every Schur GEMM can run at
+bf16/default/f32/highest, df64 accuracy depends on optimization_barrier-
+fenced error-free transforms XLA is free to destroy, and acceptance
+gates compare against float literals that silently encode a dtype.
+These rules audit DTYPE FLOW — the hazard class the recursive blocked
+TRSM/TRMM literature calls out, where accumulation precision (not
+layout) decides portability (arXiv:2504.13821).
+
+Two rules run over SOURCE via the v2 dataflow lattice's new precision
+component (``dataflow.TAINT_F64``/``TAINT_F32``/``TAINT_EFT``):
+
+SLU115 — implicit downcast.  An ``.astype`` that narrows float width
+(f64→f32→bf16) on a value-carrying array in ``numeric/``/``solve/``/
+``refine/`` silently discards mantissa bits the BERR gate will charge to
+"the matrix" three rungs later.  The sanctioned tier boundary is
+``ops/dense.gemm`` (path-exempt: ops/ is outside the rule's scope) and
+the df64 split/merge helpers; everything else is flagged, with the
+witness chain from the cast site to the consuming GEMM/TRSM when the
+cast value demonstrably feeds one.
+
+SLU117 — EFT purity.  df64 hi/lo pair components (results of the
+ops/df64.py error-free transforms) carry compensation terms whose bit
+patterns only mean something under the EFT algebra: a raw ``+``/``-``/
+``*`` on one outside ``ops/df64.py`` re-associates the compensation and
+silently degrades df64 to f32.  Second half: the EFT kernels themselves
+(two_sum/quick_two_sum/two_prod/_split) must fence every intermediate
+with ``optimization_barrier`` — an unfenced transform is exactly what
+XLA's reassociation freedoms destroy.
+
+One rule is lexical:
+
+SLU118 — tolerance hygiene.  Float comparison literals in the tolerance
+band (1e-18, 1e-5] — ``berr < 1e-6`` style, and ``rtol=``/``atol=``
+kwargs — encode a dtype assumption no reader can audit.  Thresholds must
+derive from the central dtype-aware model (``utils/tols.py``:
+eps(dtype)×factor with provenance).  Perf ratios (0.05), underflow
+guards (1e-300) and demo drivers under ``examples/`` are out of band or
+out of scope by design.
+
+SLU116 runs over BOTH source and jaxprs:
+
+SLU116 — accumulation dtype.  Source half: a ``jnp``/``lax`` matmul/
+dot/einsum/tensordot/dot_general/segment_sum in ``numeric/``/``solve/``
+without ``preferred_element_type`` leaves the accumulator at the
+backend's whim — on TPU a bf16-input GEMM then accumulates at bf16
+(the bug the BERR gate catches three rungs late; ``ops/dense.gemm``
+pins every tier and is the sanctioned route).  Jaxpr half
+(:func:`audit_accumulation`, plus :func:`audit_narrowing` for SLU115):
+every ``dot_general`` in a traced program must produce a float width
+≥ the widest operand (and ≥ 32 when any operand is 16-bit); narrowing
+``convert_element_type`` eqns on non-scalar values are flagged unless
+every transitive consumer (through shape-transparent ops) is a
+wide-accumulating dot_general — the shape ``gemm``'s bf16 tier
+legitimately emits.  Both halves are duck-typed over jaxpr objects
+(no jax import — unit-testable on stubs) and power the
+``SLU_TPU_VERIFY_DTYPES=1`` runtime twin (utils/programaudit.py).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from superlu_dist_tpu.analysis.core import (Finding, Rule, _norm_parts,
+                                            dotted_name)
+from superlu_dist_tpu.analysis.dataflow import (TAINT_EFT, FnFlow,
+                                                float_width_node,
+                                                taint_width)
+from superlu_dist_tpu.analysis.program import ProgramSpec, iter_eqns
+
+RULE_IMPLICIT_DOWNCAST = "SLU115"
+RULE_ACCUM_DTYPE = "SLU116"
+RULE_EFT_PURITY = "SLU117"
+RULE_TOL_LITERAL = "SLU118"
+
+#: calls that consume a narrowed value into MXU-bound linear algebra —
+#: the witness targets of SLU115's cast→consumer chain
+_PREC_CONSUMERS = frozenset({
+    "matmul", "dot", "einsum", "tensordot", "dot_general",
+    "solve_triangular", "gemm", "trsm", "segment_sum"})
+
+#: private taint kind threading a cast site key through the dataflow
+#: (never leaves _NarrowFlow: summarize() runs plain FnFlow)
+_TK_NARROW = "_narrow115"
+
+
+# --------------------------------------------------------------------------
+# SLU115 — implicit downcast (source half)
+# --------------------------------------------------------------------------
+
+class _NarrowFlow(FnFlow):
+    """FnFlow that records narrowing ``.astype`` sites and the first
+    GEMM/TRSM-ish consumer each narrowed value reaches."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        # (line, col) -> {node, w_from, w_to, consumer}
+        self.casts: dict = {}
+
+    def _call_taint_base(self, node: ast.Call) -> dict:
+        t = super()._call_taint_base(node)
+        fn = node.func
+        if isinstance(fn, ast.Attribute) and fn.attr == "astype" \
+                and node.args:
+            w_to = float_width_node(node.args[0])
+            if w_to is not None:
+                w_from = taint_width(self.taint(fn.value))
+                # 16-bit targets are always a downcast of a compute
+                # dtype; 32-bit targets only flag on a KNOWN f64 source
+                # (false-negative-leaning: plain-f32 code stays quiet)
+                if w_to == 16 or (w_from is not None and w_to < w_from):
+                    key = (node.lineno, node.col_offset)
+                    self.casts.setdefault(
+                        key, {"node": node, "w_from": w_from,
+                              "w_to": w_to, "consumer": None})
+                    t = dict(t)
+                    t[_TK_NARROW] = key
+        return t
+
+    def visit_stmt(self, st) -> None:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = dotted_name(node.func).rsplit(".", 1)[-1]
+            if tail not in _PREC_CONSUMERS:
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            for arg in args:
+                key = self.taint(arg).get(_TK_NARROW)
+                info = self.casts.get(key) if key is not None else None
+                if info is not None and info["consumer"] is None:
+                    info["consumer"] = (tail, node.lineno)
+
+
+class ImplicitDowncastRule(Rule):
+    rule_id = RULE_IMPLICIT_DOWNCAST
+    title = "implicit-float-downcast"
+    hint = ("route reduced-precision arithmetic through the sanctioned "
+            "tier boundary (ops/dense.gemm pins the accumulator per "
+            "ladder tier) or the df64 split helpers; a bare narrowing "
+            ".astype silently discards mantissa bits the BERR gate "
+            "charges to the matrix")
+    package_dirs = ("numeric", "solve", "refine")
+
+    def check(self, tree, source, path, project=None):
+        if project is None:
+            return []
+        out = []
+        for qname, fi in project.functions.items():
+            if fi.path != path:
+                continue
+            flow = _NarrowFlow.for_function(project, fi)
+            flow.run()
+            for key in sorted(flow.casts):
+                info = flow.casts[key]
+                w_from = info["w_from"]
+                src = f"f{w_from}" if w_from else "a compute-width value"
+                msg = (f"implicit downcast: `.astype` narrows {src} to "
+                       f"f{info['w_to']} on a value-carrying array")
+                if info["consumer"] is not None:
+                    tail, line = info["consumer"]
+                    msg += (f" — witness chain: cast at line "
+                            f"{info['node'].lineno} -> consumed by "
+                            f"`{tail}` at line {line}")
+                out.append(self.finding(path, info["node"], msg))
+        return out
+
+
+# --------------------------------------------------------------------------
+# SLU116 — accumulation dtype (source half)
+# --------------------------------------------------------------------------
+
+_ACCUM_CALLS = frozenset({"matmul", "dot", "einsum", "tensordot",
+                          "dot_general", "segment_sum"})
+_JAX_ROOTS = frozenset({"jnp", "jax", "lax"})
+
+
+class AccumulationDtypeRule(Rule):
+    rule_id = RULE_ACCUM_DTYPE
+    title = "unpinned-accumulation-dtype"
+    hint = ("pin the accumulator: pass preferred_element_type (>= the "
+            "widest operand float width) or route through ops/dense.gemm "
+            "— without it a reduced-input GEMM accumulates at the "
+            "backend's whim (bf16 on the MXU), the "
+            "bf16-GEMM-without-f32-accumulation bug")
+    package_dirs = ("numeric", "solve")
+
+    def check(self, tree, source, path, project=None):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            tail = name.rsplit(".", 1)[-1]
+            if tail not in _ACCUM_CALLS:
+                continue
+            root = name.split(".", 1)[0]
+            if root not in _JAX_ROOTS:
+                continue          # host numpy reductions keep f64 anyway
+            if any(kw.arg == "preferred_element_type"
+                   for kw in node.keywords):
+                continue
+            out.append(self.finding(
+                path, node,
+                f"`{name}` without preferred_element_type — the "
+                "accumulation dtype is whatever the backend picks, not "
+                "what the ladder promised"))
+        return out
+
+
+# --------------------------------------------------------------------------
+# SLU117 — EFT purity
+# --------------------------------------------------------------------------
+
+_RAW_OPS = (ast.Add, ast.Sub, ast.Mult)
+_EFT_KERNEL_NAMES = frozenset({"two_sum", "quick_two_sum", "two_prod"})
+_BARRIER_TAILS = frozenset({"_bar", "optimization_barrier"})
+
+
+class _EftFlow(FnFlow):
+    """FnFlow flagging raw +/-/* on df64 pair components."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.hits: dict = {}     # (line, col) -> (node, provenance)
+
+    def visit_stmt(self, st) -> None:
+        for node in ast.walk(st):
+            if not isinstance(node, ast.BinOp) \
+                    or not isinstance(node.op, _RAW_OPS):
+                continue
+            for side in (node.left, node.right):
+                prov = self.taint(side).get(TAINT_EFT)
+                if prov is not None:
+                    key = (node.lineno, node.col_offset)
+                    self.hits.setdefault(key, (node, prov))
+                    break
+
+
+def _is_eft_kernel(fn) -> bool:
+    return fn.name in _EFT_KERNEL_NAMES or fn.name.startswith("_split")
+
+
+def _fence_findings(rule, path, fn) -> list:
+    """BinOps in an EFT kernel body with no optimization_barrier call
+    ancestor — the sequences XLA's reassociation freedoms destroy."""
+    fenced: set = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and dotted_name(node.func).rsplit(
+                ".", 1)[-1] in _BARRIER_TAILS:
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.BinOp):
+                    fenced.add(id(sub))
+    out = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.BinOp) and isinstance(node.op, _RAW_OPS) \
+                and id(node) not in fenced:
+            out.append(rule.finding(
+                path, node,
+                f"unfenced error-free transform in `{fn.name}`: this "
+                "+/-/* has no optimization_barrier ancestor, so XLA may "
+                "re-associate or fuse it and zero the compensation term",
+                hint="wrap every EFT intermediate in "
+                     "jax.lax.optimization_barrier (the ops/df64.py "
+                     "`_bar` discipline)"))
+    return out
+
+
+class EFTPurityRule(Rule):
+    rule_id = RULE_EFT_PURITY
+    title = "eft-purity"
+    hint = ("df64 hi/lo components only mean something under the "
+            "ops/df64.py primitive algebra — use df64_add/df64_mul/... "
+            "(or merge with df64_to_f64 first); raw arithmetic "
+            "re-associates the compensation term and degrades df64 to "
+            "f32")
+    package_dirs = None
+
+    def check(self, tree, source, path, project=None):
+        parts = _norm_parts(path)
+        in_df64 = parts[-1] == "df64.py" and "ops" in parts
+        out = []
+        # half B — fencing of the EFT kernels themselves (runs
+        # everywhere, ops/df64.py very much included)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_eft_kernel(node):
+                out.extend(_fence_findings(self, path, node))
+        # half A — raw arithmetic on pair components (ops/df64.py is the
+        # sanctioned algebra and composes primitives from raw ops)
+        if in_df64 or project is None:
+            return out
+        for qname, fi in project.functions.items():
+            if fi.path != path or _is_eft_kernel(fi.node):
+                continue
+            flow = _EftFlow.for_function(project, fi)
+            flow.run()
+            for key in sorted(flow.hits):
+                node, prov = flow.hits[key]
+                out.append(self.finding(
+                    path, node,
+                    f"raw arithmetic on a df64 pair component ({prov}) "
+                    "outside ops/df64.py"))
+        return out
+
+
+# --------------------------------------------------------------------------
+# SLU118 — tolerance hygiene
+# --------------------------------------------------------------------------
+
+# the tolerance band: narrower than any perf ratio (0.05, 1e-3), wider
+# than underflow guards (1e-300).  Named so the rule never flags itself.
+_TOL_BAND_LO = 1e-18
+_TOL_BAND_HI = 1e-5
+
+_RELATIONAL = (ast.Lt, ast.LtE, ast.Gt, ast.GtE)
+
+
+def _float_lit(node):
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        node = node.operand
+    if isinstance(node, ast.Constant) and isinstance(node.value, float):
+        return node.value
+    return None
+
+
+def _in_band(v) -> bool:
+    return _TOL_BAND_LO < abs(v) <= _TOL_BAND_HI
+
+
+class ToleranceLiteralRule(Rule):
+    rule_id = RULE_TOL_LITERAL
+    title = "ad-hoc-tolerance-literal"
+    hint = ("derive the threshold from utils/tols.py (eps(dtype)*factor "
+            "with provenance): tols.tol(dtype, 2**k, why=...) / "
+            "tols.berr_target(dtype) / the named gate tolerances — a "
+            "bare 1e-N encodes a dtype assumption no reader can audit")
+    package_dirs = None
+
+    def applies(self, path: str) -> bool:
+        # demo drivers mirror the reference's printed residual checks
+        return "examples" not in _norm_parts(path)
+
+    def check(self, tree, source, path, project=None):
+        out = []
+        seen: set = set()
+
+        def flag(lit_node, v, where):
+            key = (lit_node.lineno, lit_node.col_offset)
+            if key in seen:
+                return
+            seen.add(key)
+            out.append(self.finding(
+                path, lit_node,
+                f"float tolerance literal {v!r} in {where} — thresholds "
+                "in the band (1e-18, 1e-5] must come from the central "
+                "dtype-aware model"))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Compare) and any(
+                    isinstance(op, _RELATIONAL) for op in node.ops):
+                for sub in ast.walk(node):
+                    v = _float_lit(sub)
+                    if v is not None and _in_band(v):
+                        flag(sub, v, "a comparison")
+                        if isinstance(sub, ast.UnaryOp):
+                            # the walk will visit the inner Constant
+                            # too — one literal, one finding
+                            seen.add((sub.operand.lineno,
+                                      sub.operand.col_offset))
+            elif isinstance(node, ast.Call):
+                for kw in node.keywords:
+                    if kw.arg not in ("rtol", "atol"):
+                        continue
+                    v = _float_lit(kw.value)
+                    if v is not None and _in_band(v):
+                        flag(kw.value, v, f"an {kw.arg}= keyword")
+        return out
+
+
+# --------------------------------------------------------------------------
+# jaxpr half (SLU115/SLU116 over traced programs) — duck-typed, no jax
+# --------------------------------------------------------------------------
+
+#: float widths by dtype NAME (complex -> component width; float8
+#: handled by prefix below)
+_DTYPE_WIDTHS = {"float64": 64, "complex128": 64,
+                 "float32": 32, "complex64": 32,
+                 "bfloat16": 16, "float16": 16}
+
+#: shape-only plumbing a narrowed value may pass through on its way to
+#: the consuming dot_general (jnp.matmul emits broadcasts/transposes
+#: around the MXU op) — deliberately NO arithmetic primitives
+_TRANSPARENT_PRIMS = frozenset({
+    "broadcast_in_dim", "reshape", "transpose", "squeeze", "slice",
+    "dynamic_slice", "expand_dims", "concatenate", "pad", "copy",
+    "rev", "optimization_barrier", "stop_gradient"})
+
+
+def dtype_width(dtype) -> int | None:
+    """Float width in bits of an aval dtype (None for non-floats)."""
+    name = str(getattr(dtype, "name", dtype))
+    w = _DTYPE_WIDTHS.get(name)
+    if w is None and name.startswith("float8"):
+        return 8
+    return w
+
+
+def _prim_name(eqn) -> str:
+    return getattr(eqn.primitive, "name", str(eqn.primitive))
+
+
+def _var_width(v) -> int | None:
+    return dtype_width(getattr(getattr(v, "aval", None), "dtype", None))
+
+
+def _program_finding(rule: str, spec: ProgramSpec, message: str,
+                     hint: str) -> Finding:
+    return Finding(rule, f"<program:{spec.site}[{spec.label}]>", 0, 1,
+                   message, hint)
+
+
+def _consumer_map(eqns) -> dict:
+    """id(var) -> [consuming eqns].  Keyed by object identity: jaxpr
+    vars are unique objects shared between a producer's outvars and its
+    consumers' invars (and Literals need not be hashable)."""
+    out: dict = {}
+    for eqn in eqns:
+        for v in getattr(eqn, "invars", ()):
+            out.setdefault(id(v), []).append(eqn)
+    return out
+
+
+def _sanctioned_narrow(eqn, consumers) -> bool:
+    """True when every transitive consumer of a narrowing convert
+    (through shape-transparent ops) is a dot_general accumulating at
+    width >= 32 — the shape ops/dense.gemm's bf16 tier emits (inputs
+    cast to bf16, product pinned to f32).  Zero visible consumers (the
+    value escapes this jaxpr) also passes: false-negative-leaning."""
+    work = [id(v) for v in eqn.outvars]
+    seen: set = set()
+    while work:
+        k = work.pop()
+        if k in seen:
+            continue
+        seen.add(k)
+        for c in consumers.get(k, ()):
+            name = _prim_name(c)
+            if name in _TRANSPARENT_PRIMS:
+                work.extend(id(v) for v in c.outvars)
+            elif name == "dot_general":
+                w = _var_width(c.outvars[0])
+                if w is None or w < 32:
+                    return False
+            else:
+                return False
+    return True
+
+
+def audit_narrowing(spec: ProgramSpec):
+    """SLU115 over a traced program: narrowing ``convert_element_type``
+    eqns on non-scalar values outside the sanctioned GEMM input pattern.
+    Returns ``(findings, {n_converts, n_narrowing})``."""
+    eqns = list(iter_eqns(spec.jaxpr))
+    consumers = _consumer_map(eqns)
+    findings = []
+    n_converts = n_narrow = 0
+    for eqn in eqns:
+        if _prim_name(eqn) != "convert_element_type":
+            continue
+        n_converts += 1
+        iv, ov = eqn.invars[0], eqn.outvars[0]
+        w_in, w_out = _var_width(iv), _var_width(ov)
+        if w_in is None or w_out is None or w_out >= w_in:
+            continue
+        if not getattr(getattr(iv, "aval", None), "shape", ()):
+            continue             # scalars are not value-carrying arrays
+        n_narrow += 1
+        if _sanctioned_narrow(eqn, consumers):
+            continue
+        findings.append(_program_finding(
+            RULE_IMPLICIT_DOWNCAST, spec,
+            f"narrowing convert f{w_in}->f{w_out} on shape "
+            f"{tuple(getattr(iv.aval, 'shape', ()))} whose consumers are "
+            "not wide-accumulating dot_generals — the program silently "
+            "discards mantissa bits the ladder never sanctioned",
+            "narrow only as GEMM INPUT with the accumulator pinned >= "
+            "f32 (the ops/dense.gemm bf16-tier shape), or keep the "
+            "value at its compute width"))
+    return findings, {"n_converts": n_converts, "n_narrowing": n_narrow}
+
+
+def audit_accumulation(spec: ProgramSpec):
+    """SLU116 over a traced program: every ``dot_general`` must produce
+    a float width >= the widest float operand, and >= 32 whenever any
+    operand is narrower than 32 bits (16-bit MXU inputs must accumulate
+    at f32).  Returns ``(findings, {n_dot_generals})``."""
+    findings = []
+    n_dots = 0
+    for eqn in iter_eqns(spec.jaxpr):
+        if _prim_name(eqn) != "dot_general":
+            continue
+        n_dots += 1
+        ws = [w for w in (_var_width(v)
+                          for v in getattr(eqn, "invars", ()))
+              if w is not None]
+        if not ws:
+            continue
+        required = max(ws)
+        if min(ws) < 32:
+            required = max(required, 32)
+        w_out = _var_width(eqn.outvars[0])
+        if w_out is not None and w_out < required:
+            findings.append(_program_finding(
+                RULE_ACCUM_DTYPE, spec,
+                f"dot_general accumulates at f{w_out} with operand "
+                f"widths {sorted(set(ws))} — required >= f{required}: "
+                "the bf16-GEMM-without-f32-accumulation bug, caught "
+                "before the program runs instead of by a BERR gate "
+                "three rungs later",
+                "pin preferred_element_type to the accumulator dtype "
+                "(ops/dense.gemm does this on every ladder tier)"))
+    return findings, {"n_dot_generals": n_dots}

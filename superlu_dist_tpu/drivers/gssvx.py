@@ -43,6 +43,7 @@ from superlu_dist_tpu.numeric.plan import build_plan, FactorPlan
 from superlu_dist_tpu.numeric.factor import numeric_factorize, NumericFactorization
 from superlu_dist_tpu.solve.trisolve import lu_solve, lu_solve_trans
 from superlu_dist_tpu.refine.ir import iterative_refinement
+from superlu_dist_tpu.utils import tols
 
 
 @dataclasses.dataclass
@@ -1127,9 +1128,8 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
         lu.berrs = berrs
         report.berr_history = list(berrs)
         report.berr = berrs[-1] if berrs else None
-        eps_w = float(np.finfo(np.dtype(residual_dtype)).eps)
         target = (recovery.berr_target if recovery.berr_target
-                  else 10.0 * eps_w)
+                  else float(tols.berr_target(residual_dtype)))
         report.target = target
         bad = (report.berr is None or report.berr > target
                or not np.all(np.isfinite(np.asarray(x))))
@@ -1162,9 +1162,8 @@ def _solve_and_refine(options: Options, a: SparseCSR, b: np.ndarray,
         # factor_dtype choice deliberately left at factor precision)
         if recovery.enabled and next_gemm_precision(tier0) is not None:
             from superlu_dist_tpu.refine.ir import request_berrs
-            eps_w = float(np.finfo(np.float64).eps)
             target = (recovery.berr_target if recovery.berr_target
-                      else 10.0 * eps_w)
+                      else float(tols.berr_target(np.float64)))
             report.target = target
             try:
                 report.berr = float(request_berrs(op, b, x).max())

@@ -334,7 +334,7 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
             from superlu_dist_tpu.utils.programaudit import maybe_audit
             maybe_audit(
                 "make_factor_fn",
-                f"fused g{len(plan.groups)} {str(dtype)}", jfn,
+                f"fused g{len(plan.groups)} {str(dtype)} {gemm_prec}", jfn,
                 (avals, thresh, *flat_args),
                 mesh_axes=tuple(mesh.axis_names) if mesh is not None
                 else ())

@@ -123,8 +123,10 @@ def _extend_add_kernel(off_ref, slot_ref,          # SMEM (C,) scalars
             # at HIGHEST precision (v·1.0 reconstructs v on the MXU)
             upd = jnp.matmul(
                 oh.T, jnp.matmul(child, oh,
-                                 precision=lax.Precision.HIGHEST),
-                precision=lax.Precision.HIGHEST)
+                                 precision=lax.Precision.HIGHEST,
+                                 preferred_element_type=child.dtype),
+                precision=lax.Precision.HIGHEST,
+                preferred_element_type=child.dtype)
             mask = member[:, None] & member[None, :]
             cur = out_ref[...].reshape(m, m)
             out_ref[...] = jnp.where(mask, cur + upd,

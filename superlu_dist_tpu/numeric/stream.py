@@ -188,8 +188,14 @@ class StreamExecutor:
         # lifetime (they are part of get_executor's cache key, so a
         # changed knob yields a fresh executor — slulint SLU105)
         from superlu_dist_tpu.numeric.pallas_kernels import pallas_mode
-        from superlu_dist_tpu.ops.dense import gemm_precision
+        from superlu_dist_tpu.ops.dense import (gemm_precision,
+                                                resolve_gemm_tier)
         self.gemm_prec = gemm_precision(gemm_prec)
+        # the tier the arithmetic will actually RUN for this dtype
+        # (bf16 degrades to default on complex) — kernel spans report
+        # THIS, never a tier the math didn't use (slulint v5 satellite)
+        self.gemm_prec_resolved = resolve_gemm_tier(self.gemm_prec,
+                                                    self.dtype)
         self.pallas = "off" if mesh is not None else pallas_mode(pallas)
         # granularity="level" traces all bucket groups sharing one
         # schedule wave (Group.level: the elimination level under
@@ -672,6 +678,7 @@ class StreamExecutor:
         tr.complete(f"lu b{b} m{m} w{w} u{u}", "kernel", t0, dt,
                     level=int(level), batch=int(nreal),
                     padded_batch=int(b), m=int(m), w=int(w), u=int(u),
+                    gemm_prec=self.gemm_prec_resolved,
                     host=bool(host), aggregate=bool(aggregate),
                     executed_flops=float(executed),
                     structural_flops=float(structural),

@@ -112,6 +112,20 @@ def next_gemm_precision(tier: str, backend: str | None = None) -> str | None:
     return GEMM_PREC_LADDER[i + 1]
 
 
+def resolve_gemm_tier(prec: str, dtype) -> str:
+    """The tier :func:`gemm` will actually RUN for ``dtype`` operands.
+
+    One degrade exists: complex operands have no bf16 carrier, so the
+    ``bf16`` tier resolves to ``default`` instead of silently dropping
+    imaginary precision.  Callers that record or escalate the tier
+    (kernel spans, the BERR ladder) must report THIS value — a trace
+    must never show a tier the arithmetic didn't use."""
+    if prec == "bf16" and jnp.issubdtype(jnp.result_type(dtype),
+                                         jnp.complexfloating):
+        return "default"
+    return prec
+
+
 def gemm(a, b, prec: str = "highest"):
     """One ladder-tier batched matmul: the single matmul wrapper every
     Schur-update GEMM in the factor path (and the blocked-TRSM
@@ -121,21 +135,23 @@ def gemm(a, b, prec: str = "highest"):
     every tier, so reduced-INPUT GEMMs still accumulate at f32 (or the
     operands' own width) — the mixed-precision contract the BERR gate
     assumes.  The bf16 tier casts real inputs to bfloat16 and casts the
-    f32-accumulated product back; complex operands have no bf16 carrier
-    and degrade to the ``default`` tier instead of silently dropping
-    imaginary precision."""
+    f32-accumulated product back; complex operands degrade per
+    :func:`resolve_gemm_tier` (asserted, not silently assumed)."""
     out_dt = jnp.result_type(a.dtype, b.dtype)
     # 16-bit-float factor dtypes still accumulate at f32 — pinning the
     # accumulator to bf16 would be a silent accuracy regression
     acc_dt = (jnp.float32 if out_dt in (jnp.bfloat16, jnp.float16)
               else out_dt)
-    if prec == "bf16" and not jnp.issubdtype(out_dt, jnp.complexfloating):
+    tier = resolve_gemm_tier(prec, out_dt)
+    if tier == "bf16":
+        assert not jnp.issubdtype(out_dt, jnp.complexfloating), \
+            "bf16 tier on complex operands must resolve to 'default'"
         r = jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
                        precision=lax.Precision.DEFAULT,
                        preferred_element_type=jnp.float32)
         return r.astype(out_dt)
-    p = _TIER_LAX["default" if prec == "bf16" else prec]
-    r = jnp.matmul(a, b, precision=p, preferred_element_type=acc_dt)
+    r = jnp.matmul(a, b, precision=_TIER_LAX[tier],
+                   preferred_element_type=acc_dt)
     return r.astype(out_dt) if acc_dt != out_dt else r
 
 

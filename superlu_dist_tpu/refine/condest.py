@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import numpy as np
 
+from superlu_dist_tpu.utils import tols
+
 
 def onenormest(n: int, apply, apply_adj, dtype=np.float64,
                itmax: int = 5) -> float:
@@ -50,7 +52,8 @@ def onenormest(n: int, apply, apply_adj, dtype=np.float64,
             xi = np.where(y >= 0, 1.0, -1.0)
         z = np.asarray(apply_adj(xi.astype(dtype)))
         j = int(np.argmax(np.abs(z)))
-        if np.abs(z[j]) <= np.real(z @ np.conj(x)) * (1 + 1e-12):
+        if np.abs(z[j]) <= np.real(z @ np.conj(x)) * (
+                1 + float(tols.ONENORMEST_SLACK)):
             break           # converged: the subgradient test (dlacon.f:130)
         if j == j_old:
             break           # 2-cycle: e_j would repeat the last iterate

@@ -17,6 +17,7 @@ from __future__ import annotations
 import numpy as np
 
 from superlu_dist_tpu.sparse.formats import SparseCSR
+from superlu_dist_tpu.utils import tols
 
 ITMAX = 20
 
@@ -30,7 +31,7 @@ def componentwise_berr(r: np.ndarray, den: np.ndarray, nnz: int,
     denominator is not rounded up to 1 (which understates berr).  The ONE
     implementation shared by the serial loop here and the distributed
     loop (parallel/pgsrfs.py) — the two must never drift."""
-    safmin = float(np.finfo(np.dtype(residual_dtype)).tiny)
+    safmin = tols.safmin(residual_dtype)
     bump = (nnz + 1) * safmin
     den = np.where(den <= bump, den + bump, den)
     return float(np.max(np.abs(r) / den))
@@ -129,7 +130,7 @@ def iterative_refinement(a: SparseCSR, b: np.ndarray, x: np.ndarray,
         work = (np.complex64 if np.issubdtype(work, np.complexfloating)
                 else np.float32)
     x2 = (x[:, None] if squeeze else x).astype(work, copy=True)
-    eps = float(np.finfo(residual_dtype).eps)
+    eps = tols.eps(residual_dtype)
     nrhs = b2.shape[1]
     berrs = []
     # per-RHS stopping state, like the reference's outer loop over RHS

@@ -123,14 +123,18 @@ def _fwd_body(lpanel, x, lsum, first, rows, ws, w, u, n, use_inv, linv,
     rhs = (x.at[cols].get(mode="fill", fill_value=0)
            - lsum.at[cols].get(mode="fill", fill_value=0))
     if use_inv:
-        y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST)
+        # same-dtype preferred_element_type pins are no-ops bitwise —
+        # they make the accumulation width explicit (slulint SLU116)
+        y = jnp.matmul(linv, rhs, precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=rhs.dtype)
     else:
         y = _trsm(lpanel[:, :w, :w], rhs, lower=True, unit=True,
                   trans=0, leaf=leaf, prec=prec)
     x = x.at[cols].set(y, mode="drop")
     if u:
         contrib = jnp.matmul(lpanel[:, w:, :], y,
-                             precision=jax.lax.Precision.HIGHEST)
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=y.dtype)
         lsum = lsum.at[rows].add(contrib, mode="drop")
     return x, lsum
 
@@ -145,9 +149,11 @@ def _bwd_body(lpanel, upanel, x, first, rows, ws, w, u, n, use_inv, uinv,
     if u:
         xr = x.at[rows].get(mode="fill", fill_value=0)   # (B, u, nrhs)
         rhs = rhs - jnp.matmul(upanel, xr,
-                               precision=jax.lax.Precision.HIGHEST)
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=xr.dtype)
     if use_inv:
-        y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST)
+        y = jnp.matmul(uinv, rhs, precision=jax.lax.Precision.HIGHEST,
+                       preferred_element_type=rhs.dtype)
     else:
         y = _trsm(lpanel[:, :w, :w], rhs, lower=False, unit=False,
                   trans=0, leaf=leaf, prec=prec)
@@ -173,7 +179,8 @@ def _fwd_body_trans(lpanel, upanel, x, lsum, first, rows, ws, w, u, n,
     if u:
         u12 = upanel.conj() if conj else upanel       # (B, w, u)
         contrib = jnp.matmul(jnp.swapaxes(u12, 1, 2), y,
-                             precision=jax.lax.Precision.HIGHEST)
+                             precision=jax.lax.Precision.HIGHEST,
+                             preferred_element_type=y.dtype)
         lsum = lsum.at[rows].add(contrib, mode="drop")
     return x, lsum
 
@@ -191,7 +198,8 @@ def _bwd_body_trans(lpanel, x, first, rows, ws, w, u, n, conj, leaf,
         if conj:
             l21 = l21.conj()
         rhs = rhs - jnp.matmul(jnp.swapaxes(l21, 1, 2), xr,
-                               precision=jax.lax.Precision.HIGHEST)
+                               precision=jax.lax.Precision.HIGHEST,
+                               preferred_element_type=xr.dtype)
     l11 = lpanel[:, :w, :w]
     if conj:
         l11 = l11.conj()

@@ -491,6 +491,33 @@ class ProgramAuditError(SuperLUError):
         _flight_dump(self)
 
 
+class PrecisionAuditError(SuperLUError):
+    """Precision-audit mode (``SLU_TPU_VERIFY_DTYPES=1``, slulint's v5
+    precision rules SLU115/SLU116 — ``utils/programaudit.py``) rejected
+    a jitted program at construction/AOT-stage time: a narrowing
+    ``convert_element_type`` discards mantissa bits outside the
+    sanctioned GEMM-input pattern (SLU115), or a ``dot_general``
+    accumulates narrower than its widest operand / narrower than f32 on
+    16-bit inputs (SLU116) — the arithmetic running at a precision the
+    escalation ladder never sanctioned, caught BEFORE the program runs
+    instead of by a BERR gate three rungs later.  ``findings`` holds the
+    slulint Finding records; dumps a flight-recorder postmortem at
+    construction."""
+
+    def __init__(self, site: str, program: str, findings):
+        self.site = site
+        self.program = program
+        self.findings = list(findings)
+        self.rules = sorted({f.rule for f in self.findings})
+        lines = "; ".join(f"{f.rule}: {f.message}" for f in self.findings)
+        super().__init__(
+            f"precision audit failed for {site}[{program}] "
+            f"({', '.join(self.rules)}): {lines} "
+            "(SLU_TPU_VERIFY_DTYPES=1 — docs/ANALYSIS.md catalogs the "
+            "precision rules)")
+        _flight_dump(self)
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

@@ -240,6 +240,16 @@ def _register_all() -> None:
       "program runs; feeds slu_program_audit_total and the compile "
       "census's donation-coverage / baked-const-bytes fields",
       group="parallel")
+    r("SLU_TPU_VERIFY_DTYPES", "flag", False,
+      "precision-audit mode (utils/programaudit.py): every jitted "
+      "program the executors build is additionally walked against the "
+      "slulint v5 precision rules — SLU115 narrowing converts outside "
+      "the sanctioned GEMM-input pattern, SLU116 dot_general "
+      "accumulation width below the widest operand (or below f32 on "
+      "16-bit inputs) — raising PrecisionAuditError before the program "
+      "runs; feeds slu_precision_audit_total and `label#dtypes` census "
+      "audit notes.  Independent of SLU_TPU_VERIFY_PROGRAMS",
+      group="parallel")
     r("SLU_TPU_VERIFY_LOCKS", "flag", False,
       "lock-order verify mode (utils/lockwatch.py): instrument every "
       "make_lock/make_condition lock, record per-thread acquisition "

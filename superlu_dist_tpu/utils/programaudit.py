@@ -17,6 +17,15 @@ the bench row) plus the ``slu_program_audit_total`` metric.
 Off path (knob unset): :func:`get_auditor` returns ``None`` without
 allocating ANY auditor state — one env read per build site, nothing
 else (asserted by ``scripts/check_verify_overhead.py``).
+
+The v5 precision twin (``SLU_TPU_VERIFY_DTYPES=1``) rides the same
+``maybe_audit`` hook with its own singleton: every submitted program is
+additionally walked for narrowing converts and un-pinned accumulation
+dtypes (SLU115/SLU116, ``analysis/rules_precision.py``) and a finding
+raises :class:`PrecisionAuditError` before the program runs.  The two
+knobs are independent — either, both, or neither; census notes are keyed
+``label#dtypes`` so the program-audit coverage accounting never double-
+counts, and the off path allocates nothing, same contract.
 """
 
 from __future__ import annotations
@@ -31,6 +40,7 @@ DONATE_MIN_BYTES = 1 << 20
 CONST_MAX_BYTES = 1 << 18
 
 _AUDITOR = None
+_DTYPE_AUDITOR = None
 
 
 def get_auditor():
@@ -44,10 +54,22 @@ def get_auditor():
     return _AUDITOR
 
 
+def get_dtype_auditor():
+    """The process-wide PRECISION auditor, or None (allocating nothing)
+    when ``SLU_TPU_VERIFY_DTYPES`` is off."""
+    global _DTYPE_AUDITOR
+    if not env_flag("SLU_TPU_VERIFY_DTYPES"):
+        return None
+    if _DTYPE_AUDITOR is None:
+        _DTYPE_AUDITOR = DtypeAuditor()
+    return _DTYPE_AUDITOR
+
+
 def _reset() -> None:
-    """Test hygiene: drop the singleton so a knob flip re-latches."""
-    global _AUDITOR
+    """Test hygiene: drop the singletons so a knob flip re-latches."""
+    global _AUDITOR, _DTYPE_AUDITOR
     _AUDITOR = None
+    _DTYPE_AUDITOR = None
 
 
 def find_build_site(site: str) -> str | None:
@@ -121,11 +143,62 @@ class ProgramAuditor:
         return stats
 
 
+class DtypeAuditor:
+    """The SLU115/SLU116 precision twin: audits each (site, label)
+    program once for narrowing converts and un-pinned accumulation
+    dtypes, memoized like :class:`ProgramAuditor`.  Separate singleton
+    so either knob works alone (both on double-traces each program — an
+    accepted one-time cost at construction)."""
+
+    def __init__(self):
+        self.audited: dict = {}     # (site, label) -> stats dict
+        self.findings: list = []    # every finding ever raised (evidence)
+
+    def submit(self, site: str, label: str, fn, args, *, dead=(),
+               donated=None, mesh_axes=()) -> dict:
+        """Trace + precision-audit one program; raises
+        PrecisionAuditError on any finding, returns the stats dict when
+        clean."""
+        key = (site, label)
+        hit = self.audited.get(key)
+        if hit is not None:
+            return hit
+        from superlu_dist_tpu.analysis.program import (audit_dtypes,
+                                                       trace_spec)
+        spec = trace_spec(fn, args, label=label, site=site, dead=dead,
+                          donated=donated, mesh_axes=mesh_axes)
+        findings, stats = audit_dtypes(spec)
+        from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+        # keyed off the program label so the SLU111 coverage accounting
+        # (audit_block counts programs = len(notes)) never double-counts
+        COMPILE_STATS.audit_note(site, f"{label}#dtypes", stats)
+        from superlu_dist_tpu.obs.metrics import get_metrics
+        m = get_metrics()
+        if m.enabled:
+            m.inc("slu_precision_audit_total", 1.0, site=site,
+                  result="finding" if findings else "clean")
+        if findings:
+            self.findings.extend(findings)
+            from superlu_dist_tpu.utils.errors import PrecisionAuditError
+            raise PrecisionAuditError(site=site, program=label,
+                                      findings=findings)
+        self.audited[key] = stats
+        return stats
+
+
 def maybe_audit(site: str, label: str, fn, args, *, dead=(),
                 donated=None, mesh_axes=()) -> dict | None:
-    """One-line build-site hook: no-op (no state) when the knob is off."""
+    """One-line build-site hook: no-op (no state) when both knobs are
+    off.  Runs the SLU111/112/114 auditor first, then the precision
+    twin; each memoizes independently."""
     aud = get_auditor()
-    if aud is None:
-        return None
-    return aud.submit(site, label, fn, args, dead=dead, donated=donated,
-                      mesh_axes=mesh_axes)
+    out = None
+    if aud is not None:
+        out = aud.submit(site, label, fn, args, dead=dead,
+                         donated=donated, mesh_axes=mesh_axes)
+    daud = get_dtype_auditor()
+    if daud is not None:
+        stats = daud.submit(site, label, fn, args, dead=dead,
+                            donated=donated, mesh_axes=mesh_axes)
+        out = out if out is not None else stats
+    return out

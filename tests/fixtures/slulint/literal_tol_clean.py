@@ -1,0 +1,22 @@
+"""SLU118 clean-negative fixture: thresholds minted by utils/tols.py
+(eps(dtype) x factor with provenance), out-of-band literals (exact
+structural constants, overflow guards), and non-relational uses of
+in-band floats are all fine."""
+import numpy as np
+
+from superlu_dist_tpu.utils import tols
+
+
+def gate(res):
+    return res < tols.RESID_GATE           # derived threshold
+
+
+def structural(k, x):
+    if k > 0.5:                            # out of band: not a tolerance
+        return x / max(x, 1e-30)           # out of band (underflow guard)
+    return x * 1e-9                        # in band but not compared
+
+
+def close(x, ref):
+    np.testing.assert_allclose(x, ref, rtol=tols.DEVICE_VS_HOST_RTOL,
+                               atol=tols.DEVICE_VS_HOST_ATOL)

@@ -1,0 +1,18 @@
+"""SLU118 true-positive fixture (tolerance hygiene): ad-hoc float
+comparison literals in the eps-scale band, including a negated literal
+and rtol=/atol= keyword thresholds — each silently encodes a dtype
+assumption utils/tols.py exists to make explicit."""
+import numpy as np
+
+
+def gate(res):
+    return res < 1e-8                      # flagged: comparison literal
+
+
+def drift(delta):
+    return -1e-10 <= delta                 # flagged: negated literal
+
+
+def close(x, ref):
+    np.testing.assert_allclose(x, ref, rtol=1e-9,   # flagged: rtol
+                               atol=1e-12)          # flagged: atol

@@ -1,0 +1,16 @@
+"""SLU115 clean-negative fixture: widening casts and same-width
+re-binds never narrow; a bf16 cast inside the sanctioned GEMM helper
+(ops/dense.gemm is the ONE place the bf16 tier may narrow inputs) is
+out of this rule's package scope by construction."""
+import jax.numpy as jnp
+
+
+def widen(panel, piv):
+    p64 = panel.astype(jnp.float64)        # widening: never flagged
+    return jnp.matmul(p64, piv, preferred_element_type=jnp.float64)
+
+
+def rebind(vals, sel):
+    v = vals.astype(jnp.float32)
+    w = v.astype(jnp.float32)              # same width: not a downcast
+    return jnp.dot(w, sel, preferred_element_type=jnp.float32)

@@ -1,0 +1,18 @@
+"""SLU115 true-positive fixture (implicit downcast): a value-carrying
+f32 panel is narrowed to bf16 and the narrowed array feeds a GEMM — the
+compute dtype silently lost bits on the way to the MXU.  The witness
+chain in the finding names both the cast line and the consuming call."""
+import jax.numpy as jnp
+
+
+def schur_update(panel, piv):
+    p32 = panel.astype(jnp.float32)
+    lo = p32.astype(jnp.bfloat16)          # flagged: f32 -> bf16
+    return jnp.matmul(lo, piv, preferred_element_type=jnp.float32)
+
+
+def half_entry(vals, sel):
+    # flagged even with no visible provenance: a 16-bit target is a
+    # presumed downcast of the compute dtype
+    return jnp.dot(vals.astype(jnp.float16), sel,
+                   preferred_element_type=jnp.float32)

@@ -1,0 +1,19 @@
+"""SLU116 clean-negative fixture: every matmul-family call pins its
+accumulation dtype explicitly; host-side numpy contractions have no
+accumulation-dtype freedom and are exempt."""
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+
+def schur(l21, u12):
+    return jnp.matmul(l21, u12, preferred_element_type=l21.dtype)
+
+
+def gather_sum(oh, child):
+    return lax.dot_general(oh, child, (((1,), (0,)), ((), ())),
+                           preferred_element_type=jnp.float32)
+
+
+def host_side(a, b):
+    return np.matmul(a, b)                 # numpy: accumulates wide

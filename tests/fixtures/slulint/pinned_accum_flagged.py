@@ -1,0 +1,20 @@
+"""SLU116 true-positive fixture (accumulation dtype): matmul-family
+calls without ``preferred_element_type`` leave the accumulation width
+to whatever the backend picks — on TPU that can be bf16 partials for
+16-bit inputs, silently costing the Schur updates their f32 sums."""
+import jax.numpy as jnp
+from jax import lax
+
+
+def schur(l21, u12):
+    return jnp.matmul(l21, u12)            # flagged: no pin
+
+
+def gather_sum(oh, child):
+    return lax.dot_general(oh, child,      # flagged: no pin
+                           (((1,), (0,)), ((), ())))
+
+
+def fold(vals, seg):
+    import jax
+    return jax.ops.segment_sum(vals, seg)  # flagged: no pin

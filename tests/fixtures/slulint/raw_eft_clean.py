@@ -1,0 +1,18 @@
+"""SLU117 clean-negative fixture: df64 pairs flow only through the
+ops/df64 primitives (merge via df64_to_f64, arithmetic via df64_*), and
+a local two_sum fences every compensation op behind the barrier alias —
+the shape ops/df64.py itself uses."""
+from superlu_dist_tpu.ops.df64 import df64_add, df64_mul, df64_to_f64
+
+
+def combine(xh, xl, yh, yl):
+    sh, sl = df64_add(xh, xl, yh, yl)
+    ph, pl = df64_mul(sh, sl, yh, yl)
+    return df64_to_f64(ph, pl)             # sanctioned merge
+
+
+def two_sum(a, b):
+    from jax.lax import optimization_barrier as _bar
+    s = _bar(a + b)
+    bb = _bar(s - a)
+    return s, _bar((a - bb) + (b - bb))

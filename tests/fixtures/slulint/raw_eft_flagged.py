@@ -1,0 +1,21 @@
+"""SLU117 true-positive fixture (EFT purity): raw +/-/* on the hi/lo
+components a two_sum/df64 primitive returned, outside ops/df64.py —
+exactly the reassociation-bait the optimization_barrier fences exist to
+prevent; and a fixture-local two_sum whose compensation arithmetic is
+not fenced at all."""
+from superlu_dist_tpu.ops.df64 import df64_add, two_sum
+
+
+def leak(xh, xl, yh, yl):
+    sh, sl = df64_add(xh, xl, yh, yl)
+    return sh + sl                         # flagged: raw add on pair
+
+
+def drift(a, b):
+    hi, lo = two_sum(a, b)
+    return hi * 2.0 - lo                   # flagged: raw mul and sub
+
+
+def quick_two_sum(a, b):                   # unfenced EFT kernel
+    s = a + b                              # flagged: no barrier
+    return s, b - (s - a)                  # flagged: both subtractions

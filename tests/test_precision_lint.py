@@ -1,0 +1,388 @@
+"""slulint v5 precision-flow suite (docs/ANALYSIS.md).
+
+Per-rule fixture pairs for the source rules (SLU115 implicit downcast
+with its witness chain, SLU116 accumulation-dtype pins, SLU117 EFT
+purity both halves, SLU118 tolerance hygiene), the jaxpr twins over
+real traced programs (sanctioned vs unsanctioned narrowing, pinned vs
+unpinned dot_general), the ``SLU_TPU_VERIFY_DTYPES=1`` runtime auditor
+(raise-before-run with flight-recorder postmortem, census ``#dtypes``
+notes, off-path no-state), the utils/tols eps-model round trip
+(including df64), and the complex-operand bf16 GEMM-tier degrade.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from superlu_dist_tpu.analysis.core import analyze_sources
+from superlu_dist_tpu.analysis.program import trace_spec, audit_dtypes
+from superlu_dist_tpu.analysis import rules_precision as rp
+from superlu_dist_tpu.utils import programaudit, tols
+from superlu_dist_tpu.utils.errors import PrecisionAuditError
+
+pytestmark = pytest.mark.preclint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "slulint")
+
+
+def _scan(name):
+    path = os.path.join("tests", "fixtures", "slulint", name)
+    with open(os.path.join(REPO, path)) as f:
+        return analyze_sources({path: f.read()})
+
+
+@pytest.fixture
+def fresh_dtype_auditor(monkeypatch):
+    """SLU_TPU_VERIFY_DTYPES=1 with fresh auditors + clean census audit
+    notes, restored afterwards."""
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+    monkeypatch.delenv("SLU_TPU_VERIFY_PROGRAMS", raising=False)
+    monkeypatch.setenv("SLU_TPU_VERIFY_DTYPES", "1")
+    programaudit._reset()
+    with COMPILE_STATS._lock:
+        saved = dict(COMPILE_STATS._audits)
+        COMPILE_STATS._audits = {}
+    yield
+    programaudit._reset()
+    with COMPILE_STATS._lock:
+        COMPILE_STATS._audits = saved
+
+
+# --------------------------------------------------------------------------
+# SLU115 implicit downcast (source)
+# --------------------------------------------------------------------------
+
+def test_slu115_fixture_flagged_with_witness_chain():
+    hits = [f for f in _scan("narrowing_cast_flagged.py")
+            if f.rule == "SLU115"]
+    assert len(hits) == 2, hits
+    chained = [f for f in hits if "witness chain" in f.message]
+    assert chained, hits
+    # the chain names BOTH ends: the cast line and the consuming call
+    assert "cast at line" in chained[0].message
+    assert "`matmul`" in chained[0].message
+    # the provenance-free 16-bit cast is flagged too (presumed downcast)
+    assert any("f16" in f.message for f in hits)
+
+
+def test_slu115_fixture_clean():
+    assert [f for f in _scan("narrowing_cast_clean.py")
+            if f.rule == "SLU115"] == []
+
+
+# --------------------------------------------------------------------------
+# SLU116 accumulation dtype (source)
+# --------------------------------------------------------------------------
+
+def test_slu116_fixture_flagged():
+    hits = [f for f in _scan("pinned_accum_flagged.py")
+            if f.rule == "SLU116"]
+    assert len(hits) == 3, hits          # matmul, dot_general, segment_sum
+    assert all("preferred_element_type" in f.message for f in hits)
+
+
+def test_slu116_fixture_clean():
+    assert [f for f in _scan("pinned_accum_clean.py")
+            if f.rule == "SLU116"] == []
+
+
+# --------------------------------------------------------------------------
+# SLU117 EFT purity (source, both halves)
+# --------------------------------------------------------------------------
+
+def test_slu117_fixture_flagged():
+    hits = [f for f in _scan("raw_eft_flagged.py") if f.rule == "SLU117"]
+    raw = [f for f in hits if "raw arithmetic" in f.message]
+    fence = [f for f in hits if "unfenced" in f.message]
+    # half A: sh+sl, and hi*2.0-lo (taint flows through the nested
+    # BinOp; the two ops share a position, so one finding)
+    assert len(raw) == 2, hits
+    assert any("two_sum" in f.message or "df64_add" in f.message
+               for f in raw)
+    # half B: the unfenced local quick_two_sum (s=a+b, s-a, b-(...))
+    assert len(fence) >= 3, hits
+    assert all("quick_two_sum" in f.message for f in fence)
+
+
+def test_slu117_fixture_clean():
+    assert [f for f in _scan("raw_eft_clean.py")
+            if f.rule == "SLU117"] == []
+
+
+# --------------------------------------------------------------------------
+# SLU118 tolerance hygiene (source)
+# --------------------------------------------------------------------------
+
+def test_slu118_fixture_flagged():
+    hits = [f for f in _scan("literal_tol_flagged.py")
+            if f.rule == "SLU118"]
+    # 1e-8 comparison, negated -1e-10, rtol=1e-9, atol=1e-12
+    assert len(hits) == 4, hits
+    assert all("utils/tols" in (f.message + (f.hint or ""))
+               for f in hits)
+
+
+def test_slu118_fixture_clean():
+    assert [f for f in _scan("literal_tol_clean.py")
+            if f.rule == "SLU118"] == []
+
+
+def test_slu118_suppression_honored():
+    src = "def gate(res):\n"
+    src += "    return res < 1e-8  # slulint: disable=SLU118\n"
+    assert analyze_sources({"scripts/x.py": src}) == []
+
+
+# --------------------------------------------------------------------------
+# jaxpr twins: audit_narrowing / audit_accumulation over traced programs
+# --------------------------------------------------------------------------
+
+def test_audit_narrowing_flags_unsanctioned_convert():
+    f = jax.jit(lambda x: x.astype(jnp.bfloat16) + 1.0)
+    spec = trace_spec(f, (np.ones((8, 8), np.float32),),
+                      label="narrow", site="test")
+    findings, stats = rp.audit_narrowing(spec)
+    assert [x.rule for x in findings] == ["SLU115"]
+    assert stats["n_narrowing"] >= 1
+    assert "f32->f16" in findings[0].message
+
+
+def test_audit_narrowing_sanctioned_gemm_input_clean():
+    # the ops/dense.gemm bf16-tier shape: narrowed inputs are fine when
+    # every consumer is a dot_general accumulating at >= f32
+    def g(a, b):
+        return jnp.matmul(a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+                          preferred_element_type=jnp.float32)
+    spec = trace_spec(jax.jit(g),
+                      (np.ones((8, 8), np.float32),
+                       np.ones((8, 8), np.float32)),
+                      label="gemm-in", site="test")
+    findings, stats = rp.audit_narrowing(spec)
+    assert findings == [], findings
+    assert stats["n_narrowing"] >= 2      # counted, but sanctioned
+
+
+def test_audit_accumulation_flags_unpinned_bf16_dot():
+    def g(a, b):
+        return lax.dot_general(a.astype(jnp.bfloat16),
+                               b.astype(jnp.bfloat16),
+                               (((1,), (0,)), ((), ())))
+    spec = trace_spec(jax.jit(g),
+                      (np.ones((8, 8), np.float32),
+                       np.ones((8, 8), np.float32)),
+                      label="unpinned", site="test")
+    findings, stats = rp.audit_accumulation(spec)
+    assert [x.rule for x in findings] == ["SLU116"]
+    assert stats["n_dot_generals"] == 1
+    assert "required >= f32" in findings[0].message
+
+
+def test_audit_accumulation_pinned_twin_clean():
+    def g(a, b):
+        return lax.dot_general(a.astype(jnp.bfloat16),
+                               b.astype(jnp.bfloat16),
+                               (((1,), (0,)), ((), ())),
+                               preferred_element_type=jnp.float32)
+    spec = trace_spec(jax.jit(g),
+                      (np.ones((8, 8), np.float32),
+                       np.ones((8, 8), np.float32)),
+                      label="pinned", site="test")
+    findings, _ = rp.audit_accumulation(spec)
+    assert findings == []
+
+
+def test_audit_dtypes_merges_both_rule_stats():
+    f = jax.jit(lambda a, b: jnp.matmul(a, b,
+                                        preferred_element_type=a.dtype))
+    spec = trace_spec(f, (np.ones((4, 4)), np.ones((4, 4))),
+                      label="clean", site="test")
+    findings, stats = audit_dtypes(spec)
+    assert findings == []
+    assert stats["findings"] == 0
+    assert stats["n_dot_generals"] == 1
+    assert "n_converts" in stats
+
+
+# --------------------------------------------------------------------------
+# runtime twin: SLU_TPU_VERIFY_DTYPES=1
+# --------------------------------------------------------------------------
+
+def test_runtime_auditor_raises_before_run(fresh_dtype_auditor, tmp_path,
+                                           monkeypatch):
+    from superlu_dist_tpu.obs import flightrec
+    monkeypatch.setenv("SLU_TPU_FLIGHTREC", str(tmp_path / "fr-%p.json"))
+    flightrec._reset()
+    ran = []
+
+    def bad(x):
+        ran.append(True)      # traced once; never EXECUTED by the audit
+        return x.astype(jnp.bfloat16) + 1.0
+
+    try:
+        with pytest.raises(PrecisionAuditError) as ei:
+            programaudit.maybe_audit("test.site", "bad", jax.jit(bad),
+                                     (np.ones((8, 8), np.float32),))
+        err = ei.value
+        assert err.rules == ["SLU115"]
+        assert err.site == "test.site" and err.program == "bad"
+        assert "SLU_TPU_VERIFY_DTYPES" in str(err)
+        # flight-recorder postmortem dumped at construction
+        assert err.flightrec_dump and os.path.exists(err.flightrec_dump)
+        doc = json.load(open(err.flightrec_dump))
+        assert doc["reason"] == "PrecisionAuditError"
+        # the failing program was NOT memoized as audited-clean
+        aud = programaudit.get_dtype_auditor()
+        assert ("test.site", "bad") not in aud.audited
+        assert aud.findings and aud.findings[0].rule == "SLU115"
+    finally:
+        flightrec._reset()
+
+
+def test_runtime_auditor_clean_program_memoized(fresh_dtype_auditor):
+    from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+    f = jax.jit(lambda a, b: jnp.matmul(a, b,
+                                        preferred_element_type=a.dtype))
+    args = (np.ones((4, 4)), np.ones((4, 4)))
+    s1 = programaudit.maybe_audit("test.site", "clean", f, args)
+    assert s1["findings"] == 0
+    aud = programaudit.get_dtype_auditor()
+    assert ("test.site", "clean") in aud.audited
+    # memoized: a second submit returns the same stats, no re-trace
+    s2 = aud.submit("test.site", "clean", None, None)
+    assert s2 is s1
+    # census note lands under the #dtypes-suffixed label, so SLU111
+    # coverage accounting (programs == len(notes)) never double-counts
+    assert ("test.site", "clean#dtypes") in COMPILE_STATS._audits
+    blk = COMPILE_STATS.audit_block()
+    assert blk["programs"] == 1 and blk["findings"] == 0
+
+
+def test_dtype_off_path_allocates_nothing(monkeypatch):
+    monkeypatch.delenv("SLU_TPU_VERIFY_DTYPES", raising=False)
+    monkeypatch.delenv("SLU_TPU_VERIFY_PROGRAMS", raising=False)
+    programaudit._reset()
+    f = jax.jit(lambda x: x.astype(jnp.bfloat16) + 1.0)  # would flag
+    out = programaudit.maybe_audit("test.site", "off", f,
+                                   (np.ones((8, 8), np.float32),))
+    assert out is None
+    assert programaudit._DTYPE_AUDITOR is None
+    assert programaudit.get_dtype_auditor() is None
+
+
+# --------------------------------------------------------------------------
+# utils/tols: the eps(dtype) x factor model
+# --------------------------------------------------------------------------
+
+def test_eps_round_trip_per_dtype():
+    for dt in (np.float64, np.float32, np.float16):
+        assert tols.eps(dt) == float(np.finfo(dt).eps)
+        assert tols.safmin(dt) == float(np.finfo(dt).tiny)
+    # complex resolves to the component float
+    assert tols.eps(np.complex128) == tols.eps(np.float64)
+    assert tols.eps(np.complex64) == tols.eps(np.float32)
+    # the emulated double-float pair formats and the MXU input dtypes
+    assert tols.eps("df64") == 2.0 ** -48
+    assert tols.eps("zdf64") == 2.0 ** -48
+    assert tols.eps("bfloat16") == 2.0 ** -8
+    assert tols.safmin("df64") == float(np.finfo(np.float32).tiny)
+    with pytest.raises(TypeError):
+        tols.eps(np.int32)
+
+
+def test_tolerance_carries_provenance():
+    t = tols.tol("float64", 2 ** 10, "unit test")
+    assert isinstance(t, float)
+    assert float(t) == 1024.0 * float(np.finfo(np.float64).eps)
+    assert t.factor == 1024.0 and t.dtype == "float64"
+    assert "1024*eps(float64)" in t.describe()
+    assert "unit test" in repr(t)
+
+
+def test_berr_target_matches_the_driver_gate():
+    # bitwise the 10*eps the drivers/gssvx gate used to mint by hand
+    assert float(tols.berr_target(np.float64)) == \
+        10.0 * float(np.finfo(np.float64).eps)
+    assert float(tols.berr_target(np.float32)) == \
+        10.0 * float(np.finfo(np.float32).eps)
+
+
+def test_named_gates_cover_the_migrated_literals():
+    # each migration loosened-or-held its literal: no gate got stricter
+    # by surprise (DEVICE_VS_HOST_RTOL is deliberately ~7% tighter)
+    assert float(tols.RESID_GATE) > 1e-8
+    assert float(tols.RESID_GATE_TIGHT) > 1e-10
+    assert float(tols.SCHEDULE_DRIFT_RTOL) > 1e-11
+    assert float(tols.SCHEDULE_DRIFT_ATOL) > 1e-13
+    for t in (tols.RESID_GATE, tols.RESID_GATE_TIGHT,
+              tols.SCHEDULE_DRIFT_RTOL, tols.DEVICE_VS_HOST_RTOL,
+              tols.ONENORMEST_SLACK):
+        assert t.dtype == "float64" and t.why
+        # power-of-two factors: an explicit ulp budget
+        assert t.factor == 2.0 ** round(np.log2(t.factor))
+
+
+# --------------------------------------------------------------------------
+# ops/dense: complex operands degrade the bf16 tier (asserted, recorded)
+# --------------------------------------------------------------------------
+
+def test_resolve_gemm_tier():
+    from superlu_dist_tpu.ops.dense import resolve_gemm_tier
+    assert resolve_gemm_tier("bf16", "complex64") == "default"
+    assert resolve_gemm_tier("bf16", "complex128") == "default"
+    assert resolve_gemm_tier("bf16", "float32") == "bf16"
+    assert resolve_gemm_tier("f32", "complex128") == "f32"
+    assert resolve_gemm_tier("highest", "float64") == "highest"
+
+
+def test_gemm_complex_bf16_degrades_to_default():
+    from superlu_dist_tpu.ops.dense import gemm
+    rng = np.random.default_rng(3)
+    a = (rng.standard_normal((6, 6))
+         + 1j * rng.standard_normal((6, 6))).astype(np.complex64)
+    b = (rng.standard_normal((6, 6))
+         + 1j * rng.standard_normal((6, 6))).astype(np.complex64)
+    got = np.asarray(gemm(jnp.asarray(a), jnp.asarray(b), prec="bf16"))
+    want = np.asarray(gemm(jnp.asarray(a), jnp.asarray(b),
+                           prec="default"))
+    assert got.dtype == np.complex64
+    assert np.array_equal(got, want)      # same resolved tier: same bits
+
+
+def test_stream_executor_records_resolved_tier(tmp_path):
+    from superlu_dist_tpu.models.gallery import poisson2d
+    from superlu_dist_tpu.numeric.plan import build_plan
+    from superlu_dist_tpu.numeric.stream import StreamExecutor
+    from superlu_dist_tpu.obs import trace
+    from superlu_dist_tpu.sparse.formats import symmetrize_pattern
+    from superlu_dist_tpu.symbolic.symbfact import symbolic_factorize
+
+    a = poisson2d(6)
+    sym = symmetrize_pattern(a)
+    sf = symbolic_factorize(sym, np.arange(a.n_rows), relax=4,
+                            max_supernode=16)
+    plan = build_plan(sf)
+    avals = sym.data[sf.value_perm].astype(np.complex64)
+
+    ex = StreamExecutor(plan, "complex64", gemm_prec="bf16")
+    assert ex.gemm_prec == "bf16"
+    assert ex.gemm_prec_resolved == "default"   # complex degrade
+
+    t = trace.Tracer(str(tmp_path / "s.json"))
+    prev = trace.install(t)
+    try:
+        ex(jnp.asarray(avals), jnp.asarray(0.0))
+    finally:
+        trace.install(prev)
+        t.close()
+    events = json.load(open(tmp_path / "s.json"))["traceEvents"]
+    kernels = [e for e in events if e["cat"] == "kernel"]
+    assert kernels
+    # every kernel span reports the tier the arithmetic actually RAN
+    assert all(k["args"]["gemm_prec"] == "default" for k in kernels)
