@@ -129,6 +129,12 @@ def _watchdog():
                               _aud["donation_coverage_pct"])
             RESULT.setdefault("baked_const_bytes",
                               _aud["baked_const_bytes"])
+        if _aud["programs_sharding_audited"]:
+            RESULT.setdefault("programs_sharding_audited",
+                              _aud["programs_sharding_audited"])
+            RESULT.setdefault("peak_bytes_est", _aud["peak_bytes_est"])
+            RESULT.setdefault("replicated_bytes",
+                              _aud["replicated_bytes"])
         # durable frontier FIRST (persist/checkpoint.py): flush whatever
         # the factor loop completed, record the bundle path and its
         # resume eligibility in the row — the next BENCH run of this
@@ -588,6 +594,15 @@ def main():
         RESULT["programs_audited"] = _aud["programs"]
         RESULT["donation_coverage_pct"] = _aud["donation_coverage_pct"]
         RESULT["baked_const_bytes"] = _aud["baked_const_bytes"]
+    # sharding-audit fields (SLU_TPU_VERIFY_SHARDING=1, slulint v6):
+    # the worst program's static peak-live-bytes estimate and the
+    # gathered/replicated traffic the SLU119 walk priced — the
+    # will-it-fit-HBM axes of the sharding tier
+    if _aud["programs_sharding_audited"]:
+        RESULT["programs_sharding_audited"] = \
+            _aud["programs_sharding_audited"]
+        RESULT["peak_bytes_est"] = _aud["peak_bytes_est"]
+        RESULT["replicated_bytes"] = _aud["replicated_bytes"]
     tracer.complete("factor-compile", "phase", t_phase,
                     time.perf_counter() - t_phase,
                     kernels=ex.n_kernels, offload=ex.offload,

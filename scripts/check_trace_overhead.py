@@ -224,6 +224,19 @@ def main():
         fail("flight dump carries no events")
     print(f"metrics+flightrec on: {live['metrics_series']} series, "
           f"dump with {len(doc['events'])} events")
+
+    # ---- repo hygiene: no stray postmortem dumps at the repo root --------
+    # SLU_TPU_FLIGHTREC=1 (bare flag, no path) dumps flightrec-<pid>.json
+    # into the cwd; a gate that provokes a dump without pointing it at a
+    # tempdir litters the checkout (a flightrec-595.json once shipped in a
+    # commit).  Every child above runs with an explicit artifact path, so
+    # the repo root must stay clean.
+    import glob
+    stray = sorted(glob.glob(os.path.join(REPO, "flightrec-*.json")))
+    if stray:
+        fail(f"stray flight-recorder dump(s) at the repo root: {stray} "
+             f"(point SLU_TPU_FLIGHTREC at a tempdir path)")
+    print("hygiene: no stray flightrec-*.json at the repo root")
     print("trace overhead smoke: PASS")
 
 

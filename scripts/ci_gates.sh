@@ -98,6 +98,19 @@
 #                   fleet.refactor under live traffic drops zero
 #                   tickets and a poisoned refactor rolls back every
 #                   swapped replica
+#   sharding-audit  scripts/check_sharding_audit.py   slulint v6
+#                   sharding/memory rules: the whole tree is clean under
+#                   SLU119 (implicit replication), SLU120 (mesh/spec
+#                   hygiene vs utils/meshreg.py), SLU121 (static peak
+#                   memory) and SLU122 (dispatch-loop cross-mesh
+#                   transfers); under SLU_TPU_VERIFY_SHARDING=1 plus a
+#                   generous SLU_TPU_MEM_BUDGET_BYTES every program the
+#                   real executors submit (gate gallery, all three
+#                   factor executors + device solve sweeps) audits
+#                   clean with 100% census coverage and the mega bucket
+#                   estimates within 2x of XLA memory_analysis; a tiny
+#                   budget proves MemoryBudgetError fires BEFORE any
+#                   program runs, naming the bucket rung
 #
 # Scan sharing: the slulint gate (and any other in-tree slulint
 # invocation) reads/writes the content-hash scan cache
@@ -137,8 +150,9 @@ declare -A GATES=(
   [precision-safety]="python scripts/check_precision_safety.py"
   [precision-lint]="python scripts/check_precision_lint.py"
   [refactor-consistency]="python scripts/check_refactor.py"
+  [sharding-audit]="python scripts/check_sharding_audit.py"
 )
-ORDER=(slulint precision-lint program-audit verify-overhead
+ORDER=(slulint precision-lint sharding-audit program-audit verify-overhead
        schedule-equiv solve-equiv precision-safety serve-robust
        fleet-failover refactor-consistency crash-resume rank-failure
        compile-budget tsan-native trace-overhead nan-guards

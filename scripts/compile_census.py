@@ -36,8 +36,10 @@ Usage:
       AOT-stages (trace+lower, no backend compile) every bucket
       program, proving the closed set is buildable.
 
-Output: per-bucket ranked table (seconds, share, builds, disk hits) and
-the totals line.  Exit 1 when no census evidence is found.
+Output: per-bucket ranked table (seconds, share, builds, disk hits, and
+— when the sharding twin audited the programs or --live staged them —
+the SLU121 static peak-live-bytes estimate as a ``peak MiB`` column)
+and the totals line.  Exit 1 when no census evidence is found.
 """
 
 import json
@@ -104,7 +106,8 @@ def rows_from_artifact(path: str) -> list:
             return [dict(site=r.get("site", "?"), key=r.get("key", "?"),
                          seconds=float(r.get("seconds", 0.0)),
                          builds=int(r.get("builds", r.get("n", 1))),
-                         persistent_hits=int(r.get("persistent_hits", 0)))
+                         persistent_hits=int(r.get("persistent_hits", 0)),
+                         peak_bytes_est=int(r.get("peak_bytes_est", 0)))
                     for r in census]
     # trace artifact: compile-category spans
     events = _iter_events(text)
@@ -176,6 +179,7 @@ def live_rows(nx: int) -> list:
             args += [Sds((c,), i64), Sds((c,), i64), Sds((c, ub), i64)]
         kern = stream._kernel(key[0], la, child_shapes, pool_size, dtype,
                               None, False, "blocked")
+        peak = _static_peak(kern, args, f"lu b{b} m{m} w{w} u{u}")
         t0 = time.perf_counter()
         try:
             traced = kern.trace(*args)       # jaxpr trace (jax >= 0.4.31)
@@ -190,9 +194,26 @@ def live_rows(nx: int) -> list:
         rows.append(dict(site="stream._kernel",
                          key=f"lu b{b} m{m} w{w} u{u}",
                          seconds=t3 - t0, builds=1, persistent_hits=0,
+                         peak_bytes_est=peak,
                          trace_s=t1 - t0, lower_s=t2 - t1,
                          compile_s=t3 - t2))
     return rows
+
+
+def _static_peak(kern, args, label: str) -> int:
+    """SLU121 static high-water live bytes of one abstractly-traced
+    kernel (analysis/program.py liveness walk) — the census memory
+    column.  0 when the trace fails (older jax)."""
+    try:
+        from superlu_dist_tpu.analysis.program import (audit_sharding,
+                                                       trace_spec)
+        spec = trace_spec(kern, tuple(args), label=label, site="census")
+        _, stats = audit_sharding(spec, 1 << 20)
+        return int(stats.get("peak_bytes_est", 0))
+    except Exception as e:
+        print(f"compile_census: static peak unavailable for {label}: {e}",
+              file=sys.stderr)
+        return 0
 
 
 # ---------------------------------------------------------------------------
@@ -209,26 +230,38 @@ def report(rows: list, staged: bool) -> int:
     for r in rows:
         row = agg.setdefault((r["site"], r["key"]), dict(
             site=r["site"], key=r["key"], seconds=0.0, builds=0,
-            persistent_hits=0, trace_s=0.0, lower_s=0.0, compile_s=0.0))
+            persistent_hits=0, peak_bytes_est=0,
+            trace_s=0.0, lower_s=0.0, compile_s=0.0))
         row["seconds"] += r["seconds"]
         row["builds"] += r.get("builds", 1)
         row["persistent_hits"] += r.get("persistent_hits", 0)
+        row["peak_bytes_est"] = max(row["peak_bytes_est"],
+                                    r.get("peak_bytes_est", 0))
         for k in ("trace_s", "lower_s", "compile_s"):
             row[k] += r.get(k, 0.0)
     ranked = sorted(agg.values(), key=lambda row: -row["seconds"])
     total = sum(row["seconds"] for row in ranked) or 1e-12
     builds = sum(row["builds"] for row in ranked)
     hits = sum(row["persistent_hits"] for row in ranked)
+    # memory column (slulint v6): the SLU121 static peak-live-bytes
+    # estimate, present when the sharding twin audited the program or
+    # --live staged it — the will-it-fit-HBM axis next to compile cost
+    have_mem = any(row["peak_bytes_est"] for row in ranked)
     print(f"\n== compile census: {builds} builds, {total:.2f} s total, "
           f"{hits} persistent-cache hits ==")
-    hdr = "   seconds  share  builds  hits  site                key"
+    hdr = "   seconds  share  builds  hits"
+    if have_mem:
+        hdr += "  peak MiB"
+    hdr += "  site                key"
     if staged:
         hdr += "                        trace/lower/compile"
     print(hdr)
     for row in ranked:
         line = (f"  {row['seconds']:8.3f}  {100 * row['seconds'] / total:4.1f}%"
-                f"  {row['builds']:6d}  {row['persistent_hits']:4d}"
-                f"  {row['site']:<18s}  {row['key']:<24s}")
+                f"  {row['builds']:6d}  {row['persistent_hits']:4d}")
+        if have_mem:
+            line += f"  {row['peak_bytes_est'] / (1 << 20):8.2f}"
+        line += f"  {row['site']:<18s}  {row['key']:<24s}"
         if staged:
             line += (f"  {row['trace_s']:.3f}/{row['lower_s']:.3f}"
                      f"/{row['compile_s']:.3f} s")
@@ -236,6 +269,11 @@ def report(rows: list, staged: bool) -> int:
     top = ranked[0]
     print(f"\ndominant bucket: {top['key']} ({top['site']}) — "
           f"{100 * top['seconds'] / total:.1f}% of compile time")
+    if have_mem:
+        worst = max(ranked, key=lambda row: row["peak_bytes_est"])
+        print(f"peak static memory: {worst['key']} ({worst['site']}) — "
+              f"{worst['peak_bytes_est'] / (1 << 20):.2f} MiB estimated "
+              f"live high-water (SLU121 model)")
     return 0
 
 
@@ -271,7 +309,7 @@ def bucket_budget(nxs: list, stage: bool) -> int:
                                 amalg_tol=1.05)
         plan = build_plan(sf, min_bucket=16, growth=1.05, closed=True)
         ex = MegaExecutor(plan, "float32")
-        staged = 0
+        staged, peak = 0, 0
         if stage:
             idt = jnp.asarray(np.zeros(0, dtype=np.int64)).dtype
             from jax import ShapeDtypeStruct as Sds
@@ -289,12 +327,19 @@ def bucket_budget(nxs: list, stage: bool) -> int:
                     kern.trace(*args).lower()
                 except AttributeError:
                     kern.lower(*args)
+                # static peak (SLU121) of the worst bucket program: the
+                # budget gate's compile-count invariant says nothing
+                # about whether the rung-padded pool still FITS — this
+                # column does
+                peak = max(peak, _static_peak(
+                    kern, args, f"lu b{b} m{m} w{w} u{u} P{pl}"))
                 staged += 1
         counts[nx] = ex.n_kernels
+        mem = (f"peak={peak / (1 << 20):.2f}MiB " if peak else "")
         print(f"nx={nx:3d} n={a.n_rows:7d} groups={len(plan.groups):4d} "
               f"mega_kernels={ex.n_kernels} "
               f"digest={plan.bucket_set_digest()} "
-              f"staged={staged} ({time.perf_counter() - t0:.1f}s)",
+              f"staged={staged} {mem}({time.perf_counter() - t0:.1f}s)",
               flush=True)
     distinct = sorted(set(counts.values()))
     if len(distinct) != 1:

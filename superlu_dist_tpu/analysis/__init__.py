@@ -16,12 +16,24 @@ Rules:
                                    env SLU_TPU_VERIFY_COLLECTIVES=1)
   SLU109 runtime lock verify      (utils/lockwatch.py,
                                    env SLU_TPU_VERIFY_LOCKS=1)
+  SLU115-SLU118 precision flow    (rules_precision.py, width lattice;
+                                   runtime twin utils/programaudit.py
+                                   under SLU_TPU_VERIFY_DTYPES=1)
+  SLU120 mesh/spec hygiene        (rules_sharding.py, meshreg-backed)
+  SLU122 dispatch-loop transfers  (rules_sharding.py, device lattice)
   SLU111/SLU112/SLU114 IR audit   (program.py + rules_program.py over
                                    closed jaxprs; runtime twin
                                    utils/programaudit.py under
                                    SLU_TPU_VERIFY_PROGRAMS=1 — donation
                                    coverage, baked-const blowup, SPMD
                                    collective lockstep)
+  SLU119/SLU121 sharding audit    (rules_sharding.py over closed
+                                   jaxprs; runtime twin
+                                   utils/programaudit.py under
+                                   SLU_TPU_VERIFY_SHARDING=1 /
+                                   SLU_TPU_MEM_BUDGET_BYTES — implicit
+                                   replication blowup, static
+                                   peak-memory model)
 
 Engine: every scan first builds a package-wide call graph
 (callgraph.py) and per-function dataflow summaries over the

@@ -40,15 +40,19 @@ def _build_parser():
                     "lifecycle SLU110, dispatch-loop host round-trips "
                     "SLU113, implicit downcast SLU115, accumulation "
                     "dtype SLU116, EFT purity SLU117, tolerance hygiene "
-                    "SLU118; the SLU106 runtime twin lives in "
-                    "parallel/treecomm.py under "
+                    "SLU118, mesh/spec hygiene SLU120, dispatch-loop "
+                    "cross-mesh transfers SLU122; the SLU106 runtime "
+                    "twin lives in parallel/treecomm.py under "
                     "SLU_TPU_VERIFY_COLLECTIVES=1, the SLU109 runtime "
                     "twin in utils/lockwatch.py under "
                     "SLU_TPU_VERIFY_LOCKS=1, the program-level IR "
                     "rules SLU111/SLU112/SLU114 in utils/programaudit.py "
-                    "under SLU_TPU_VERIFY_PROGRAMS=1, and the "
-                    "SLU115/SLU116 precision twin there too under "
-                    "SLU_TPU_VERIFY_DTYPES=1)")
+                    "under SLU_TPU_VERIFY_PROGRAMS=1, the SLU115/SLU116 "
+                    "precision twin there too under "
+                    "SLU_TPU_VERIFY_DTYPES=1, and the SLU119/SLU121 "
+                    "sharding/peak-memory twin under "
+                    "SLU_TPU_VERIFY_SHARDING=1 + "
+                    "SLU_TPU_MEM_BUDGET_BYTES)")
     p.add_argument("paths", nargs="*", default=DEFAULT_PATHS,
                    help="files/directories to scan (default: the package, "
                         "scripts/, bench.py, examples/)")

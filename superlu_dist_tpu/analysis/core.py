@@ -35,7 +35,7 @@ import re
 #: bumped whenever the rule set / engine semantics change — part of the
 #: result-cache key (analysis/cache.py), so a stale cache can never
 #: serve findings computed by an older rule set
-ANALYSIS_VERSION = "5"
+ANALYSIS_VERSION = "6"
 
 
 @dataclasses.dataclass
@@ -194,12 +194,17 @@ def default_rules() -> list:
     from superlu_dist_tpu.analysis.rules_precision import (
         AccumulationDtypeRule, EFTPurityRule, ImplicitDowncastRule,
         ToleranceLiteralRule)
+    from superlu_dist_tpu.analysis.rules_sharding import (
+        CrossMeshTransferRule, ImplicitReshardRule, MeshSpecHygieneRule,
+        PeakMemoryRule)
     return [CollectiveRule(), TracePurityRule(), IndexWidthRule(),
             EnvKnobRule(), JitCacheKeyRule(), JitKeyShapeDiversityRule(),
             SharedMutableRule(), LockOrderRule(), ThreadLifecycleRule(),
             HostRoundTripRule(), ImplicitDowncastRule(),
             AccumulationDtypeRule(), EFTPurityRule(),
-            ToleranceLiteralRule()]
+            ToleranceLiteralRule(), ImplicitReshardRule(),
+            MeshSpecHygieneRule(), PeakMemoryRule(),
+            CrossMeshTransferRule()]
 
 
 def analyze_source(source: str, path: str, rules, project=None) -> list:

@@ -294,6 +294,27 @@ def audit_spec(spec: ProgramSpec, donate_min_bytes: int,
     return findings, stats
 
 
+def audit_sharding(spec: ProgramSpec, reshard_min_bytes: int,
+                   budget_bytes: int = 0):
+    """Run the v6 sharding/memory rules (SLU119 implicit replication/
+    reshard blowup / SLU121 static peak-memory model) over one spec —
+    the jaxpr half of the ``SLU_TPU_VERIFY_SHARDING=1`` /
+    ``SLU_TPU_MEM_BUDGET_BYTES`` runtime twin (utils/programaudit.py).
+
+    Returns ``(findings, stats)`` like :func:`audit_spec`; stats carry
+    ``peak_bytes_est``/``replicated_bytes`` — the census memory column.
+    """
+    from superlu_dist_tpu.analysis import rules_sharding as rs
+    f1, reshard_stats = rs.audit_resharding(spec, reshard_min_bytes)
+    f2, mem_stats = rs.audit_peak_memory(spec, budget_bytes)
+    findings = f1 + f2
+    stats = {"label": spec.label, "site": spec.site,
+             "findings": len(findings)}
+    stats.update(reshard_stats)
+    stats.update(mem_stats)
+    return findings, stats
+
+
 def audit_dtypes(spec: ProgramSpec):
     """Run the v5 precision rules (SLU115 narrowing converts / SLU116
     accumulation dtypes) over one spec — the jaxpr half of the

@@ -1,0 +1,551 @@
+"""slulint v6 sharding & memory-flow rules — SLU119-SLU122.
+
+Landing AHEAD of ROADMAP item 1 (the shard_map/pjit SPMD rewrite), the
+way SLU114 landed ahead of it in PR 13: the two failure classes SLU114
+does NOT cover are exactly the ones that kill real SPMD solver ports —
+silent full-replication/resharding inserted by the partitioner (an
+implicit all-gather of a Schur pool is a pod-slice OOM), and padded-rung
+buffer sizing whose peak live bytes exceed per-device HBM.
+
+Two rules run over TRACED PROGRAMS (closed jaxprs, via
+``analysis/program.py`` and the ``SLU_TPU_VERIFY_SHARDING=1`` runtime
+twin in ``utils/programaudit.py``):
+
+SLU119 — implicit replication/reshard blowup.  A gathering collective
+(``all_gather``/``all_to_all``) whose output is at least the byte
+threshold, or an explicit sharding constraint/transfer that resolves to
+a FULLY-REPLICATED layout on a non-trivial mesh, moves (or duplicates)
+whole-buffer traffic the author probably never asked for: under GSPMD a
+single underconstrained op makes the partitioner insert exactly these —
+and a replicated Schur pool is the device-memory-constrained assembly
+problem of arXiv:2509.21037.  Findings name the op, the axes, and the
+bytes; stats carry ``replicated_bytes``/``resharded_bytes`` for the
+census.
+
+SLU121 — static peak-memory model.  A forward liveness walk over the
+closed jaxpr computes the high-water live-byte mark (arguments + baked
+consts + intermediates, each freed after its last use; sub-jaxpr bodies
+contribute their own transient peak).  The estimate is surfaced as
+``peak_bytes_est`` in the compile census and bench rows, and — when
+``SLU_TPU_MEM_BUDGET_BYTES`` is set — a program whose peak exceeds the
+budget FAILS before it runs (``MemoryBudgetError``), naming the largest
+live buffers.  The model is deliberately sharding-blind (per-device
+bytes = global bytes): it upper-bounds a single-device run and exactly
+bounds the replicated path, which is what the mega executor's
+padded-rung pool sizing needs.
+
+Two rules run over SOURCE (part of the slulint CLI rule set):
+
+SLU120 — mesh/spec hygiene.  shard_map/pjit/Mesh/NamedSharding/
+PartitionSpec call sites must spell axis names declared in the central
+registry (``utils/meshreg.py`` — the axis-name analog of SLU104's knob
+registry): a typo'd axis is not an error anywhere in jax, the dimension
+just silently replicates.  Literal ``in_specs`` tuples must match the
+wrapped function's positional arity, and args donated through
+``jax.jit(shard_map(...), donate_argnums=...)`` must carry a
+``P(...)`` spec — donating a spec-less (replicated) arg aliases a
+buffer every device still reads.
+
+SLU122 — cross-mesh transfer in dispatch loops.  Extends the SLU113
+device-taint: ``jax.device_put`` / ``.reshard`` of a DEVICE value
+inside a per-group For/While dispatch loop in numeric//solve/ is a
+whole-buffer cross-device (or cross-layout) copy once per group — the
+reshard analog of SLU113's host round-trip.  Host-side uploads
+(numpy -> device) are exempt: the taint gate only fires when the value
+already lives on a device.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from superlu_dist_tpu.analysis.core import Finding, Rule, dotted_name
+from superlu_dist_tpu.analysis.dataflow import TAINT_DEVICE, FnFlow
+from superlu_dist_tpu.analysis.program import (ProgramSpec, aval_bytes,
+                                               const_bytes, eqn_axes,
+                                               iter_eqns, open_jaxpr,
+                                               sub_jaxprs)
+
+RULE_IMPLICIT_RESHARD = "SLU119"
+RULE_MESH_HYGIENE = "SLU120"
+RULE_PEAK_MEMORY = "SLU121"
+RULE_LOOP_TRANSFER = "SLU122"
+
+#: primitives that materialize the GATHERED (cross-shard) operand — the
+#: implicit-replication traffic SLU119 prices.  ``psum`` and friends
+#: reduce (output is shard-shaped), so they are deliberately absent.
+GATHERING_PRIMS = frozenset({"all_gather", "all_to_all", "pgather"})
+
+#: primitives that re-lay-out an existing device value
+RESHARD_PRIMS = frozenset({"sharding_constraint", "device_put"})
+
+
+def _program_finding(rule: str, spec: ProgramSpec, message: str,
+                     hint: str) -> Finding:
+    return Finding(rule, f"<program:{spec.site}[{spec.label}]>", 0, 1,
+                   message, hint)
+
+
+def _eqn_out_bytes(eqn) -> int:
+    return sum(aval_bytes(getattr(v, "aval", None))
+               for v in getattr(eqn, "outvars", ()))
+
+
+def _replicated_shardings(eqn):
+    """Duck-typed: sharding-like objects in the eqn's params that report
+    ``is_fully_replicated`` truthy (NamedSharding/GSPMDSharding both
+    carry the flag; stubs only need the attribute)."""
+    out = []
+    for v in getattr(eqn, "params", {}).values():
+        for s in (v if isinstance(v, (list, tuple)) else (v,)):
+            rep = getattr(s, "is_fully_replicated", None)
+            if rep:
+                out.append(s)
+    return out
+
+
+# --------------------------------------------------------------------------
+# SLU119 — implicit replication / reshard blowup (jaxpr rule)
+# --------------------------------------------------------------------------
+
+def audit_resharding(spec: ProgramSpec, min_bytes: int):
+    """Findings for gathering collectives and fully-replicated reshard
+    constraints moving >= min_bytes, plus {replicated_bytes,
+    resharded_bytes, n_gathers}."""
+    findings = []
+    replicated = 0
+    resharded = 0
+    n_gathers = 0
+    for eqn in iter_eqns(spec.jaxpr):
+        name = getattr(eqn.primitive, "name", str(eqn.primitive))
+        if name in GATHERING_PRIMS:
+            n_gathers += 1
+            nb = _eqn_out_bytes(eqn)
+            replicated += nb
+            if nb < min_bytes:
+                continue
+            axes = eqn_axes(eqn) or ("?",)
+            findings.append(_program_finding(
+                RULE_IMPLICIT_RESHARD, spec,
+                f"`{name}` over axis {','.join(map(repr, axes))} "
+                f"materializes {nb} gathered bytes on every shard — the "
+                "implicit-replication blowup (a gathered Schur pool is a "
+                "pod-slice OOM, not a slowdown)",
+                "keep the operand shard-resident: reduce with psum/"
+                "psum_scatter, or reshard only the panel actually "
+                "consumed (the partitioner inserts gathers wherever an "
+                "op is underconstrained — constrain it)"))
+        elif name in RESHARD_PRIMS:
+            nb = _eqn_out_bytes(eqn)
+            resharded += nb
+            reps = _replicated_shardings(eqn)
+            if not reps or not spec.mesh_axes or nb < min_bytes:
+                continue
+            replicated += nb
+            findings.append(_program_finding(
+                RULE_IMPLICIT_RESHARD, spec,
+                f"`{name}` resolves {nb} bytes to a FULLY-REPLICATED "
+                f"layout on mesh axes {list(spec.mesh_axes)} — every "
+                "device holds the whole buffer, so the per-device "
+                "footprint stops scaling with the mesh",
+                "replicate only below the byte threshold; shard large "
+                "buffers over a mesh axis (PartitionSpec) and let the "
+                "consumers gather the panel they touch"))
+    return findings, {"replicated_bytes": int(replicated),
+                      "resharded_bytes": int(resharded),
+                      "n_gathers": int(n_gathers)}
+
+
+# --------------------------------------------------------------------------
+# SLU121 — static peak-memory model (jaxpr rule)
+# --------------------------------------------------------------------------
+
+def _var_bytes(v) -> int:
+    return aval_bytes(getattr(v, "aval", None))
+
+
+def _is_literal(v) -> bool:
+    # jax.core.Literal carries .val; variables do not
+    return hasattr(v, "val")
+
+
+def _jaxpr_peak(j) -> tuple:
+    """(peak_bytes, args_bytes, n_eqns) for one OPEN jaxpr body: a
+    forward walk where every binder's bytes go live at its defining
+    equation and die after its last use (jaxpr binders are SSA, so
+    id(var) is a sound key).  Sub-jaxpr-bearing equations contribute
+    their body's transient high-water (inner peak minus inner args,
+    which the outer operands already count)."""
+    invars = list(getattr(j, "constvars", ())) + list(getattr(j,
+                                                              "invars", ()))
+    eqns = list(getattr(j, "eqns", ()))
+    last_use: dict = {}
+    for i, eqn in enumerate(eqns):
+        for v in getattr(eqn, "invars", ()):
+            if not _is_literal(v):
+                last_use[id(v)] = i
+    for v in getattr(j, "outvars", ()):
+        if not _is_literal(v):
+            last_use[id(v)] = len(eqns)
+    args_bytes = sum(_var_bytes(v) for v in invars)
+    live = args_bytes
+    peak = live
+    # args with no use at all die before the first equation
+    for v in invars:
+        if id(v) not in last_use:
+            live -= _var_bytes(v)
+    for i, eqn in enumerate(eqns):
+        out_b = sum(_var_bytes(v) for v in getattr(eqn, "outvars", ()))
+        transient = 0
+        for s in sub_jaxprs(eqn):
+            inner_peak, inner_args, _ = _jaxpr_peak(s)
+            transient = max(transient, inner_peak - inner_args)
+        live += out_b
+        peak = max(peak, live + transient)
+        for v in getattr(eqn, "outvars", ()):
+            if id(v) not in last_use:
+                live -= _var_bytes(v)
+        for vid, bytes_ in _dying_at(eqns[i], last_use, i):
+            live -= bytes_
+    return peak, args_bytes, len(eqns)
+
+
+def _dying_at(eqn, last_use, i):
+    seen = set()
+    for v in getattr(eqn, "invars", ()):
+        if _is_literal(v) or id(v) in seen:
+            continue
+        seen.add(id(v))
+        if last_use.get(id(v)) == i:
+            yield id(v), _var_bytes(v)
+
+
+def _top_buffers(j, n: int = 3) -> str:
+    sizes = []
+    for v in list(getattr(j, "invars", ())) + [
+            ov for e in getattr(j, "eqns", ())
+            for ov in getattr(e, "outvars", ())]:
+        nb = _var_bytes(v)
+        if nb:
+            aval = getattr(v, "aval", None)
+            short = getattr(aval, "str_short", None)
+            sizes.append((nb, short() if callable(short) else str(aval)))
+    sizes.sort(key=lambda t: -t[0])
+    return ", ".join(f"{s} ({nb} B)" for nb, s in sizes[:n]) or "none"
+
+
+def audit_peak_memory(spec: ProgramSpec, budget_bytes: int):
+    """High-water live-byte estimate for one program; a finding when a
+    positive budget is exceeded.  Returns (findings, {peak_bytes_est,
+    args_bytes, n_eqns})."""
+    j = open_jaxpr(spec.jaxpr)
+    peak, args_bytes, n_eqns = _jaxpr_peak(j)
+    peak += sum(const_bytes(c) for c in getattr(spec.jaxpr, "consts", ()))
+    findings = []
+    if budget_bytes and budget_bytes > 0 and peak > budget_bytes:
+        findings.append(_program_finding(
+            RULE_PEAK_MEMORY, spec,
+            f"static peak live bytes {peak} exceed the "
+            f"SLU_TPU_MEM_BUDGET_BYTES budget of {budget_bytes} "
+            f"(largest buffers: {_top_buffers(j)})",
+            "shrink the padded rung (SLU_TPU_BUCKET_GROWTH / "
+            "SLU_TPU_SCHED_WINDOW), donate dead inputs so XLA aliases "
+            "them, or raise the budget — the estimate is "
+            "free-after-last-use, so anything above it is structural"))
+    return findings, {"peak_bytes_est": int(peak),
+                      "args_bytes": int(args_bytes),
+                      "n_eqns": int(n_eqns)}
+
+
+# --------------------------------------------------------------------------
+# catalog stubs: SLU119/SLU121 are jaxpr-tier rules with no source half,
+# but they need Rule identities so `--rules SLU119,SLU121` selects them,
+# `--list-rules` and the SARIF catalog describe them, and suppressions/
+# baselines treat their runtime findings uniformly.
+# --------------------------------------------------------------------------
+
+class ImplicitReshardRule(Rule):
+    rule_id = RULE_IMPLICIT_RESHARD
+    title = "implicit-replication-reshard-blowup"
+    hint = ("keep large operands shard-resident; the jaxpr walk "
+            "(audit_resharding) runs under SLU_TPU_VERIFY_SHARDING=1 — "
+            "the source scan has nothing to check")
+
+    def check(self, tree, source, path, project=None):
+        return []
+
+
+class PeakMemoryRule(Rule):
+    rule_id = RULE_PEAK_MEMORY
+    title = "static-peak-memory-budget"
+    hint = ("the liveness walk (audit_peak_memory) runs under "
+            "SLU_TPU_VERIFY_SHARDING=1 / SLU_TPU_MEM_BUDGET_BYTES — "
+            "the source scan has nothing to check")
+
+    def check(self, tree, source, path, project=None):
+        return []
+
+
+# --------------------------------------------------------------------------
+# SLU120 — mesh/spec hygiene (source rule)
+# --------------------------------------------------------------------------
+
+_SHARD_MAP_NAMES = frozenset({"shard_map", "jax.experimental.shard_map."
+                              "shard_map"})
+_PJIT_NAMES = frozenset({"pjit", "jax.pjit"})
+_SPEC_CTORS = frozenset({"P", "PartitionSpec"})
+_JIT_NAMES = frozenset({"jit", "jax.jit"})
+
+
+def _is_spec_ctor(name: str) -> bool:
+    return name in _SPEC_CTORS or name.endswith(".PartitionSpec")
+
+
+def _is_mesh_ctor(name: str) -> bool:
+    return name == "Mesh" or name.endswith(".Mesh")
+
+
+def _literal_strings(node):
+    """(value, anchor) for every string constant under ``node``."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            yield sub.value, sub
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _positional_arity(fn_node) -> int | None:
+    """Positional parameter count of a def/lambda (None when *args makes
+    the arity open)."""
+    a = fn_node.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+class MeshSpecHygieneRule(Rule):
+    rule_id = RULE_MESH_HYGIENE
+    title = "mesh-spec-hygiene"
+    hint = ("declare every mesh axis in utils/meshreg.py and spell it "
+            "exactly at shard_map/pjit/Mesh/PartitionSpec call sites — "
+            "a typo'd axis silently replicates the dimension")
+
+    def __init__(self):
+        self._axes = None
+
+    @property
+    def axes(self) -> frozenset:
+        if self._axes is None:
+            from superlu_dist_tpu.utils.meshreg import registered_axes
+            self._axes = frozenset(registered_axes())
+        return self._axes
+
+    def check(self, tree, source, path, project=None):
+        out = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if name in _SHARD_MAP_NAMES or name in _PJIT_NAMES:
+                out.extend(self._check_specs(path, node))
+                if name in _SHARD_MAP_NAMES:
+                    out.extend(self._check_arity(path, node, project))
+            elif _is_mesh_ctor(name):
+                axes = _kw(node, "axis_names") or (
+                    node.args[1] if len(node.args) > 1 else None)
+                if axes is not None:
+                    out.extend(self._check_names(path, axes, name))
+            elif _is_spec_ctor(name):
+                out.extend(self._check_names(path, node, name))
+            elif name in _JIT_NAMES:
+                out.extend(self._check_donation(path, node))
+        # a P("typo") inside an in_specs= kwarg is reached by both the
+        # spec walk and the ctor walk — one finding per anchor
+        seen, uniq = set(), []
+        for f in out:
+            if (f.line, f.col) not in seen:
+                seen.add((f.line, f.col))
+                uniq.append(f)
+        return uniq
+
+    def _check_names(self, path, node, what):
+        out = []
+        for value, anchor in _literal_strings(node):
+            if value not in self.axes:
+                out.append(self.finding(
+                    path, anchor,
+                    f"axis name {value!r} in `{what}(...)` is not "
+                    "declared in the mesh-axis registry "
+                    f"(utils/meshreg.py declares "
+                    f"{sorted(self.axes) or 'no axes'}) — jax treats an "
+                    "unknown axis as replicated, silently"))
+        return out
+
+    def _check_specs(self, path, call):
+        out = []
+        for spec_kw in ("in_specs", "out_specs"):
+            v = _kw(call, spec_kw)
+            if v is not None:
+                out.extend(self._check_names(path, v,
+                                             f"{spec_kw}="))
+        return out
+
+    def _check_arity(self, path, call, project):
+        """Literal in_specs tuple length vs the wrapped function's
+        positional arity (resolvable local defs only)."""
+        specs = _kw(call, "in_specs")
+        if not isinstance(specs, (ast.Tuple, ast.List)) or not call.args:
+            return []
+        wrapped = call.args[0]
+        arity = None
+        if isinstance(wrapped, ast.Lambda):
+            arity = _positional_arity(wrapped)
+        elif isinstance(wrapped, ast.Name) and project is not None:
+            for qname, fi in project.functions.items():
+                if fi.path == path and qname.rsplit(".", 1)[-1] == \
+                        wrapped.id:
+                    arity = _positional_arity(fi.node)
+                    break
+        if arity is None or arity == len(specs.elts):
+            return []
+        return [self.finding(
+            path, specs,
+            f"in_specs declares {len(specs.elts)} spec(s) but the "
+            f"wrapped function takes {arity} positional argument(s) — "
+            "jax reports this as an opaque tree mismatch at trace time; "
+            "the spec list must mirror the signature")]
+
+    def _check_donation(self, path, call):
+        """jax.jit(shard_map(...), donate_argnums=...): donated
+        positions must carry a P(...) spec, not None/replicated."""
+        if not call.args:
+            return []
+        inner = call.args[0]
+        if not (isinstance(inner, ast.Call)
+                and dotted_name(inner.func) in _SHARD_MAP_NAMES):
+            return []
+        specs = _kw(inner, "in_specs")
+        donate = _kw(call, "donate_argnums")
+        if specs is None or donate is None:
+            return []
+        if not isinstance(specs, (ast.Tuple, ast.List)):
+            return []
+        idxs = []
+        if isinstance(donate, ast.Constant) and isinstance(donate.value,
+                                                           int):
+            idxs = [donate.value]
+        elif isinstance(donate, (ast.Tuple, ast.List)):
+            idxs = [e.value for e in donate.elts
+                    if isinstance(e, ast.Constant)
+                    and isinstance(e.value, int)]
+        out = []
+        for i in idxs:
+            if i >= len(specs.elts):
+                continue
+            el = specs.elts[i]
+            is_spec = isinstance(el, ast.Call) and _is_spec_ctor(
+                dotted_name(el.func))
+            if not is_spec:
+                out.append(self.finding(
+                    path, el,
+                    f"donated argument {i} carries no PartitionSpec "
+                    "(in_specs element is not a P(...) call) — donating "
+                    "a replicated/spec-less buffer aliases storage every "
+                    "device still reads",
+                    "give donated args an explicit P(...) layout, or "
+                    "drop them from donate_argnums"))
+        return out
+
+
+# --------------------------------------------------------------------------
+# SLU122 — cross-mesh transfer in dispatch loops (source rule)
+# --------------------------------------------------------------------------
+
+_TRANSFER_CALLS = frozenset({"jax.device_put", "device_put"})
+
+
+class _TransferFlow(FnFlow):
+    """FnFlow with the SLU122 in-loop transfer scan attached (the
+    device-taint machinery of SLU113's _DispatchFlow, hunting resharding
+    instead of host coercions)."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.hits: dict = {}     # (line, col) -> (anchor node, message)
+
+    def _device(self, expr) -> str | None:
+        t = self.taint(expr)
+        return t.get(TAINT_DEVICE)
+
+    def _scan_expr(self, expr) -> None:
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            prov = None
+            what = None
+            if name in _TRANSFER_CALLS and node.args:
+                prov = self._device(node.args[0])
+                what = f"`{name}`"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "reshard":
+                prov = self._device(node.func.value)
+                what = "`.reshard()`"
+            if prov is not None:
+                self._hit(node, what, prov)
+
+    def _hit(self, node, what, prov) -> None:
+        key = (node.lineno, node.col_offset)
+        if key not in self.hits:
+            self.hits[key] = (node, f"{what} on a device value ({prov}) "
+                              "inside the dispatch loop — a whole-buffer "
+                              "cross-device/cross-layout copy once per "
+                              "group (the reshard analog of SLU113's "
+                              "host round-trip)")
+
+    def visit_stmt(self, st) -> None:
+        if self.loop_depth == 0:
+            return
+        if isinstance(st, (ast.If, ast.While)):
+            self._scan_expr(st.test)
+            return
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            self._scan_expr(st.iter)
+            return
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._scan_expr(item.context_expr)
+            return
+        if isinstance(st, ast.Try):
+            return
+        self._scan_expr(st)
+
+
+class CrossMeshTransferRule(Rule):
+    rule_id = RULE_LOOP_TRANSFER
+    title = "cross-mesh-transfer-in-dispatch-loop"
+    hint = ("commit buffers to their mesh layout ONCE before the loop "
+            "(the __call__-prologue device_put discipline of "
+            "stream.__call__/df64_factor.__call__), or keep the reshard "
+            "inside the jitted program where XLA can fuse it; host "
+            "uploads (numpy -> device) are exempt")
+    package_dirs = ("numeric", "solve")
+
+    def check(self, tree, source, path, project=None):
+        if project is None:
+            return []
+        out = []
+        for qname, fi in project.functions.items():
+            if fi.path != path:
+                continue
+            flow = _TransferFlow.for_function(project, fi)
+            flow.run()
+            for key in sorted(flow.hits):
+                node, msg = flow.hits[key]
+                out.append(self.finding(path, node, msg))
+        return out

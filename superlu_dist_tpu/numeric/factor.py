@@ -343,10 +343,12 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
         t_issue = time.perf_counter() - t0
         if cold:
             built.append(True)
+            # same label the audit notes use (gemm_prec included), so the
+            # census join that attaches peak_bytes_est to this row holds
             COMPILE_STATS.record(
                 "make_factor_fn",
-                f"fused g{len(plan.groups)} {str(dtype)}", t0, t_issue,
-                n_args=2)
+                f"fused g{len(plan.groups)} {str(dtype)} {gemm_prec}",
+                t0, t_issue, n_args=2)
         if not tracer.enabled:
             return out
         tracer.complete("issue fused", "dispatch", t0, t_issue,

@@ -99,6 +99,15 @@ class MegaExecutor(StreamExecutor):
 
     _census_site = "mega._kernel"
 
+    @staticmethod
+    def _census_label(key) -> str:
+        # the pool rung P is part of the label: the SLU121 peak-memory
+        # verdict is dominated by the rung-padded Schur pool, so a
+        # MemoryBudgetError (and the census memory column) must name the
+        # offending bucket RUNG, not just the front geometry
+        (b, m, w, u) = key[0]
+        return f"lu b{b} m{m} w{w} u{u} P{key[3]}"
+
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
                  offload: str = "auto", pool_partition: bool = False,
                  host_flops=None, gemm_prec=None, pallas=None):

@@ -169,9 +169,15 @@ class CompileStats:
     def audit_block(self) -> dict:
         """Aggregate program-audit stats for the stats.compile block and
         the bench row: program count, donated/dead byte totals, overall
-        donation coverage %, total baked-const bytes."""
+        donation coverage %, total baked-const bytes, plus the v6
+        sharding-twin aggregates (programs_sharding_audited,
+        peak_bytes_est = the worst program's static high-water mark,
+        replicated_bytes = gathered/replicated traffic across all
+        audited programs)."""
         with self._lock:
             audits = [dict(v) for v in self._audits.values()]
+            sharding = [dict(v) for (s, k), v in self._audits.items()
+                        if k.endswith("#sharding")]
         donated = sum(a.get("donated_bytes", 0) for a in audits)
         dead = sum(a.get("dead_bytes", 0) for a in audits)
         return {
@@ -184,6 +190,12 @@ class CompileStats:
                 else round(100.0 * donated / dead, 2)),
             "baked_const_bytes": sum(a.get("baked_const_bytes", 0)
                                      for a in audits),
+            "programs_sharding_audited": len(sharding),
+            "peak_bytes_est": max(
+                (a.get("peak_bytes_est", 0) for a in sharding),
+                default=0),
+            "replicated_bytes": sum(a.get("replicated_bytes", 0)
+                                    for a in sharding),
         }
 
     # ---- querying ------------------------------------------------------
@@ -207,7 +219,9 @@ class CompileStats:
     def census(self, since: int = 0) -> list[dict]:
         """Per-(site, key) aggregation of the records after ``since``,
         sorted by total seconds descending — the "which buckets dominate
-        cold-compile" table."""
+        cold-compile" table.  Rows carry ``peak_bytes_est`` (the SLU121
+        static high-water estimate) when the sharding twin audited the
+        matching program (``key#sharding`` audit note)."""
         agg: dict[tuple, dict] = {}
         for r in self._snap(since):
             row = agg.get((r.site, r.key))
@@ -219,9 +233,16 @@ class CompileStats:
             row["builds"] += r.builds
             row["seconds"] += r.seconds
             row["persistent_hits"] += 1 if r.persistent_hit else 0
+        with self._lock:
+            peaks = {(s, k[:-len("#sharding")]): v.get("peak_bytes_est")
+                     for (s, k), v in self._audits.items()
+                     if k.endswith("#sharding")}
         out = sorted(agg.values(), key=lambda row: -row["seconds"])
         for row in out:
             row["seconds"] = round(row["seconds"], 4)
+            peak = peaks.get((row["site"], row["key"]))
+            if peak is not None:
+                row["peak_bytes_est"] = int(peak)
         return out
 
     def block(self, since: int = 0, top: int = 8) -> dict:

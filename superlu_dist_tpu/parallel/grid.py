@@ -53,8 +53,13 @@ def gridinit(nprow: int, npcol: int, devices=None) -> ProcessGrid:
         raise ValueError(
             f"grid {nprow}x{npcol} needs {need} devices, have {len(devices)}")
     dev = np.asarray(devices[:need]).reshape(nprow, npcol)
+    # axis names come from the central registry (utils/meshreg.py) so the
+    # runtime mesh and slulint SLU120's literal-spec vetting can never
+    # disagree about what an axis is called
+    from superlu_dist_tpu.utils.meshreg import require_axis
     return ProcessGrid(nprow=nprow, npcol=npcol,
-                       mesh=Mesh(dev, axis_names=("snode", "panel")))
+                       mesh=Mesh(dev, axis_names=(require_axis("snode"),
+                                                  require_axis("panel"))))
 
 
 def gridmap(device_ids, nprow: int, npcol: int) -> ProcessGrid:

@@ -518,6 +518,51 @@ class PrecisionAuditError(SuperLUError):
         _flight_dump(self)
 
 
+class ShardingAuditError(SuperLUError):
+    """Sharding-audit mode (``SLU_TPU_VERIFY_SHARDING=1``, slulint's v6
+    sharding rules — ``utils/programaudit.py``) rejected a jitted
+    program at construction/AOT-stage time: a gathering collective
+    materializes whole-buffer cross-shard traffic, or an explicit
+    constraint resolves a large buffer to a fully-replicated layout on a
+    non-trivial mesh (SLU119, ``analysis/rules_sharding.py``) — the
+    implicit-replication blowup that turns a pod-slice port into an OOM,
+    caught BEFORE the program runs.  ``findings`` holds the slulint
+    Finding records; dumps a flight-recorder postmortem at
+    construction."""
+
+    def __init__(self, site: str, program: str, findings):
+        self.site = site
+        self.program = program
+        self.findings = list(findings)
+        self.rules = sorted({f.rule for f in self.findings})
+        lines = "; ".join(f"{f.rule}: {f.message}" for f in self.findings)
+        super().__init__(
+            f"sharding audit failed for {site}[{program}] "
+            f"({', '.join(self.rules)}): {lines} "
+            "(SLU_TPU_VERIFY_SHARDING=1 — docs/ANALYSIS.md catalogs the "
+            "sharding rules)")
+        _flight_dump(self)
+
+
+class MemoryBudgetError(ShardingAuditError):
+    """The SLU121 static peak-memory model priced a program above
+    ``SLU_TPU_MEM_BUDGET_BYTES``: the liveness walk's high-water
+    live-byte estimate (args + baked consts + intermediates,
+    free-after-last-use) does not fit the declared per-device budget, so
+    the submit raises HERE — at program construction, naming the program
+    (for the mega executor: the offending bucket rung) and its largest
+    live buffers — instead of the first real MXU run dying in an opaque
+    device OOM.  A subclass of :class:`ShardingAuditError` so one
+    ``except`` covers the whole v6 audit family; ``peak_bytes`` /
+    ``budget_bytes`` carry the verdict."""
+
+    def __init__(self, site: str, program: str, findings,
+                 peak_bytes: int = 0, budget_bytes: int = 0):
+        self.peak_bytes = int(peak_bytes)
+        self.budget_bytes = int(budget_bytes)
+        super().__init__(site=site, program=program, findings=findings)
+
+
 class CollectiveMismatchError(SuperLUError):
     """Lockstep-verify mode (SLU_TPU_VERIFY_COLLECTIVES=1, slulint's
     runtime rule SLU106) detected ranks entering DIFFERENT collectives:

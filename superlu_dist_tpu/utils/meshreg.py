@@ -1,0 +1,69 @@
+"""Mesh-axis registry — the single source of truth for every mesh axis
+name the project shards over (the axis-name analog of the PR 3 env-knob
+registry in ``utils/options.py``).
+
+ROADMAP item 1 (the shard_map/pjit SPMD rewrite) multiplies the number
+of call sites that spell axis names as string literals; a typo'd axis
+(``"pannel"``) is not an error anywhere — jax just treats the dimension
+as replicated and the program silently gathers.  Declaring every axis
+here lets slulint rule SLU120 (``analysis/rules_sharding.py``) flag any
+``shard_map``/``pjit``/``Mesh``/``NamedSharding``/``PartitionSpec``
+call site whose literal axis name the registry does not declare — the
+same lexical closed-world bet SLU104 won for env knobs.
+
+The registry is import-cheap (no jax): the analysis tier reads it from
+rule construction, and ``parallel/grid.py`` builds its mesh from the
+canonical names below so the runtime and the lint rule can never
+disagree about what an axis is called.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+
+class UnknownAxisError(KeyError):
+    """A mesh axis name was used that the registry does not declare."""
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshAxis:
+    name: str
+    help: str
+
+
+AXIS_REGISTRY: dict[str, MeshAxis] = {}
+
+
+def register_axis(name: str, help: str) -> None:
+    AXIS_REGISTRY[name] = MeshAxis(name, help)
+
+
+def registered_axes() -> tuple:
+    """The declared axis names, sorted — what SLU120 validates literal
+    specs against."""
+    return tuple(sorted(AXIS_REGISTRY))
+
+
+def require_axis(name: str) -> str:
+    """Validate one axis name at runtime (mesh construction paths);
+    returns it unchanged or raises :class:`UnknownAxisError`."""
+    if name not in AXIS_REGISTRY:
+        raise UnknownAxisError(
+            f"mesh axis {name!r} is not declared in utils/meshreg.py "
+            f"(declared: {', '.join(registered_axes()) or 'none'}) — "
+            "register it there so slulint SLU120 can vet literal specs")
+    return name
+
+
+def _register_all() -> None:
+    r = register_axis
+    r("snode", "supernode-batch axis: fronts of one dispatch group are "
+      "scattered across devices along their batch dimension "
+      "(parallel/grid.py process grid rows)")
+    r("panel", "intra-front panel axis: the trailing front dimension a "
+      "partitioned Schur pool shards over (parallel/grid.py process "
+      "grid columns; SLU_TPU_POOL_PARTITION)")
+
+
+_register_all()

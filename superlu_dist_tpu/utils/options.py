@@ -250,6 +250,26 @@ def _register_all() -> None:
       "runs; feeds slu_precision_audit_total and `label#dtypes` census "
       "audit notes.  Independent of SLU_TPU_VERIFY_PROGRAMS",
       group="parallel")
+    r("SLU_TPU_VERIFY_SHARDING", "flag", False,
+      "sharding-audit mode (utils/programaudit.py): every jitted "
+      "program the executors build is additionally walked against the "
+      "slulint v6 sharding/memory rules — SLU119 implicit replication/"
+      "reshard blowup (an op whose operand shardings force an implicit "
+      "all-gather or a >= 1 MiB reshard), SLU121 static peak-live-bytes "
+      "against SLU_TPU_MEM_BUDGET_BYTES — raising ShardingAuditError/"
+      "MemoryBudgetError before the program runs; feeds "
+      "slu_sharding_audit_total and `label#sharding` census audit notes "
+      "(peak_bytes_est, replicated_bytes).  Independent of "
+      "SLU_TPU_VERIFY_PROGRAMS/SLU_TPU_VERIFY_DTYPES", group="parallel")
+    r("SLU_TPU_MEM_BUDGET_BYTES", "int", 0,
+      "per-program static peak-memory budget in bytes (0 = off): the "
+      "SLU121 liveness walk's high-water live-byte estimate (args + "
+      "consts + intermediates, free-after-last-use) must fit it or the "
+      "submit raises MemoryBudgetError naming the program — the mega "
+      "executor's padded-rung bucket programs are the first real "
+      "consumer (the error names the offending bucket rung).  Setting "
+      "it implies the sharding audit even without "
+      "SLU_TPU_VERIFY_SHARDING=1", group="parallel")
     r("SLU_TPU_VERIFY_LOCKS", "flag", False,
       "lock-order verify mode (utils/lockwatch.py): instrument every "
       "make_lock/make_condition lock, record per-thread acquisition "

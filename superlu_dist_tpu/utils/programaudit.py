@@ -26,11 +26,22 @@ raises :class:`PrecisionAuditError` before the program runs.  The two
 knobs are independent — either, both, or neither; census notes are keyed
 ``label#dtypes`` so the program-audit coverage accounting never double-
 counts, and the off path allocates nothing, same contract.
+
+The v6 sharding twin (``SLU_TPU_VERIFY_SHARDING=1``, or implied by a
+positive ``SLU_TPU_MEM_BUDGET_BYTES``) is the third leg: every
+submitted program is walked for implicit replication/reshard blowup
+(SLU119) and priced by the static peak-memory model (SLU121,
+``analysis/rules_sharding.py``); an SLU121 budget breach raises
+:class:`MemoryBudgetError` (naming the program — for the mega executor,
+the offending bucket rung), any other finding
+:class:`ShardingAuditError`, both before the program runs.  Census
+notes are keyed ``label#sharding`` and carry ``peak_bytes_est`` /
+``replicated_bytes`` — the memory column of the compile census.
 """
 
 from __future__ import annotations
 
-from superlu_dist_tpu.utils.options import env_flag
+from superlu_dist_tpu.utils.options import env_flag, env_int
 
 #: SLU111 only flags dead-but-not-donated inputs at least this large —
 #: small scalars/index vectors are not the peak-memory axis
@@ -39,8 +50,13 @@ DONATE_MIN_BYTES = 1 << 20
 #: (thresholds, iota tables) are not the per-matrix-capture pattern
 CONST_MAX_BYTES = 1 << 18
 
+#: SLU119 only prices gathers/replications at least this large — a
+#: replicated scalar threshold or index vector is not the OOM axis
+RESHARD_MIN_BYTES = 1 << 20
+
 _AUDITOR = None
 _DTYPE_AUDITOR = None
+_SHARDING_AUDITOR = None
 
 
 def get_auditor():
@@ -65,11 +81,26 @@ def get_dtype_auditor():
     return _DTYPE_AUDITOR
 
 
+def get_sharding_auditor():
+    """The process-wide SHARDING/MEMORY auditor, or None (allocating
+    nothing) when both ``SLU_TPU_VERIFY_SHARDING`` and
+    ``SLU_TPU_MEM_BUDGET_BYTES`` are off — a positive byte budget
+    implies the audit without the flag."""
+    global _SHARDING_AUDITOR
+    budget = env_int("SLU_TPU_MEM_BUDGET_BYTES")
+    if not env_flag("SLU_TPU_VERIFY_SHARDING") and budget <= 0:
+        return None
+    if _SHARDING_AUDITOR is None:
+        _SHARDING_AUDITOR = ShardingAuditor(budget_bytes=budget)
+    return _SHARDING_AUDITOR
+
+
 def _reset() -> None:
     """Test hygiene: drop the singletons so a knob flip re-latches."""
-    global _AUDITOR, _DTYPE_AUDITOR
+    global _AUDITOR, _DTYPE_AUDITOR, _SHARDING_AUDITOR
     _AUDITOR = None
     _DTYPE_AUDITOR = None
+    _SHARDING_AUDITOR = None
 
 
 def find_build_site(site: str) -> str | None:
@@ -186,11 +217,66 @@ class DtypeAuditor:
         return stats
 
 
+class ShardingAuditor:
+    """The v6 sharding/memory twin: audits each (site, label) program
+    once for implicit replication/reshard blowup (SLU119) and prices it
+    against the static peak-memory budget (SLU121), memoized like
+    :class:`DtypeAuditor`.  Separate singleton so any knob subset works
+    alone (each active twin re-traces the program once at construction —
+    an accepted one-time cost)."""
+
+    def __init__(self, reshard_min_bytes: int = RESHARD_MIN_BYTES,
+                 budget_bytes: int = 0):
+        self.reshard_min_bytes = int(reshard_min_bytes)
+        self.budget_bytes = int(budget_bytes)
+        self.audited: dict = {}     # (site, label) -> stats dict
+        self.findings: list = []    # every finding ever raised (evidence)
+
+    def submit(self, site: str, label: str, fn, args, *, dead=(),
+               donated=None, mesh_axes=()) -> dict:
+        """Trace + sharding/memory-audit one program; raises
+        MemoryBudgetError on an SLU121 budget breach, ShardingAuditError
+        on any other finding, returns the stats dict when clean."""
+        key = (site, label)
+        hit = self.audited.get(key)
+        if hit is not None:
+            return hit
+        from superlu_dist_tpu.analysis.program import (audit_sharding,
+                                                       trace_spec)
+        spec = trace_spec(fn, args, label=label, site=site, dead=dead,
+                          donated=donated, mesh_axes=mesh_axes)
+        findings, stats = audit_sharding(spec, self.reshard_min_bytes,
+                                         self.budget_bytes)
+        from superlu_dist_tpu.obs.compilestats import COMPILE_STATS
+        # keyed off the program label so the SLU111 coverage accounting
+        # (audit_block counts programs = len(notes)) never double-counts
+        COMPILE_STATS.audit_note(site, f"{label}#sharding", stats)
+        from superlu_dist_tpu.obs.metrics import get_metrics
+        m = get_metrics()
+        if m.enabled:
+            m.inc("slu_sharding_audit_total", 1.0, site=site,
+                  result="finding" if findings else "clean")
+        if findings:
+            self.findings.extend(findings)
+            from superlu_dist_tpu.utils.errors import (MemoryBudgetError,
+                                                       ShardingAuditError)
+            if any(f.rule == "SLU121" for f in findings):
+                raise MemoryBudgetError(
+                    site=site, program=label, findings=findings,
+                    peak_bytes=stats.get("peak_bytes_est", 0),
+                    budget_bytes=self.budget_bytes)
+            raise ShardingAuditError(site=site, program=label,
+                                     findings=findings)
+        self.audited[key] = stats
+        return stats
+
+
 def maybe_audit(site: str, label: str, fn, args, *, dead=(),
                 donated=None, mesh_axes=()) -> dict | None:
-    """One-line build-site hook: no-op (no state) when both knobs are
+    """One-line build-site hook: no-op (no state) when every knob is
     off.  Runs the SLU111/112/114 auditor first, then the precision
-    twin; each memoizes independently."""
+    twin, then the v6 sharding/memory twin; each memoizes
+    independently."""
     aud = get_auditor()
     out = None
     if aud is not None:
@@ -199,6 +285,11 @@ def maybe_audit(site: str, label: str, fn, args, *, dead=(),
     daud = get_dtype_auditor()
     if daud is not None:
         stats = daud.submit(site, label, fn, args, dead=dead,
+                            donated=donated, mesh_axes=mesh_axes)
+        out = out if out is not None else stats
+    saud = get_sharding_auditor()
+    if saud is not None:
+        stats = saud.submit(site, label, fn, args, dead=dead,
                             donated=donated, mesh_axes=mesh_axes)
         out = out if out is not None else stats
     return out

@@ -1,0 +1,22 @@
+"""SLU119 true-positive fixture (executable): a shard_map program whose
+body all-gathers the whole sharded pool onto every shard — the
+implicit-replication blowup the jaxpr walk prices.  ``build(mesh)``
+returns ``(jitted_fn, args)`` sized so the gathered output crosses the
+1 MiB RESHARD_MIN_BYTES threshold (f32[512,512] -> 1 MiB gathered)."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def build(mesh):
+    def gather_pool(pool):
+        def body(p):
+            # materializes the WHOLE pool on every shard
+            g = jax.lax.all_gather(p, "snode")
+            return jnp.sum(g)
+        return shard_map(body, mesh=mesh, in_specs=(P("snode"),),
+                         out_specs=P(), check_rep=False)(pool)
+
+    args = (jnp.zeros((512, 512), jnp.float32),)
+    return jax.jit(gather_pool), args

@@ -1,0 +1,19 @@
+"""SLU121 true-positive fixture (executable): a program whose
+intermediates all stay live to the last equation — the high-water mark
+is ~5x one buffer, the padded-rung-pool pattern the static peak-memory
+model exists to price.  ``build()`` returns ``(jitted_fn, args)`` with
+f32[256,256] buffers (256 KiB each)."""
+import jax
+import jax.numpy as jnp
+
+
+def build():
+    def widen(x):
+        a = x * 2.0
+        b = x * 3.0
+        c = x * 4.0
+        # a, b, c and x are ALL live here: nothing frees before the end
+        return a + b + c + x, a, b, c
+
+    args = (jnp.zeros((256, 256), jnp.float32),)
+    return jax.jit(widen), args

@@ -1,0 +1,18 @@
+"""SLU121 clean twin of mem_blowup.py: the same arithmetic volume as a
+sequential chain — each intermediate dies at the next equation, so the
+high-water mark stays ~2 buffers no matter how long the chain gets.
+``build()`` returns ``(jitted_fn, args)`` with the same f32[256,256]
+buffer size."""
+import jax
+import jax.numpy as jnp
+
+
+def build():
+    def chain(x):
+        y = x * 2.0        # x dies here
+        y = y * 3.0
+        y = y * 4.0
+        return jnp.sum(y)
+
+    args = (jnp.zeros((256, 256), jnp.float32),)
+    return jax.jit(chain), args

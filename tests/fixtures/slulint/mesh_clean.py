@@ -1,0 +1,29 @@
+"""SLU120 clean twin of unregistered_axis.py: every axis name comes
+from the utils/meshreg.py registry ("snode"/"panel"), the in_specs
+arity mirrors the wrapped signature, and the donated argument carries
+an explicit P(...) layout."""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def panel_update(pool, piv):
+    return pool + piv
+
+
+def good_mesh(devs):
+    return Mesh(devs, axis_names=("snode", "panel"))
+
+
+def good_specs(mesh, pool, piv):
+    fn = shard_map(panel_update, mesh=mesh,
+                   in_specs=(P("snode"), P(None)),
+                   out_specs=P("snode"))
+    return fn(pool, piv)
+
+
+def good_donation(mesh):
+    return jax.jit(shard_map(panel_update, mesh=mesh,
+                             in_specs=(P("snode"), P("panel")),
+                             out_specs=P("snode")),
+                   donate_argnums=(0,))

@@ -1,0 +1,21 @@
+"""SLU119 clean twin of implicit_gather.py: the same shard_map shape,
+but the pool stays shard-resident — the body reduces with psum (output
+is shard-shaped, deliberately not a gathering primitive) and the result
+keeps its P("snode") layout.  ``build(mesh)`` returns
+``(jitted_fn, args)``."""
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import PartitionSpec as P
+
+
+def build(mesh):
+    def scale_pool(pool):
+        def body(p):
+            norm = jax.lax.psum(jnp.sum(jnp.abs(p)), "snode")
+            return p / (norm + 1.0)
+        return shard_map(body, mesh=mesh, in_specs=(P("snode"),),
+                         out_specs=P("snode"))(pool)
+
+    args = (jnp.zeros((512, 512), jnp.float32),)
+    return jax.jit(scale_pool), args

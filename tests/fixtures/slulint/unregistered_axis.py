@@ -1,0 +1,44 @@
+"""SLU120 true-positive fixture (mesh/spec hygiene): axis names that
+are not declared in utils/meshreg.py, an in_specs arity that does not
+match the wrapped function, and a donated spec-less argument.  jax
+rejects NONE of these — a typo'd axis just silently replicates the
+dimension, which is why the registry check exists."""
+import jax
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def panel_update(pool, piv):
+    return pool + piv
+
+
+def bad_mesh(devs):
+    # flagged twice: neither "row" nor "col" is a registered axis
+    return Mesh(devs, axis_names=("row", "col"))
+
+
+def bad_specs(mesh, pool, piv):
+    # flagged twice: "rows" (in_specs) and "rows" (out_specs) are not
+    # registered axes ("snode" is — the typo the registry catches)
+    fn = shard_map(panel_update, mesh=mesh,
+                   in_specs=(P("rows"), P(None)),
+                   out_specs=P("rows"))
+    return fn(pool, piv)
+
+
+def bad_arity(mesh, pool, piv):
+    # flagged once: one spec for a two-argument function — jax reports
+    # this as an opaque tree mismatch at trace time
+    fn = shard_map(panel_update, mesh=mesh,
+                   in_specs=(P("snode"),),
+                   out_specs=P("snode"))
+    return fn(pool, piv)
+
+
+def bad_donation(mesh):
+    # flagged once: donated argument 1 carries no P(...) spec — the
+    # aliased buffer is replicated, so every device still reads it
+    return jax.jit(shard_map(panel_update, mesh=mesh,
+                             in_specs=(P("snode"), None),
+                             out_specs=P("snode")),
+                   donate_argnums=(1,))
