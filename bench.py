@@ -31,7 +31,11 @@ Env knobs: BENCH_NX (grid edge, default 48 -> n=110592; a default-config
 TPU run downsizes to 16 when the compile cache is cold and the deadline
 is tight — see the cold-cache guard in main), BENCH_REPS,
 BENCH_DEADLINE_S (watchdog, default 1350), BENCH_PEAK_F32_TFLOPS (MFU
-denominator), BENCH_NO_PROBE (skip the device-reachability probe).
+denominator), BENCH_NO_PROBE (skip the device-reachability probe),
+BENCH_MESH (an 'RxC' mesh spec, e.g. 1x8: factor/solve run over a real
+jax.Mesh through the shard_map SPMD tier and the row carries
+mesh_shape/n_devices/spmd — virtual CPU devices when the backend is
+cpu, so MULTICHIP rows are real measurements off-hardware too).
 """
 
 import json
@@ -200,6 +204,28 @@ def _probe_device(timeout_s: float = 240.0) -> bool:
 def main():
     threading.Thread(target=_watchdog, daemon=True).start()
 
+    # BENCH_MESH=RxC: the multichip bench mode — factor/solve run over a
+    # real jax.Mesh (virtual CPU devices when the backend is cpu, chips
+    # on TPU) through the shard_map SPMD tier (parallel/spmd.py), and
+    # the row carries mesh_shape/n_devices/spmd instead of being a
+    # single-device row.  The device-count config must land BEFORE the
+    # probe initializes the backend.
+    MESH_SPEC = os.environ.get("BENCH_MESH", "")
+    MESH_DIMS = None
+    if MESH_SPEC:
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "scripts"))
+        from _common import parse_mesh_spec
+        MESH_DIMS = parse_mesh_spec(MESH_SPEC)
+        # cpu-platform only (a TPU brings its real chips): XLA snapshots
+        # XLA_FLAGS at backend init, which has not happened yet — the
+        # probe below is the first jax operation
+        if "host_platform_device_count" not in os.environ.get(
+                "XLA_FLAGS", ""):
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={MESH_DIMS[2]}")
+
     probed = (None if os.environ.get("BENCH_NO_PROBE")
               else _probe_device())
     if os.environ.get("BENCH_REQUIRE_TPU") and not os.environ.get(
@@ -321,6 +347,8 @@ def main():
               "SLU_TPU_SCHED_ALIGN", "SLU_TPU_BUCKET_BASE",
               "SLU_TPU_BUCKET_GROWTH", "SLU_TPU_BUCKET_CLOSED",
               "SLU_TPU_BUCKET_KEYS", "SLU_TPU_EXECUTOR",
+              # mesh mode compiles a different program set entirely
+              "BENCH_MESH", "SLU_TPU_SPMD",
               # solve-kernel-set knobs (solve/plan.py): a set one means
               # a deliberate solve sweep with its own deadline discipline
               "BENCH_SOLVE_NRHS", "SLU_TPU_SOLVE_SCHEDULE",
@@ -396,6 +424,20 @@ def main():
 
     backend = jax.default_backend()
     RESULT["backend"] = backend
+    # cache_isa_mismatch: enable_compile_cache above verified the cache
+    # dir's host-feature stamp — nonzero means a foreign-entry class the
+    # fingerprint failed to scope out (the BENCH_r05 'machine features
+    # don't match ... SIGILL' tail); the gate asserts it stays 0
+    from superlu_dist_tpu.utils.jaxcache import isa_mismatch_count
+    RESULT["cache_isa_mismatch"] = isa_mismatch_count()
+    MESH = None
+    if MESH_DIMS:
+        from superlu_dist_tpu.parallel.grid import gridinit
+        MESH = gridinit(MESH_DIMS[0], MESH_DIMS[1]).mesh
+        RESULT["mesh_shape"] = [MESH_DIMS[0], MESH_DIMS[1]]
+        RESULT["n_devices"] = MESH_DIMS[2]
+        _log(f"mesh mode: {MESH_DIMS[0]}x{MESH_DIMS[1]} "
+             f"({MESH_DIMS[2]} {backend} devices)")
     if os.environ.get("BENCH_REQUIRE_TPU") and backend == "cpu":
         # closes the BENCH_NO_PROBE hole: with the probe skipped the
         # earlier require-check can't fire, so verify the resolved
@@ -429,8 +471,10 @@ def main():
     # wants the shape-key set CLOSED at plan build (numeric/plan.py —
     # the O(1)-compiled-programs contract), which an explicit
     # SLU_TPU_BUCKET_CLOSED setting can still override either way
-    gran = os.environ.get("BENCH_GRANULARITY",
-                          "fused" if backend == "cpu" else "group")
+    gran = os.environ.get(
+        "BENCH_GRANULARITY",
+        ("auto" if MESH is not None            # -> spmd via get_executor
+         else "fused" if backend == "cpu" else "group"))
     _closed = (True if gran == "mega"
                and "SLU_TPU_BUCKET_CLOSED" not in os.environ else None)
     plan = build_plan(sf, min_bucket=MIN_BUCKET, growth=GROWTH,
@@ -493,7 +537,22 @@ def main():
     # dispatch (BENCH_r03, 0.66x scipy) while compile is cheap; group
     # on accelerators, where per-kernel compile through the tunnel
     # dominates instead.  (gran itself is resolved above, pre-plan.)
-    if gran == "mega":
+    if MESH is not None:
+        # mesh mode routes through the central dispatch so the auto rule
+        # (numeric/factor.py) picks the shard_map SPMD tier on a
+        # single-process mesh; BENCH_GRANULARITY still names an explicit
+        # tier (spmd|stream|mega|fused — "group"/"level" mean stream)
+        from superlu_dist_tpu.numeric.factor import get_executor
+        ex = get_executor(plan, DTYPE,
+                          executor={"group": "stream",
+                                    "level": "stream"}.get(gran, gran),
+                          mesh=MESH, gemm_prec=GEMM_PREC)
+        # spmd: did the row actually run the one-program shard_map tier
+        # (granularity "program"), or a GSPMD streamed/mega fallback?
+        RESULT["spmd"] = ex.granularity == "program"
+        _log(f"mesh executor: {type(ex).__name__} "
+             f"(granularity={ex.granularity}, spmd={RESULT['spmd']})")
+    elif gran == "mega":
         from superlu_dist_tpu.numeric.mega import MegaExecutor
         ex = MegaExecutor(plan, DTYPE)
     elif gran == "fused":
@@ -697,7 +756,7 @@ def main():
         lu = LUFactorization(n=n, options=Options(), equed="N", dr=ones,
                              dc=ones, r1=ones, c1=ones, row_order=ident,
                              col_order=None, sf=sf, plan=plan,
-                             numeric=numeric, a=a)
+                             numeric=numeric, a=a, mesh=MESH)
         xt = np.random.default_rng(0).standard_normal(n)
         b = a.matvec(xt)
         x, _ = iterative_refinement(a, b, lu.solve_factored(b),
@@ -721,6 +780,12 @@ def main():
                       else "host")
         if lu.solve_path == "host" and backend != "cpu":
             solve_path = "host-fallback"
+        if MESH is not None and lu.dev_solver is not None:
+            from superlu_dist_tpu.parallel.spmd import SpmdSolver
+            if isinstance(lu.dev_solver, SpmdSolver):
+                # the mesh row's triangular sweeps ran as shard_map
+                # programs (one per sweep bucket), not the host loop
+                solve_path = "device-spmd"
         RESULT["solve_path"] = solve_path
         _log(f"residual {RESULT['residual']:.2e} via {solve_path} solve")
     except Exception as e:                       # pragma: no cover
@@ -795,6 +860,10 @@ def main():
                 RESULT["latency_p50_ms"] = dict(lat50)
                 RESULT["latency_p99_ms"] = dict(lat99)
                 RESULT["solve_path"] = "device"
+                if MESH is not None and lu.dev_solver is not None:
+                    from superlu_dist_tpu.parallel.spmd import SpmdSolver
+                    if isinstance(lu.dev_solver, SpmdSolver):
+                        RESULT["solve_path"] = "device-spmd"
                 if lu.dev_solver is not None \
                         and lu.dev_solver.last_solve_stats:
                     RESULT["solve_padding_factor"] = \

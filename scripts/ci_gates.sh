@@ -111,6 +111,15 @@
 #                   estimates within 2x of XLA memory_analysis; a tiny
 #                   budget proves MemoryBudgetError fires BEFORE any
 #                   program runs, naming the bucket rung
+#   spmd-equiv      scripts/check_spmd_equiv.py       shard_map SPMD
+#                   tier on the 8-virtual-device mesh: ONE compiled
+#                   factor program regardless of n, L/U and solve/
+#                   transpose-solve bitwise vs the fused+stream
+#                   lockstep executors and the lockstep DeviceSolver,
+#                   the demoted TreeComm tier still bitwise vs the
+#                   gssvx driver (the A/B reference chain), and every
+#                   mesh program audits clean (0 sharding findings,
+#                   100% donation coverage) under the runtime auditors
 #
 # Scan sharing: the slulint gate (and any other in-tree slulint
 # invocation) reads/writes the content-hash scan cache
@@ -151,9 +160,10 @@ declare -A GATES=(
   [precision-lint]="python scripts/check_precision_lint.py"
   [refactor-consistency]="python scripts/check_refactor.py"
   [sharding-audit]="python scripts/check_sharding_audit.py"
+  [spmd-equiv]="python scripts/check_spmd_equiv.py"
 )
 ORDER=(slulint precision-lint sharding-audit program-audit verify-overhead
-       schedule-equiv solve-equiv precision-safety serve-robust
+       schedule-equiv solve-equiv spmd-equiv precision-safety serve-robust
        fleet-failover refactor-consistency crash-resume rank-failure
        compile-budget tsan-native trace-overhead nan-guards
        perf-regress slo-gate)

@@ -185,23 +185,45 @@ class LUFactorization:
                           # host — re-uploading them each solve would cost
                           # more than the device solve saves
                           and not self.numeric.on_host))
+        # a SINGLE-process mesh routes to the shard_map SPMD tier
+        # (parallel/spmd.SpmdSolver): the whole fwd+bwd sweep is ONE
+        # compiled program per nrhs bucket, bitwise-identical to the
+        # local DeviceSolver (so the lockstep fallback below stays a
+        # valid recovery path)
+        spmd = False
+        if (self.mesh is not None and not multiproc
+                and self.solve_path != "host"
+                and not self.numeric.on_host):
+            from superlu_dist_tpu.parallel.spmd import spmd_mode
+            spmd = spmd_mode()
+            use_device = use_device or spmd
         if use_device:
             try:
                 if self.dev_solver is None:
-                    from superlu_dist_tpu.solve.device import DeviceSolver
-                    # multiproc: streamed sweeps (fused=False) — the
-                    # whole-sweep programs at n≈1e5 hit the same compile
-                    # wall as the fused factor executor (see
-                    # factor.get_executor's auto rule)
-                    self.dev_solver = DeviceSolver(
-                        self.numeric, diag_inv=self.options.diag_inv,
-                        mesh=self.mesh if multiproc else None,
-                        fused=False if multiproc else "auto",
-                        schedule=self.options.solve_schedule,
-                        window=self.options.solve_window,
-                        align=self.options.solve_align,
-                        gemm_prec=getattr(self.options, "gemm_prec",
-                                          None))
+                    if spmd:
+                        from superlu_dist_tpu.parallel.spmd import SpmdSolver
+                        self.dev_solver = SpmdSolver(
+                            self.numeric, self.mesh,
+                            schedule=self.options.solve_schedule,
+                            window=self.options.solve_window,
+                            align=self.options.solve_align,
+                            gemm_prec=getattr(self.options, "gemm_prec",
+                                              None))
+                    else:
+                        from superlu_dist_tpu.solve.device import DeviceSolver
+                        # multiproc: streamed sweeps (fused=False) — the
+                        # whole-sweep programs at n≈1e5 hit the same compile
+                        # wall as the fused factor executor (see
+                        # factor.get_executor's auto rule)
+                        self.dev_solver = DeviceSolver(
+                            self.numeric, diag_inv=self.options.diag_inv,
+                            mesh=self.mesh if multiproc else None,
+                            fused=False if multiproc else "auto",
+                            schedule=self.options.solve_schedule,
+                            window=self.options.solve_window,
+                            align=self.options.solve_align,
+                            gemm_prec=getattr(self.options, "gemm_prec",
+                                              None))
                 return device_call(self.dev_solver)
             except Exception as e:
                 if self.solve_path != "auto" or multiproc:

@@ -9,12 +9,18 @@ update pool plays the role of the reference's bigU/bigV GEMM buffers
 (pdgstrf.c:770-884) and the device-computed extend-add indices the role of
 the dscatter_l/u index arithmetic (SRC/dscatter.c:111-290).
 
-Two executors share the same per-group step (`group_step`):
+Four executors share the same per-group step (`group_step`):
   * make_factor_fn — the whole factorization traced into ONE jittable XLA
-    program (best for moderate plans and for mesh-sharded runs);
+    program (best for moderate plans);
   * stream.StreamExecutor — one small jitted kernel per shape key, groups
     streamed through asynchronously (best on real TPU where giant programs
-    compile slowly).
+    compile slowly);
+  * mega.MegaExecutor — shape-closed bucketed programs, O(1) compile
+    count across matrices (and, since the SPMD tier, under a mesh);
+  * parallel.spmd.SpmdFactorExecutor — the shard_map tier: the whole
+    factorization as ONE SPMD program over the mesh, slots block-cyclic
+    over the devices and the collectives in-program ops XLA can overlap
+    with compute (the pdgstrf look-ahead shape).
 """
 
 from __future__ import annotations
@@ -60,7 +66,7 @@ def extend_add_set(f, pool, m, ub, child_off, child_slot, rel):
 def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
                children, front_sharding=None, pivot_sharding=None,
                replicated=None, pivot="blocked", gemm_prec="highest",
-               pallas="off"):
+               pallas="off", write_back=True):
     """One (level, bucket) group: assemble + factor + write back.
 
     dims = (batch, m, w, u) static; `children` is either a list of
@@ -79,7 +85,17 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
     both are baked into the cached jitted factories' keys, never read
     from env here (slulint SLU102/SLU105).  The Pallas path is bitwise-
     identical to the ``.at[]`` lowering, so every executor-equivalence
-    contract is mode-independent; sharded runs arrive with pallas="off".
+    contract is mode-independent — including under a mesh, where the
+    SPMD tier runs it per-shard inside shard_map (interpret mode on CPU
+    meshes, native on TPU; see parallel/spmd.py).
+
+    ``write_back=False`` (the SPMD per-shard path) skips the pool
+    scatter and returns the raw (batch, u*u) Schur values in the pool's
+    position instead (None when u == 0): inside shard_map each device
+    factors only its slot partition, so the full-order pool write is
+    replayed by the caller AFTER the all-gather — keeping the exact
+    scatter sequence (and hence bitwise factors) of the write_back=True
+    lowering every other executor runs.
     """
     batch, m, w, u = dims
     dt = pool.dtype
@@ -145,8 +161,12 @@ def group_step(dims, avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
         vals = schur.reshape(batch, u * u)
         if replicated is not None:
             vals = wsc(vals, replicated)
+        if not write_back:
+            return (lpanel, upanel), vals, tiny
         dst = off[:, None] + jnp.arange(u * u)         # off==pool_size drops
         pool = pool.at[dst].set(vals, mode="drop")
+    elif not write_back:
+        return (lpanel, upanel), None, tiny
     return (lpanel, upanel), pool, tiny
 
 
@@ -268,13 +288,14 @@ def make_factor_fn(plan: FactorPlan, dtype="float64", mesh=None,
     # SLU_TPU_PIVOT_KERNEL / SLU_TPU_GEMM_PREC / SLU_TPU_PALLAS resolved
     # HERE, in the uncached factory, and closed over as constants —
     # get_executor keys the fused executor on them, and the traced body
-    # must not read env (slulint SLU102/SLU105).  Sharded runs pin the
-    # Pallas path off (the SPMD partitioner owns the layout).
+    # must not read env (slulint SLU102/SLU105).  Mesh runs no longer
+    # pin Pallas off: the resolved mode rides through (auto still means
+    # off on CPU backends, interpret/on must be asked for explicitly).
     from superlu_dist_tpu.numeric.pallas_kernels import pallas_mode
     from superlu_dist_tpu.ops.dense import gemm_precision, pivot_kernel
     pivot = pivot_kernel()
     gemm_prec = gemm_precision(gemm_prec)
-    pallas = "off" if mesh is not None else pallas_mode(pallas)
+    pallas = pallas_mode(pallas)
 
     def fn(avals, thresh, *flat):
         avals = avals.astype(dtype)
@@ -373,28 +394,37 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
 
     executor: "fused" (one XLA program — fast dispatch, compile grows with
     plan size), "stream" (per-bucket kernels — compile count is bounded,
-    right for real TPU where program compile is expensive), or "auto"
-    (stream on accelerators AND on multi-process meshes, fused on
-    single-controller CPU).  A mesh spanning processes forces stream for
-    the same reason real TPU does: the fused whole-program jit's compile
-    time grows with the plan (an n≈1e5 SPMD program took >60 min on
-    XLA:CPU), while the streamed kernels' compile count is bounded by
-    distinct shape keys.  mesh shards either executor over
+    right for real TPU where program compile is expensive), "mega"
+    (bucketed shape-closed programs, O(1) compile count), "spmd" (the
+    shard_map tier, parallel/spmd.py: ONE compiled program per factor
+    with the collectives as in-program ops), or "auto".  Auto picks
+    spmd on a single-process mesh (unless SLU_TPU_SPMD=0 or the pool is
+    partitioned), stream on multi-process meshes and accelerators, and
+    fused on single-controller CPU.  A mesh spanning processes keeps
+    stream for the same reason real TPU does: the fused whole-program
+    jit's compile time grows with the plan (an n≈1e5 SPMD program took
+    >60 min on XLA:CPU), while the streamed kernels' compile count is
+    bounded by distinct shape keys.  mesh shards every executor over
     ("snode", "panel"); pool_partition shards the Schur pool across all
     mesh devices (see make_factor_fn).
     """
-    if executor not in ("auto", "fused", "stream", "mega"):
-        raise ValueError(f"executor must be auto|fused|stream|mega, "
+    if executor not in ("auto", "fused", "stream", "mega", "spmd"):
+        raise ValueError(f"executor must be auto|fused|stream|mega|spmd, "
                          f"got {executor!r}")
+    multiproc = mesh is not None and jax.process_count() > 1
     if executor == "auto":
-        multiproc = mesh is not None and jax.process_count() > 1
-        executor = ("fused" if jax.default_backend() == "cpu"
-                    and not multiproc else "stream")
-    if executor == "mega" and mesh is not None:
-        # the mega executor has no SPMD story yet (its per-bucket
-        # programs take metadata as runtime arguments the partitioner
-        # would have to replicate anyway) — mesh runs keep the streamed
-        # per-key kernels, which shard
+        from superlu_dist_tpu.parallel.spmd import spmd_mode
+        if (mesh is not None and not multiproc and not pool_partition
+                and spmd_mode()):
+            executor = "spmd"
+        else:
+            executor = ("fused" if jax.default_backend() == "cpu"
+                        and not multiproc else "stream")
+    if executor == "spmd" and (mesh is None or multiproc or pool_partition):
+        # the shard_map tier is single-controller over a local mesh and
+        # replays the full-order pool on every device (its bitwise
+        # contract) — no mesh, a multi-process mesh, or a partitioned
+        # pool keep the streamed GSPMD kernels
         executor = "stream"
     cache = getattr(plan, "_factor_fns", None)
     if cache is None:
@@ -409,7 +439,7 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
     # pivot-kernel choice, which StreamExecutor re-reads per call
     # (stream._kernel / _level_fns key on it)
     gemm_prec = gemm_precision(gemm_prec)
-    pallas = "off" if mesh is not None else pallas_mode()
+    pallas = pallas_mode()
     key = (str(jnp.dtype(dtype)), executor, mesh, bool(pool_partition),
            gemm_prec, pallas,
            pivot_kernel() if executor == "fused" else None,
@@ -426,8 +456,13 @@ def get_executor(plan: FactorPlan, dtype="float64", executor: str = "auto",
                                 gemm_prec=gemm_prec, pallas=pallas)
         elif executor == "mega":
             from superlu_dist_tpu.numeric.mega import MegaExecutor
-            fn = MegaExecutor(plan, dtype, gemm_prec=gemm_prec,
-                              pallas=pallas)
+            fn = MegaExecutor(plan, dtype, mesh=mesh,
+                              pool_partition=pool_partition,
+                              gemm_prec=gemm_prec, pallas=pallas)
+        elif executor == "spmd":
+            from superlu_dist_tpu.parallel.spmd import SpmdFactorExecutor
+            fn = SpmdFactorExecutor(plan, dtype, mesh,
+                                    gemm_prec=gemm_prec, pallas=pallas)
         else:
             fn = make_factor_fn(plan, dtype, mesh=mesh,
                                 pool_partition=pool_partition,
@@ -505,8 +540,9 @@ def numeric_factorize(plan: FactorPlan, pattern_values: np.ndarray,
     want_ckpt = bool(ckpt_dir) or ckpt_every > 0
     if want_ckpt or resume_from:
         # checkpoints need per-group boundaries: the streamed and mega
-        # executors have them, the fused whole-program jit does not
-        if executor in ("auto", "fused"):
+        # executors have them, the fused and spmd whole-program jits
+        # do not
+        if executor in ("auto", "fused", "spmd"):
             executor = "stream"
     if want_ckpt:
         from superlu_dist_tpu.persist.checkpoint import FactorCheckpointer

@@ -34,10 +34,18 @@ because closure runs before the schedule branch).  The PR 7 checkpoint
 / resume splice is preserved: frontiers store the UNPADDED pool, so a
 mega checkpoint resumes under stream and vice versa.
 
-Single-device by design: the per-bucket programs take all metadata as
-runtime arguments, which XLA's SPMD partitioner would pin replicated
-anyway — mesh runs keep the streamed per-key kernels
-(factor.get_executor downgrades mega→stream on a mesh).
+Mesh runs: the per-bucket programs shard exactly like the streamed
+kernels (stream._kernel) — batch-over-"snode", columns-over-"panel" on
+the dense factor math, replicated index metadata, the Schur pool
+replicated or 1-D partitioned via ``factor.pool_spec`` — so a mesh no
+longer downgrades mega→stream: the closed program set and the GSPMD
+sharding compose.  The bitwise guarantee above is a SINGLE-DEVICE
+contract; under GSPMD the partitioner re-tiles the batched triangular
+solves, which (like stream-under-mesh) perturbs low-order bits — mesh
+runs carry the allclose-class contract instead, and the BITWISE mesh
+tier is the shard_map executor (parallel/spmd.py), whose full-order
+replay sidesteps the partitioner entirely
+(tests/test_spmd.py exercises mega-under-mesh both ways).
 """
 
 from __future__ import annotations
@@ -64,7 +72,8 @@ _STORE_GROWTH = 1.25
 
 @functools.lru_cache(maxsize=None)
 def _mega_kernel(dims, la, child_dims, pool_len, avals_len, dtype, pivot,
-                 gemm_prec="highest", pallas="off"):
+                 gemm_prec="highest", pallas="off", mesh=None,
+                 pool_partition=False):
     """ONE jitted program for a closed shape bucket.
 
     Everything per-group — which fronts, which A entries, which children
@@ -74,15 +83,33 @@ def _mega_kernel(dims, la, child_dims, pool_len, avals_len, dtype, pivot,
     SLU_TPU_PALLAS choices (part of this cache key — slulint SLU105).
     The stacked-children extend-add keeps the .at[] scan under every
     pallas mode (its per-set ub is traced); the A-assembly takes the
-    fused path — bitwise-identical either way."""
+    fused path — bitwise-identical either way.  With a mesh, the dense
+    math shards exactly like stream._kernel (batch-over-"snode",
+    columns-over-"panel", pool via factor.pool_spec)."""
     batch, m, w, u = dims
+    front_sharding = pivot_sharding = replicated = pool_sharding = None
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from superlu_dist_tpu.numeric.factor import pool_spec
+        front_sharding = NamedSharding(mesh, P("snode", None, "panel"))
+        pivot_sharding = NamedSharding(mesh, P("snode", None, None))
+        replicated = NamedSharding(mesh, P(None, None))
+        pool_sharding = pool_spec(mesh, pool_partition)
 
     def step(avals, pool, thresh, a_slot, a_flat, a_src, ws, off,
              child_off, child_slot, child_ub, rel):
-        return group_step((batch, m, w, u), avals, pool, thresh,
-                          a_slot, a_flat, a_src, ws, off,
-                          (child_off, child_slot, child_ub, rel),
-                          pivot=pivot, gemm_prec=gemm_prec, pallas=pallas)
+        if pool_sharding is not None:
+            pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
+        out, pool, tiny = group_step(
+            (batch, m, w, u), avals, pool, thresh,
+            a_slot, a_flat, a_src, ws, off,
+            (child_off, child_slot, child_ub, rel),
+            front_sharding=front_sharding, pivot_sharding=pivot_sharding,
+            replicated=replicated, pivot=pivot,
+            gemm_prec=gemm_prec, pallas=pallas)
+        if pool_sharding is not None:
+            pool = jax.lax.with_sharding_constraint(pool, pool_sharding)
+        return out, pool, tiny
 
     # pool donated exactly like the streamed kernels: XLA scatters the
     # Schur write-back in place instead of copying pool_len entries
@@ -111,20 +138,15 @@ class MegaExecutor(StreamExecutor):
     def __init__(self, plan: FactorPlan, dtype="float64", mesh=None,
                  offload: str = "auto", pool_partition: bool = False,
                  host_flops=None, gemm_prec=None, pallas=None):
-        if mesh is not None or pool_partition:
-            raise ValueError(
-                "MegaExecutor is single-device (its metadata-as-data "
-                "programs have no SPMD story) — use the streamed "
-                "executor on a mesh")
         self._mega_fns = {}
         self._spec = {}
         # host-share is off by construction: the per-bucket programs are
         # device-resident and the leading-leaf split would need per-group
         # placement of the packed metadata
-        super().__init__(plan, dtype, mesh=None, offload=offload,
-                         pool_partition=False, granularity="group",
-                         host_flops=0.0, gemm_prec=gemm_prec,
-                         pallas=pallas)
+        super().__init__(plan, dtype, mesh=mesh, offload=offload,
+                         pool_partition=pool_partition,
+                         granularity="group", host_flops=0.0,
+                         gemm_prec=gemm_prec, pallas=pallas)
         self.granularity = "mega"
 
     # ---- canonical metadata packing -------------------------------------
@@ -192,7 +214,8 @@ class MegaExecutor(StreamExecutor):
         fn = self._mega_fns.get((key, pivot))
         if fn is not None:
             return fn
-        jfn = _mega_kernel(*key, pivot, self.gemm_prec, self.pallas)
+        jfn = _mega_kernel(*key, pivot, self.gemm_prec, self.pallas,
+                           self.mesh, self.pool_partition)
         sds = tuple(jax.ShapeDtypeStruct(x.shape, x.dtype) for x in args)
         # program audit at AOT-stage time: a finding raises BEFORE the
         # XLA compile below ever runs (SLU_TPU_VERIFY_PROGRAMS=1)

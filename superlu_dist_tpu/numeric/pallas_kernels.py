@@ -37,9 +37,14 @@ Gating: ``SLU_TPU_PALLAS`` = auto (on when a TPU backend is present),
 1/on, interpret (forced interpreter mode — what CI exercises on CPU),
 or 0/off.  The mode is resolved in the UNCACHED executor factories and
 threaded into every kernel cache key like the pivot-kernel choice
-(slulint SLU102/SLU104/SLU105); mesh-sharded runs pin it off (the SPMD
-partitioner owns the layout there).  Index maps are cast to int32 for
-the kernels — plans past the int32 pool range fall back to ``.at[]``
+(slulint SLU102/SLU104/SLU105).  Mesh runs no longer pin the mode off:
+under the shard_map SPMD tier each device runs the kernel on its local
+slot shard (both kernels are bitwise twins of the ``.at[]`` lowering,
+which is per-slot, so re-batching across devices preserves every bit),
+and under the GSPMD stream/mega tiers the interpret lowering is plain
+HLO the partitioner places like any other — interpret-mode on CPU
+meshes, native Mosaic on TPU.  Index maps are cast to int32 for the
+kernels — plans past the int32 pool range fall back to ``.at[]``
 (``plan.check_index_width`` governs those anyway).
 """
 

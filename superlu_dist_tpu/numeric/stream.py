@@ -196,7 +196,11 @@ class StreamExecutor:
         # THIS, never a tier the math didn't use (slulint v5 satellite)
         self.gemm_prec_resolved = resolve_gemm_tier(self.gemm_prec,
                                                     self.dtype)
-        self.pallas = "off" if mesh is not None else pallas_mode(pallas)
+        # Pallas rides through under meshes too (interpret-mode on CPU
+        # meshes, native on TPU) — the old "pin OFF under mesh"
+        # composition debt is cleared; pallas_kernels.py emits the
+        # .at[]-fallback only when a kernel genuinely can't partition
+        self.pallas = pallas_mode(pallas)
         # granularity="level" traces all bucket groups sharing one
         # schedule wave (Group.level: the elimination level under
         # SLU_TPU_SCHEDULE=level, the monotone dispatch wave under the

@@ -16,6 +16,15 @@ interconnect bandwidths for the n·nrhs vectors involved.
 
 CSR padding makes the local blocks static-shape so one jitted kernel
 serves every shard.
+
+Where this sits in the SPMD-first stack: these row blocks are the
+INPUT/OUTPUT distribution only (matrix assembly, refinement SpMV).  The
+factor/solve numeric path no longer walks a per-rank host dispatch
+loop over them — on a single-controller mesh it is one shard_map
+program per factor/solve (parallel/spmd.py) and on multi-process
+meshes the GSPMD streamed kernels; the TreeComm host-lockstep tier
+that used to carry this traffic is the A/B reference and recovery
+fallback (parallel/pgssvx.py).
 """
 
 from __future__ import annotations

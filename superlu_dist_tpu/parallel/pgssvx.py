@@ -12,10 +12,18 @@ TPU-native split: the analysis + factorization are single-address-space
 (they run where the accelerator is — rank 0), so the distributed input is
 first assembled there, exactly like the reference's
 pdCompRow_loc_to_CompCol_global gather before serial preprocessing
-(pdgssvx.c:775).  This root-gather is the single-host fallback; when the
-participating processes share one jax.distributed world, the root's
-factorization itself runs sharded over the mesh spanning their devices
-(parallel/grid.gridinit_multihost + gssvx(grid=...)).  The
+(pdgssvx.c:775).  The numeric work itself is SPMD-first: on a
+single-controller mesh the factorization is ONE shard_map program and
+each solve sweep one more (parallel/spmd.py — panels block-cyclic over
+the flat device order, every extend-add/Schur/lsum exchange an
+in-program collective; factor.get_executor's auto rule picks it), and
+on a mesh spanning a jax.distributed world the GSPMD streamed kernels
+shard over grid axes (parallel/grid.gridinit_multihost +
+gssvx(grid=...)).  The host-mediated TreeComm lockstep tier is DEMOTED
+to the A/B reference and recovery fallback: the root-gather path below
+survives as the single-host fallback, its per-rank dispatch loop the
+bitwise baseline the SPMD tier is gated against
+(scripts/check_spmd_equiv.py, tests/test_spmd.py).  The
 gather/broadcast ride the shared-memory tree collectives
 (parallel/treecomm.py); refinement then runs distributed
 (parallel/pgsrfs.py) so the residual work stays with the row owners —

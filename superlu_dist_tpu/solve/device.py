@@ -314,11 +314,15 @@ class DeviceSolver:
         case): the RHS then uploads replicated over the global mesh and
         the index maps stay numpy (pjit treats identical host arrays as
         replicated global inputs), so every controller runs the same SPMD
-        sweeps and reads the replicated result locally.  The sweep
-        schedule is then pinned to "factor" — re-gathering panel stacks
-        would commit non-addressable shards to one local device — so a
-        multi-process solve keeps the factor grouping 1:1.
-        Single-process solves (including virtual meshes) don't need it."""
+        sweeps and reads the replicated result locally.  On such a
+        MULTI-PROCESS mesh the sweep schedule is pinned to "factor" —
+        re-gathering panel stacks into dataflow sweep batches would
+        commit non-addressable shards to one local device (solve/plan.py
+        documents the rationale) — so those solves keep the factor
+        grouping 1:1.  Single-process mesh solves are NOT pinned: one
+        controller addresses every device, so the dataflow solve
+        schedule applies, and the shard_map tier (parallel/spmd.SpmdSolver,
+        which subclasses this with mesh=None) always uses it."""
         self.fact = fact
         self.diag_inv = diag_inv
         self.mesh = mesh
@@ -333,7 +337,10 @@ class DeviceSolver:
         # part of every sweep-kernel cache key below
         from superlu_dist_tpu.ops.dense import gemm_precision
         self.gemm_prec = gemm_precision(gemm_prec)
-        if mesh is not None:
+        if mesh is not None and jax.process_count() > 1:
+            # the factor-schedule pin is a MULTI-PROCESS constraint only
+            # (docstring above; solve/plan.py) — single-process meshes
+            # keep the dataflow solve schedule like any local solve
             solve_plan = build_solve_plan(plan, schedule="factor",
                                           nrhs_max=nrhs_max,
                                           nrhs_growth=nrhs_growth)

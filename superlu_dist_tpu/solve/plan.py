@@ -30,9 +30,19 @@ finished :class:`~superlu_dist_tpu.numeric.plan.FactorPlan`:
   ROADMAP item 3 closed-bucket discipline.
 
 Schedules: ``dataflow`` (default) | ``level`` (strict level lockstep)
-| ``factor`` (mirror the factor grouping 1:1 — the pre-PR-9 behavior,
-also forced on multi-process mesh solves, where panels cannot be
-re-gathered without committing shards to one device).
+| ``factor`` (mirror the factor grouping 1:1 — the pre-PR-9 behavior).
+
+The ``factor`` schedule is FORCED only on MULTI-PROCESS mesh solves
+(solve/device.DeviceSolver): regrouping supernodes into dataflow sweep
+batches re-stacks panels out of their factor-group arrays, and on a
+multi-process mesh those stacks hold shards the local controller
+cannot address — any re-gather would commit non-addressable remote
+shards to one local device (a cross-host copy pjit forbids).  Keeping
+the factor grouping 1:1 means every sweep kernel consumes the factor
+arrays exactly as sharded.  Single-process meshes (including the
+virtual CPU mesh and the shard_map SPMD tier, parallel/spmd.SpmdSolver)
+have one controller addressing every shard, so they keep the dataflow
+schedule and its cross-level batching wins.
 
 Like the factor plan, everything here is host-side numpy, computed once
 per factorization and reused across every subsequent solve

@@ -16,26 +16,38 @@ vs 2/2 green cold compiles, round 4).  Scoping the directory by
 fingerprint makes every entry point immune to foreign entries while
 keeping same-machine warm starts: a different box simply reads a
 different directory.
+
+Two layers (the BENCH_r05 hardening — the "machine features don't
+match ... could lead to SIGILL" warning survived the first fingerprint
+because it hashed only the FIRST core's cpuinfo flags line, and
+heterogeneous-core hosts / migrated VMs expose different feature sets
+on later cores):
+
+* the fingerprint hashes the FULL host-feature set — every distinct
+  flags/Features line across all cores plus family/model/stepping/
+  microcode — so a host whose features drift reads a different
+  directory by construction;
+* ``enable_compile_cache`` additionally stamps the chosen directory
+  with the RAW feature text (`.host_features`) and verifies it on
+  every enable: a mismatch (an unhashed axis drifted, or a collision)
+  re-scopes to a feature-exact subdirectory instead of loading the
+  poisoned entries, and bumps :func:`isa_mismatch_count` — bench.py
+  emits that counter per row and asserts it stays 0.
 """
 
 import os
 
 _FP_CACHE = None
+_ISA_MISMATCHES = 0
 
 
-def machine_fingerprint() -> str:
-    """Short stable tag for (machine ISA, jax toolchain).
-
-    Built from the CPU model + feature flags (the exact axis on which
-    the cpu_aot loader declares entries incompatible) and the jax/jaxlib
-    versions (serialization format axis).  Deterministic within a
-    machine+install, distinct across the machines that produced the
-    round-4 poisoned-cache hangs.
-    """
-    global _FP_CACHE
-    if _FP_CACHE is not None:
-        return _FP_CACHE
-    import hashlib
+def host_features() -> str:
+    """The raw (machine ISA, jax toolchain) feature text the cache
+    directory is keyed by — the exact axes on which the cpu_aot loader
+    declares entries incompatible, plus the serialization-format and
+    platform-flavor axes.  Unmemoized on purpose: the fingerprint memo
+    (`_FP_CACHE`) is the single cache, so clearing it (tests, forks)
+    re-reads the live host state."""
     import platform
 
     bits = [platform.machine()]
@@ -61,19 +73,77 @@ def machine_fingerprint() -> str:
     # select the flavor so the flavors never share a directory.
     bits += [str(platforms), os.environ.get("XLA_FLAGS", "")]
     try:
+        # EVERY distinct value per key, not just the first core's: the
+        # codegen host-feature probe may run on any core, and
+        # heterogeneous-core machines (or migrated VMs) expose
+        # different flags per core — the BENCH_r05 SIGILL-warning tail
         seen = set()
         with open("/proc/cpuinfo") as fh:
             for line in fh:
                 key = line.split(":", 1)[0].strip()
-                # one copy per key: these lines repeat per core
-                if key in ("model name", "flags", "Features") \
-                        and key not in seen:
-                    seen.add(key)
-                    bits.append(line.strip())
+                if key in ("model name", "flags", "Features",
+                           "cpu family", "model", "stepping",
+                           "microcode"):
+                    ln = line.strip()
+                    if ln not in seen:
+                        seen.add(ln)
+                        bits.append(ln)
     except OSError:
         bits.append(platform.processor() or "unknown-cpu")
-    _FP_CACHE = hashlib.sha256("|".join(bits).encode()).hexdigest()[:10]
+    return "|".join(bits)
+
+
+def machine_fingerprint() -> str:
+    """Short stable tag for (machine ISA, jax toolchain) — the sha256 of
+    :func:`host_features`.  Deterministic within a machine+install,
+    distinct across the machines that produced the round-4
+    poisoned-cache hangs."""
+    global _FP_CACHE
+    if _FP_CACHE is not None:
+        return _FP_CACHE
+    import hashlib
+    _FP_CACHE = hashlib.sha256(host_features().encode()).hexdigest()[:10]
     return _FP_CACHE
+
+
+def isa_mismatch_count() -> int:
+    """How many times enable_compile_cache found a cache directory whose
+    host-feature stamp disagreed with this host (each one re-scoped to a
+    fresh feature-exact directory instead of loading the entries).  The
+    bench emits this per row and asserts 0 — nonzero means an
+    ISA-compatibility axis escaped the fingerprint hash."""
+    return _ISA_MISMATCHES
+
+
+def _stamp_host_features(cache_dir: str) -> str:
+    """Verify/write the `.host_features` stamp for ``cache_dir``.
+    Returns the directory to actually use: ``cache_dir`` when the stamp
+    matches (or was just written), else a feature-exact subdirectory —
+    entries compiled under different host features are never loaded.
+    Never raises (cache-is-an-optimization contract)."""
+    global _ISA_MISMATCHES
+    import hashlib
+    feats = host_features()
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        stamp = os.path.join(cache_dir, ".host_features")
+        if os.path.exists(stamp):
+            with open(stamp) as fh:
+                if fh.read() != feats:
+                    _ISA_MISMATCHES += 1
+                    sub = hashlib.sha256(feats.encode()).hexdigest()[:10]
+                    cache_dir = os.path.join(cache_dir, f"isa-{sub}")
+                    os.makedirs(cache_dir, exist_ok=True)
+                    stamp = os.path.join(cache_dir, ".host_features")
+                    if not os.path.exists(stamp):
+                        with open(stamp, "w") as fh:
+                            fh.write(feats)
+        else:
+            with open(stamp, "w") as fh:
+                fh.write(feats)
+    except OSError:
+        pass
+    return cache_dir
 
 
 def cache_dir_for_machine(base: str | None = None) -> str:
@@ -141,6 +211,7 @@ def enable_compile_cache(cache_dir: str | None = None) -> None:
     import jax
     if cache_dir is None:
         cache_dir = cache_dir_for_machine()
+    cache_dir = _stamp_host_features(cache_dir)
     try:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)

@@ -132,9 +132,19 @@ def _register_all() -> None:
     r("SLU_TPU_EXECUTOR", "str", "auto",
       "numeric-factorization executor: one whole-program jit (fused), "
       "one kernel per shape key (stream), one data-driven program per "
-      "closed shape bucket (mega), or the backend-dependent default "
-      "(auto).  df64 factorization keeps its own executor",
-      group="numeric", choices=("auto", "fused", "stream", "mega"))
+      "closed shape bucket (mega), the shard_map mesh tier with "
+      "in-program collectives (spmd — needs a single-process mesh), or "
+      "the backend-dependent default (auto).  df64 factorization keeps "
+      "its own executor",
+      group="numeric", choices=("auto", "fused", "stream", "mega",
+                                "spmd"))
+    r("SLU_TPU_SPMD", "str", "auto",
+      "shard_map SPMD tier gate (parallel/spmd.py): auto/empty = on "
+      "for single-process meshes (one compiled program per factor and "
+      "per solve-sweep bucket, bitwise-identical to the lockstep "
+      "path), 0/off = keep the GSPMD stream/fused tiers, anything "
+      "else = force on", group="numeric",
+      choices=("auto", "0", "1", "on", "off"))
     r("SLU_TPU_DIAG_INV", "flag", False,
       "precompute inverted diagonal blocks (reference DiagInv)",
       group="numeric")
@@ -424,7 +434,12 @@ def _register_all() -> None:
             ("BENCH_MATRIX", "str", "poisson3d", "bench matrix family"),
             ("BENCH_GRANULARITY", "str", None, "stream granularity"),
             ("BENCH_SOLVE_NRHS", "str", "1,64,1024",
-             "device-solve bench nrhs sweep (comma list; empty skips)")):
+             "device-solve bench nrhs sweep (comma list; empty skips)"),
+            ("BENCH_MESH", "str", "",
+             "mesh mode: a 'RxC' spec (e.g. 1x8) factors and solves on "
+             "that virtual/real device grid through the shard_map SPMD "
+             "tier and emits mesh_shape/n_devices/spmd row fields; "
+             "empty = single-device bench")):
         r(name, kind, default, help_, group="bench")
     # --- measurement scripts ----------------------------------------------
     for name, kind, default, help_ in (

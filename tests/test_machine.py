@@ -104,6 +104,46 @@ def test_machine_fingerprint_stable_and_scoped(tmp_path, monkeypatch):
     assert fp2 != fp
 
 
+def test_cache_dir_host_feature_stamp(tmp_path):
+    """enable_compile_cache stamps the directory with the raw host
+    features and refuses to reuse a directory stamped by a different
+    host: a mismatch re-scopes to a feature-exact subdirectory (the
+    poisoned entries are never opened) and bumps isa_mismatch_count —
+    the counter the bench asserts stays 0 (BENCH_r05 'machine features
+    don't match ... SIGILL' tail)."""
+    import os
+
+    import superlu_dist_tpu.utils.jaxcache as jc
+
+    prior = jc.current_cache_dir()
+    mine = str(tmp_path / "cache")
+    try:
+        base = jc.isa_mismatch_count()
+        jc.enable_compile_cache(mine)
+        stamp = os.path.join(mine, ".host_features")
+        assert os.path.exists(stamp)
+        assert open(stamp).read() == jc.host_features()
+        # matching stamp: same dir, no mismatch recorded
+        jc.enable_compile_cache(mine)
+        assert jc.current_cache_dir() == mine
+        assert jc.isa_mismatch_count() == base
+        # foreign stamp: re-scope to a feature-exact subdir, count it
+        with open(stamp, "w") as fh:
+            fh.write("some-other-host|other-flags")
+        jc.enable_compile_cache(mine)
+        used = jc.current_cache_dir()
+        assert used != mine and used.startswith(mine)
+        assert os.path.basename(used).startswith("isa-")
+        assert open(os.path.join(used, ".host_features")).read() \
+            == jc.host_features()
+        assert jc.isa_mismatch_count() == base + 1
+    finally:
+        if prior:
+            jc.enable_compile_cache(prior)
+        else:
+            jc.disable_compile_cache()
+
+
 def test_dryrun_throwaway_cache_never_outlives_its_directory(monkeypatch,
                                                              tmp_path):
     """dryrun_multichip uses a deliberately throwaway compile cache; on

@@ -225,7 +225,7 @@ def test_kernel_count_constant_in_n():
         counts[-1] < stream_counts[-1]
 
 
-def test_mega_is_single_device_only():
+def test_mega_accepts_mesh():
     import jax
     from jax.sharding import Mesh
 
@@ -233,19 +233,18 @@ def test_mega_is_single_device_only():
     from superlu_dist_tpu.numeric.factor import get_executor
     from superlu_dist_tpu.numeric.mega import MegaExecutor
     from superlu_dist_tpu.numeric.plan import build_plan
-    from superlu_dist_tpu.numeric.stream import StreamExecutor
 
     sf, _, _ = _analyzed(poisson2d(10))
     plan = build_plan(sf, closed=True)
     devs = np.array(jax.devices()[:2]).reshape(2, 1)
     mesh = Mesh(devs, ("snode", "panel"))
-    with pytest.raises(ValueError):
-        MegaExecutor(plan, "float64", mesh=mesh)
-    # get_executor downgrades mega -> stream on a mesh (SPMD runs keep
-    # the shardable per-key kernels)
+    # mega composes under a mesh now (GSPMD-sharded bucket programs) —
+    # an explicit mega request keeps the MegaExecutor instead of
+    # downgrading to stream; tests/test_spmd.py pins the numerics
+    ex = MegaExecutor(plan, "float64", mesh=mesh)
+    assert ex.mesh is mesh
     ex = get_executor(plan, "float64", executor="mega", mesh=mesh)
-    assert isinstance(ex, StreamExecutor) and not isinstance(
-        ex, MegaExecutor)
+    assert isinstance(ex, MegaExecutor) and ex.mesh is mesh
     with pytest.raises(ValueError):
         get_executor(plan, "float64", executor="bogus")
 
